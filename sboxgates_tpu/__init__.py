@@ -1,0 +1,10 @@
+"""sboxgates_tpu — TPU-native framework for minimal-gate-count S-box circuits.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+dansarie/sboxgates (reference mounted at ``/root/reference``): Kwan's
+bitslice gate-minimization algorithm extended with 3/5/7-input LUT search,
+with the combinatorial candidate sweeps running as batched device kernels
+sharded over a ``jax.sharding.Mesh`` in place of the reference's MPI backend.
+"""
+
+__version__ = "0.1.0"

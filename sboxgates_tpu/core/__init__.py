@@ -1,0 +1,1 @@
+from . import boolfunc, ttable  # noqa: F401

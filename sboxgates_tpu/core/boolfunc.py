@@ -1,0 +1,231 @@
+"""Boolean-function algebra.
+
+Enumerates the 2-input gate functions available to a search and the 3-input
+functions expressible as ``fun2(fun1(A, B), C)`` over them, with optional NOT
+gates on inputs/outputs.  Mirrors the semantics of the reference's
+``boolfunc.c`` (get_val boolfunc.c:22-25, create_2_input_fun boolfunc.c:56-71,
+get_not_functions boolfunc.c:36-54, get_3_input_function_list
+boolfunc.c:73-134) — this layer is tiny, branchy host code, so it is plain
+Python; the *evaluation* of these functions happens in batched device sweeps.
+
+Note: the reference has an apparent indexing bug where 3-input commutativity
+flags are read from ``opt->avail_3[m]`` with ``m`` a gate index rather than
+the function index ``p`` (sboxgates.c:411,418,425).  We use the function's
+own flags.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence
+
+# Enum values of the 16 two-input gate functions plus NOT/IN/LUT, identical
+# to the reference's gate_type (state.h:36-57).  The enum value of a 2-input
+# gate is its 4-bit truth table: f(1,1)=bit0, f(1,0)=bit1, f(0,1)=bit2,
+# f(0,0)=bit3.
+FALSE_GATE = 0
+AND = 1
+A_AND_NOT_B = 2
+A = 3
+NOT_A_AND_B = 4
+B = 5
+XOR = 6
+OR = 7
+NOR = 8
+XNOR = 9
+NOT_B = 10
+A_OR_NOT_B = 11
+NOT_A = 12
+NOT_A_OR_B = 13
+NAND = 14
+TRUE_GATE = 15
+NOT = 16
+IN = 17
+LUT = 18
+
+GATE_NAMES = [
+    "FALSE",
+    "AND",
+    "A_AND_NOT_B",
+    "A",
+    "NOT_A_AND_B",
+    "B",
+    "XOR",
+    "OR",
+    "NOR",
+    "XNOR",
+    "NOT_B",
+    "A_OR_NOT_B",
+    "NOT_A",
+    "NOT_A_OR_B",
+    "NAND",
+    "TRUE",
+    "NOT",
+    "IN",
+    "LUT",
+]
+
+GATE_BY_NAME = {name: i for i, name in enumerate(GATE_NAMES)}
+
+DEFAULT_AVAILABLE = (1 << AND) | (1 << OR) | (1 << XOR)  # = 2 + 64 + 128
+
+
+def get_val(fun: int, a: int, b: int) -> int:
+    """Value of 2-input function ``fun`` on inputs A=a, B=b."""
+    return (fun >> (3 - ((a << 1) | b))) & 1
+
+
+def fun3_val(fun: int, a: int, b: int, c: int) -> int:
+    """Value of 3-input function byte ``fun``: bit k = f at k = A<<2|B<<1|C."""
+    return (fun >> ((a << 2) | (b << 1) | c)) & 1
+
+
+@dataclass(frozen=True)
+class BoolFunc:
+    """A 2- or 3-input Boolean function with its gate decomposition.
+
+    3-input functions decompose as ``fun2(fun1(A, B), C)``; NOT gates may be
+    interposed on any input or the output (reference: boolfunc.h:28-40).
+    """
+
+    num_inputs: int
+    fun: int                 # 4-bit (2-input) or 8-bit (3-input) truth table
+    fun1: int                # first 2-input gate
+    fun2: Optional[int]      # second 2-input gate (3-input functions only)
+    not_a: bool = False
+    not_b: bool = False
+    not_c: bool = False
+    not_out: bool = False
+    ab_commutative: bool = False
+    ac_commutative: bool = False
+    bc_commutative: bool = False
+
+    @property
+    def extra_gates(self) -> int:
+        """Number of NOT gates this decomposition adds on top of fun1/fun2."""
+        return sum((self.not_a, self.not_b, self.not_c, self.not_out))
+
+
+def create_2_input_fun(fun: int) -> BoolFunc:
+    """Wraps a function nibble; A/B commutativity iff f(0,1) == f(1,0)."""
+    assert 0 <= fun < 16
+    return BoolFunc(
+        num_inputs=2,
+        fun=fun,
+        fun1=fun,
+        fun2=None,
+        ab_commutative=bool(~((fun >> 1) ^ (fun >> 2)) & 1),
+    )
+
+
+def create_avail_gates(bitfield: int) -> List[BoolFunc]:
+    """Expands a 16-bit gate-availability bitfield into BoolFuncs.
+
+    Reference: create_avail_gates (sboxgates.c:870-880); the default set is
+    AND+OR+XOR (sboxgates.c:1078).
+    """
+    assert 0 < bitfield <= 0xFFFF
+    return [create_2_input_fun(i) for i in range(16) if bitfield & (1 << i)]
+
+
+def get_not_functions(input_funs: Sequence[BoolFunc]) -> List[BoolFunc]:
+    """For each available gate, adds its output complement if novel.
+
+    E.g. AND available -> NAND becomes available by appending a NOT gate.
+    Reference: get_not_functions (boolfunc.c:36-54).
+    """
+    have = {f.fun for f in input_funs}
+    out: List[BoolFunc] = []
+    for f in input_funs:
+        cfun = ~f.fun & 0xF
+        if cfun not in have and cfun not in {g.fun for g in out}:
+            out.append(replace(f, fun=cfun, not_out=not f.not_out))
+    return out
+
+
+def _fun3_commutativity(fun: int) -> tuple:
+    """(ab, ac, bc) commutativity of a 3-input function byte.
+
+    Swapping two inputs permutes truth-table bit positions; the function is
+    commutative in that pair iff the table is invariant (boolfunc.c:106-108).
+    """
+    ab = bool((~((fun >> 2) ^ (fun >> 4)) & ~((fun >> 3) ^ (fun >> 5))) & 1)
+    ac = bool((~((fun >> 1) ^ (fun >> 4)) & ~((fun >> 3) ^ (fun >> 6))) & 1)
+    bc = bool((~((fun >> 1) ^ (fun >> 2)) & ~((fun >> 5) ^ (fun >> 6))) & 1)
+    return ab, ac, bc
+
+
+def get_3_input_function_list(
+    input_funs: Sequence[BoolFunc], try_nots: bool
+) -> List[BoolFunc]:
+    """All distinct 3-input functions buildable as fun2(fun1(A,B),C).
+
+    With ``try_nots``, NOT gates may be placed on any of the three inputs (8
+    polarity combinations) and on the output.  The first decomposition found
+    for each 8-bit truth table wins, matching the reference's enumeration
+    order (boolfunc.c:73-134): polarities in the order
+    {none, c, b, a, b+c, a+c, a+b, a+b+c}, then fun1, then fun2.
+    """
+    funs: dict = {}
+    # Reference order nots[] = {0,1,2,4,3,5,6,7} where bit2=not_a, bit1=not_b,
+    # bit0=not_c applied to the *input index* during table construction.
+    nots_order = (0, 1, 2, 4, 3, 5, 6, 7)
+    for notsp in nots_order if try_nots else (0,):
+        for fi in input_funs:
+            for fk in input_funs:
+                fun = 0
+                for val in range(8):
+                    idx = (7 - val) ^ notsp
+                    a, b, c = (idx >> 2) & 1, (idx >> 1) & 1, idx & 1
+                    fun = (fun << 1) | get_val(fk.fun, get_val(fi.fun, a, b), c)
+                if fun not in funs:
+                    ab, ac, bc = _fun3_commutativity(fun)
+                    funs[fun] = BoolFunc(
+                        num_inputs=3,
+                        fun=fun,
+                        fun1=fi.fun,
+                        fun2=fk.fun,
+                        not_a=bool(notsp & 4),
+                        not_b=bool(notsp & 2),
+                        not_c=bool(notsp & 1),
+                        ab_commutative=ab,
+                        ac_commutative=ac,
+                        bc_commutative=bc,
+                    )
+    if try_nots:
+        for fun in range(256):
+            nfun = ~fun & 0xFF
+            if fun in funs and nfun not in funs:
+                base = funs[fun]
+                ab, ac, bc = _fun3_commutativity(nfun)
+                funs[nfun] = replace(
+                    base,
+                    fun=nfun,
+                    not_out=True,
+                    ab_commutative=ab,
+                    ac_commutative=ac,
+                    bc_commutative=bc,
+                )
+    return [funs[f] for f in sorted(funs)]
+
+
+def permute_fun3(fun: int, perm: tuple) -> int:
+    """Truth table of ``fun`` with its inputs permuted.
+
+    ``perm`` maps new operand positions to old: the returned function g
+    satisfies g(x0, x1, x2) = fun(x[perm[0]], x[perm[1]], x[perm[2]]).
+    Used to fold non-commutative operand orders into plain byte comparisons
+    in the triple sweep (replacing the reference's repeated ttable
+    evaluations at sboxgates.c:406-432).
+    """
+    g = 0
+    for k in range(8):
+        x = ((k >> 2) & 1, (k >> 1) & 1, k & 1)  # (x0, x1, x2)
+        src = (x[perm[0]] << 2) | (x[perm[1]] << 1) | x[perm[2]]
+        g |= ((fun >> src) & 1) << k
+    return g
+
+
+def swap_fun2(fun: int) -> int:
+    """Truth table of a 2-input function with A and B swapped."""
+    return (fun & 0b1001) | ((fun & 0b0100) >> 1) | ((fun & 0b0010) << 1)

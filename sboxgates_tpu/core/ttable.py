@@ -1,0 +1,169 @@
+"""Truth-table bitvector primitives.
+
+A truth table (*ttable*) represents a Boolean function of up to eight
+variables as a 256-bit vector: bit ``i`` holds the function value for input
+``i``.  The reference implements this as a 256-bit GCC vector of four
+``uint64_t`` lanes (``/root/reference/state.h:64-68``) with LSB-first bit
+order inside each lane (``/root/reference/state.c:232-250``).
+
+TPU-natively, a ttable is an array of **eight little-endian uint32 words**
+(last axis), because uint32 is the natural VPU lane width.  Bit ``i`` lives
+in word ``i // 32`` at position ``i % 32`` — the same global bit order as the
+reference, just with a narrower word.  A *batch* of N tables is a
+``uint32[N, 8]`` array; all gate evaluations are elementwise logic ops that
+JAX maps straight onto the VPU, and batches shard along the leading axis.
+
+All functions here are polymorphic over numpy and jax.numpy arrays: they use
+only operators and methods both support, so the same code runs as the host
+oracle and inside jitted sweeps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_WORDS = 8
+WORD_BITS = 32
+TABLE_BITS = N_WORDS * WORD_BITS  # 256
+
+_FULL_WORD = np.uint32(0xFFFFFFFF)
+
+
+def zero() -> np.ndarray:
+    """All-false truth table."""
+    return np.zeros(N_WORDS, dtype=np.uint32)
+
+
+def ones() -> np.ndarray:
+    """All-true truth table."""
+    return np.full(N_WORDS, _FULL_WORD, dtype=np.uint32)
+
+
+def from_bits(bits) -> np.ndarray:
+    """Packs a boolean array (last axis = 256) into uint32 words (last axis = 8).
+
+    Host-side constructor (numpy only).
+    """
+    bits = np.asarray(bits, dtype=bool)
+    assert bits.shape[-1] == TABLE_BITS
+    b = bits.reshape(bits.shape[:-1] + (N_WORDS, WORD_BITS)).astype(np.uint32)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    return (b << shifts).sum(axis=-1, dtype=np.uint32)
+
+
+def to_bits(tt) -> np.ndarray:
+    """Unpacks uint32 words (last axis = 8) into a boolean array (last axis = 256).
+
+    Host-side helper (numpy only).
+    """
+    tt = np.asarray(tt, dtype=np.uint32)
+    assert tt.shape[-1] == N_WORDS
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    bits = (tt[..., :, None] >> shifts) & np.uint32(1)
+    return bits.reshape(tt.shape[:-1] + (TABLE_BITS,)).astype(bool)
+
+
+def target_table(sbox: np.ndarray, bit: int) -> np.ndarray:
+    """Truth table of output bit ``bit`` of an S-box.
+
+    Bit ``i`` of the result is ``(sbox[i] >> bit) & 1``.  Equivalent of the
+    reference's ``generate_target(bit, true)`` (state.c:232-250).
+    """
+    sbox = np.asarray(sbox, dtype=np.uint32)
+    assert sbox.shape == (256,)
+    return from_bits((sbox >> np.uint32(bit)) & np.uint32(1))
+
+
+def input_table(var: int) -> np.ndarray:
+    """Truth table of input variable ``var``: bit ``i`` is ``(i >> var) & 1``.
+
+    Equivalent of the reference's ``generate_target(bit, false)``.
+    """
+    assert 0 <= var < 8
+    idx = np.arange(TABLE_BITS, dtype=np.uint32)
+    return from_bits((idx >> np.uint32(var)) & np.uint32(1))
+
+
+def mask_table(num_inputs: int) -> np.ndarray:
+    """Mask with the low ``2**num_inputs`` bits set.
+
+    For an n-input S-box only the first 2^n positions of a ttable are
+    meaningful; everything else is masked off.  Equivalent of the reference's
+    ``generate_mask`` (sboxgates.c:644-659).
+    """
+    assert 1 <= num_inputs <= 8
+    valid = 1 << num_inputs
+    idx = np.arange(TABLE_BITS, dtype=np.uint32)
+    return from_bits(idx < valid)
+
+
+def is_zero(tt):
+    """True where the table (last axis) is all-zero. Works on np and jnp."""
+    return ~(tt != 0).any(axis=-1)
+
+
+def eq_mask(a, b, mask):
+    """Masked equality: true where ``a`` and ``b`` agree on all bits set in
+    ``mask`` (reference: ``ttable_equals_mask``, sboxgates.c:91-93).
+
+    Broadcasts over leading axes; reduces the last (word) axis.
+    """
+    return is_zero((a ^ b) & mask)
+
+
+def eval_gate2(fun, a, b):
+    """Evaluates a 2-input gate given its 4-bit function value.
+
+    The gate_type enum value *is* the function's truth table read MSB-first
+    from input (A=0,B=0) (reference: get_val, boolfunc.c:22-25), i.e.::
+
+        f(1,1) = bit0,  f(1,0) = bit1,  f(0,1) = bit2,  f(0,0) = bit3
+
+    ``fun`` may be scalar or an array broadcastable against ``a``/``b``.
+    Implemented as a sum of minterms — four fused elementwise ops on the VPU
+    instead of the reference's 16-way switch (boolfunc.c:136-157).
+    """
+    f = fun
+    b0 = -((f >> 0) & 1)  # all-ones where bit set (two's complement trick)
+    b1 = -((f >> 1) & 1)
+    b2 = -((f >> 2) & 1)
+    b3 = -((f >> 3) & 1)
+    if isinstance(f, (int, np.integer)):
+        b0, b1, b2, b3 = (np.uint32(x & 0xFFFFFFFF) for x in (b0, b1, b2, b3))
+    else:
+        b0, b1, b2, b3 = (x.astype(a.dtype) for x in (b0, b1, b2, b3))
+    return (b0 & a & b) | (b1 & a & ~b) | (b2 & ~a & b) | (b3 & ~a & ~b)
+
+
+def eval_lut(func, a, b, c):
+    """Evaluates a 3-input LUT given its 8-bit function value.
+
+    Bit ``k`` of ``func`` is the output for inputs ``k = A<<2 | B<<1 | C``
+    (reference: generate_lut_ttable, state.c:202-230).  Sum of the up-to-8
+    minterms, vectorized over broadcast shapes.
+    """
+    f = func
+    scalar = isinstance(f, (int, np.integer))
+
+    def bit(k):
+        v = -((f >> k) & 1)
+        if scalar:
+            return np.uint32(v & 0xFFFFFFFF)
+        return v.astype(a.dtype)
+
+    return (
+        (bit(0) & ~a & ~b & ~c)
+        | (bit(1) & ~a & ~b & c)
+        | (bit(2) & ~a & b & ~c)
+        | (bit(3) & ~a & b & c)
+        | (bit(4) & a & ~b & ~c)
+        | (bit(5) & a & ~b & c)
+        | (bit(6) & a & b & ~c)
+        | (bit(7) & a & b & c)
+    )
+
+
+def table_as_hex(tt) -> str:
+    """Debug representation: 64 hex chars, most significant position first."""
+    words = np.asarray(tt, dtype=np.uint32)
+    return "".join(f"{int(w):08x}" for w in words[::-1])

@@ -1,0 +1,19 @@
+from .state import (  # noqa: F401
+    GATES,
+    MAX_GATES,
+    NO_GATE,
+    SAT,
+    Gate,
+    State,
+    check_num_gates_possible,
+    get_sat_metric,
+)
+from .xmlio import (  # noqa: F401
+    StateLoadError,
+    load_state,
+    save_state,
+    state_filename,
+    state_fingerprint,
+    state_from_xml,
+    state_to_xml,
+)

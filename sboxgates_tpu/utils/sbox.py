@@ -1,0 +1,65 @@
+"""S-box input files: 2^n whitespace-separated hex values, 1 <= n <= 8.
+
+Reference: load_sbox (sboxgates.c:988-1040).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class SboxError(Exception):
+    pass
+
+
+def parse_sbox(text: str) -> Tuple[np.ndarray, int]:
+    """Parses an S-box table; returns (sbox[256] uint8, num_inputs).
+
+    Values beyond the table length are zero-filled, matching the reference's
+    fixed 256-entry array.  The number of entries must be a power of two and
+    every value must fit in a byte.
+    """
+    values = []
+    for token in text.split():
+        try:
+            v = int(token, 16)
+        except ValueError:
+            break
+        if v < 0 or v >= 256 or len(values) >= 256:
+            break
+        values.append(v)
+    n = len(values)
+    if n == 0 or (n & (n - 1)) != 0:
+        raise SboxError("Bad number of items in target S-box.")
+    num_inputs = n.bit_length() - 1
+    sbox = np.zeros(256, dtype=np.uint8)
+    sbox[:n] = values
+    return sbox, num_inputs
+
+
+def load_sbox(path: str, permute: int = 0) -> Tuple[np.ndarray, int]:
+    """Loads an S-box file, optionally XOR-permuting the input indices
+    (reference: sboxgates.c:1021-1031)."""
+    with open(path, "r", encoding="utf-8") as f:
+        sbox, num_inputs = parse_sbox(f.read())
+    if permute:
+        if permute >= (1 << num_inputs):
+            raise SboxError(f"Bad permutation value: {permute}")
+        sbox = sbox[np.arange(256) ^ (permute & 0xFF)]
+    return sbox, num_inputs
+
+
+def num_outputs(sbox: np.ndarray, num_inputs: int) -> int:
+    """Index of the highest non-constant... highest set output bit + 1.
+
+    Matches the reference's get_num_outputs (sboxgates.c:231-244): the
+    number of outputs is determined by the highest output bit whose target
+    truth table is not all-zero.
+    """
+    valid = sbox[: 1 << num_inputs]
+    for bit in range(7, -1, -1):
+        if ((valid >> bit) & 1).any():
+            return bit + 1
+    raise SboxError("S-box has no set output bits")

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths can
+be exercised without TPU hardware.  These env vars must be set before jax is
+imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)
+
+
+@pytest.fixture
+def aes_sbox():
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    sbox, n = load_sbox(os.path.join(os.path.dirname(__file__), "data", "rijndael.txt"))
+    assert n == 8
+    return sbox
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running search tests")

@@ -1,0 +1,119 @@
+"""Tests for the boolean-function algebra layer."""
+
+import numpy as np
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+
+
+def test_default_available_gates():
+    funs = bf.create_avail_gates(bf.DEFAULT_AVAILABLE)
+    assert [f.fun for f in funs] == [bf.AND, bf.XOR, bf.OR]
+    assert all(f.ab_commutative for f in funs)
+
+
+def test_commutativity_flags():
+    for fun in range(16):
+        f = bf.create_2_input_fun(fun)
+        expected = all(
+            bf.get_val(fun, a, b) == bf.get_val(fun, b, a)
+            for a in (0, 1)
+            for b in (0, 1)
+        )
+        assert f.ab_commutative == expected, f"fun={fun}"
+
+
+def test_get_not_functions():
+    funs = bf.create_avail_gates(bf.DEFAULT_AVAILABLE)  # AND, XOR, OR
+    nots = bf.get_not_functions(funs)
+    got = {f.fun for f in nots}
+    assert got == {bf.NAND, bf.XNOR, bf.NOR}
+    assert all(f.not_out for f in nots)
+
+
+def test_get_not_functions_skips_existing():
+    funs = [bf.create_2_input_fun(bf.AND), bf.create_2_input_fun(bf.NAND)]
+    assert bf.get_not_functions(funs) == []
+
+
+def _brute_force_fun3(avail, try_nots):
+    """Oracle: enumerate all fun2(fun1(±A, ±B), ±C) (± out) truth tables."""
+    found = set()
+    polarities = range(8) if try_nots else (0,)
+    for nots in polarities:
+        for f1 in avail:
+            for f2 in avail:
+                fun = 0
+                for k in range(8):
+                    a, b, c = (k >> 2) & 1, (k >> 1) & 1, k & 1
+                    if nots & 4:
+                        a ^= 1
+                    if nots & 2:
+                        b ^= 1
+                    if nots & 1:
+                        c ^= 1
+                    fun |= bf.get_val(f2, bf.get_val(f1, a, b), c) << k
+                found.add(fun)
+                if try_nots:
+                    found.add(~fun & 0xFF)
+    return found
+
+
+def test_fun3_list_matches_brute_force():
+    avail = [bf.AND, bf.XOR, bf.OR]
+    funs = bf.create_avail_gates(bf.DEFAULT_AVAILABLE)
+    for try_nots in (False, True):
+        got = bf.get_3_input_function_list(funs, try_nots)
+        expected = _brute_force_fun3(avail, try_nots)
+        assert {f.fun for f in got} == expected
+        # no duplicates
+        assert len({f.fun for f in got}) == len(got)
+
+
+def test_fun3_decompositions_are_valid():
+    """Each BoolFunc's recorded decomposition reproduces its truth table."""
+    funs = bf.create_avail_gates(bf.DEFAULT_AVAILABLE)
+    for f in bf.get_3_input_function_list(funs, True):
+        fun = 0
+        for k in range(8):
+            a, b, c = (k >> 2) & 1, (k >> 1) & 1, k & 1
+            a ^= f.not_a
+            b ^= f.not_b
+            c ^= f.not_c
+            v = bf.get_val(f.fun2, bf.get_val(f.fun1, a, b), c)
+            v ^= f.not_out
+            fun |= v << k
+        assert fun == f.fun
+
+
+def test_fun3_commutativity_flags():
+    funs = bf.create_avail_gates(bf.DEFAULT_AVAILABLE)
+    for f in bf.get_3_input_function_list(funs, True):
+        def val(a, b, c):
+            return bf.fun3_val(f.fun, a, b, c)
+
+        ab = all(val(a, b, c) == val(b, a, c) for a in (0, 1) for b in (0, 1) for c in (0, 1))
+        ac = all(val(a, b, c) == val(c, b, a) for a in (0, 1) for b in (0, 1) for c in (0, 1))
+        bc = all(val(a, b, c) == val(a, c, b) for a in (0, 1) for b in (0, 1) for c in (0, 1))
+        assert (f.ab_commutative, f.ac_commutative, f.bc_commutative) == (ab, ac, bc)
+
+
+def test_permute_fun3():
+    rng = np.random.default_rng(7)
+    tables = [tt.input_table(i) for i in range(3)]
+    for _ in range(20):
+        fun = int(rng.integers(0, 256))
+        perm = tuple(rng.permutation(3))
+        g = bf.permute_fun3(fun, perm)
+        # g(t0, t1, t2) must equal fun applied to permuted tables
+        got = tt.eval_lut(g, *tables)
+        expected = tt.eval_lut(fun, tables[perm[0]], tables[perm[1]], tables[perm[2]])
+        assert np.array_equal(got, expected), (fun, perm)
+
+
+def test_swap_fun2():
+    for fun in range(16):
+        g = bf.swap_fun2(fun)
+        for a in (0, 1):
+            for b in (0, 1):
+                assert bf.get_val(g, a, b) == bf.get_val(fun, b, a)

@@ -1,0 +1,187 @@
+"""Graph state and XML persistence tests."""
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph import (
+    GATES,
+    NO_GATE,
+    SAT,
+    State,
+    StateLoadError,
+    state_filename,
+    state_fingerprint,
+    state_from_xml,
+    state_to_xml,
+)
+
+
+def build_simple_state():
+    """in0 XOR in1, AND with in2; output 0 = the AND."""
+    st = State.init_inputs(3)
+    x = st.add_gate(bf.XOR, 0, 1, GATES)
+    a = st.add_gate(bf.AND, x, 2, GATES)
+    st.outputs[0] = a
+    return st
+
+
+def test_init_inputs():
+    st = State.init_inputs(6)
+    assert st.num_gates == 6
+    assert st.num_inputs == 6
+    for i in range(6):
+        assert np.array_equal(st.table(i), tt.input_table(i))
+
+
+def test_add_gate_tables():
+    st = build_simple_state()
+    assert np.array_equal(st.table(3), tt.input_table(0) ^ tt.input_table(1))
+    assert np.array_equal(st.table(4), st.table(3) & tt.input_table(2))
+    assert st.sat_metric == 12 + 7
+
+
+def test_add_gate_budget():
+    st = State.init_inputs(2)
+    st.max_gates = 2
+    # num_gates (2) > max_gates (2) is false -> allowed once
+    g = st.add_gate(bf.AND, 0, 1, GATES)
+    assert g == 2
+    g2 = st.add_gate(bf.OR, 0, 1, GATES)
+    assert g2 == NO_GATE
+
+
+def test_add_lut():
+    st = State.init_inputs(3)
+    g = st.add_lut(0xAC, 0, 1, 2)
+    expected = tt.eval_lut(0xAC, tt.input_table(0), tt.input_table(1), tt.input_table(2))
+    assert np.array_equal(st.table(g), expected)
+    assert st.gates[g].function == 0xAC
+
+
+def test_copy_independence():
+    st = build_simple_state()
+    st2 = st.copy()
+    st2.add_gate(bf.OR, 0, 1, GATES)
+    assert st.num_gates == 5
+    assert st2.num_gates == 6
+    st2.gates[0].type = bf.LUT
+    assert st.gates[0].type == bf.IN
+
+
+def test_verify_gate():
+    st = build_simple_state()
+    target = st.table(4).copy()
+    st.verify_gate(4, target, tt.mask_table(3))
+    with pytest.raises(AssertionError):
+        st.verify_gate(3, target, tt.mask_table(3))
+
+
+def test_xml_roundtrip():
+    st = build_simple_state()
+    text = state_to_xml(st)
+    st2 = state_from_xml(text)
+    assert st2.num_gates == st.num_gates
+    assert st2.outputs == st.outputs
+    for g1, g2 in zip(st.gates, st2.gates):
+        assert (g1.type, g1.in1, g1.in2, g1.in3, g1.function) == (
+            g2.type,
+            g2.in1,
+            g2.in2,
+            g2.in3,
+            g2.function,
+        )
+    assert np.array_equal(st.live_tables(), st2.live_tables())
+    assert st2.sat_metric == st.sat_metric
+
+
+def test_xml_roundtrip_lut():
+    st = State.init_inputs(3)
+    g = st.add_lut(0x96, 0, 1, 2)  # 3-input XOR
+    st.outputs[1] = g
+    st2 = state_from_xml(state_to_xml(st))
+    assert st2.gates[3].function == 0x96
+    assert np.array_equal(st2.table(3), st.table(3))
+    assert st2.sat_metric == 0  # zeroed when LUTs present
+
+
+def test_xml_exact_text():
+    st = build_simple_state()
+    expected = (
+        '<?xml version="1.0" encoding="UTF-8" ?>\n'
+        "<gates>\n"
+        '  <output bit="0" gate="4" />\n'
+        '  <gate type="IN" />\n'
+        '  <gate type="IN" />\n'
+        '  <gate type="IN" />\n'
+        '  <gate type="XOR">\n'
+        '    <input gate="0" />\n'
+        '    <input gate="1" />\n'
+        "  </gate>\n"
+        '  <gate type="AND">\n'
+        '    <input gate="3" />\n'
+        '    <input gate="2" />\n'
+        "  </gate>\n"
+        "</gates>\n"
+    )
+    assert state_to_xml(st) == expected
+
+
+def test_xml_validation_errors():
+    with pytest.raises(StateLoadError):
+        state_from_xml("<notgates></notgates>")
+    with pytest.raises(StateLoadError):
+        state_from_xml('<gates><gate type="BOGUS" /></gates>')
+    # forward reference
+    with pytest.raises(StateLoadError):
+        state_from_xml(
+            '<gates><gate type="NOT"><input gate="1" /></gate></gates>'
+        )
+    # wrong arity
+    with pytest.raises(StateLoadError):
+        state_from_xml(
+            '<gates><gate type="IN" /><gate type="AND">'
+            '<input gate="0" /></gate></gates>'
+        )
+    # function attr on non-LUT
+    with pytest.raises(StateLoadError):
+        state_from_xml(
+            '<gates><gate type="IN" /><gate type="NOT" function="1f">'
+            '<input gate="0" /></gate></gates>'
+        )
+    # more than 8 inputs
+    xml = "<gates>" + '<gate type="IN" />' * 9 + "</gates>"
+    with pytest.raises(StateLoadError):
+        state_from_xml(xml)
+    # non-contiguous IN gates
+    with pytest.raises(StateLoadError):
+        state_from_xml(
+            '<gates><gate type="IN" /><gate type="NOT"><input gate="0" /></gate>'
+            '<gate type="IN" /></gates>'
+        )
+    # duplicate output bit
+    with pytest.raises(StateLoadError):
+        state_from_xml(
+            '<gates><output bit="0" gate="0" /><output bit="0" gate="0" />'
+            '<gate type="IN" /></gates>'
+        )
+
+
+def test_fingerprint_stability_and_sensitivity():
+    st = build_simple_state()
+    fp1 = state_fingerprint(st)
+    assert fp1 == state_fingerprint(st)  # deterministic
+    st2 = build_simple_state()
+    assert state_fingerprint(st2) == fp1  # same structure, same fingerprint
+    st2.outputs[0] = 3
+    assert state_fingerprint(st2) != fp1
+
+
+def test_state_filename_format():
+    st = build_simple_state()
+    name = state_filename(st)
+    # 1 output, 2 gates beyond inputs, sat metric 19, output bit 0
+    assert name.startswith("1-002-0019-0-")
+    assert name.endswith(".xml")
+    assert len(name.split("-")) == 5

@@ -1,0 +1,137 @@
+"""Property tests for truth-table primitives against a brute-force oracle."""
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+
+
+def test_pack_roundtrip(rng):
+    bits = rng.integers(0, 2, size=(5, 256)).astype(bool)
+    assert np.array_equal(tt.to_bits(tt.from_bits(bits)), bits)
+
+
+def test_input_table_bits():
+    for var in range(8):
+        bits = tt.to_bits(tt.input_table(var))
+        expected = ((np.arange(256) >> var) & 1).astype(bool)
+        assert np.array_equal(bits, expected)
+
+
+def test_target_table_matches_sbox_eval(aes_sbox):
+    for bit in range(8):
+        bits = tt.to_bits(tt.target_table(aes_sbox, bit))
+        expected = ((aes_sbox.astype(np.uint32) >> bit) & 1).astype(bool)
+        assert np.array_equal(bits, expected)
+
+
+def test_mask_table():
+    for n in range(1, 9):
+        bits = tt.to_bits(tt.mask_table(n))
+        assert bits[: 1 << n].all()
+        assert not bits[1 << n :].any()
+
+
+def test_eq_mask(rng):
+    a = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    b = a.copy()
+    mask = tt.mask_table(6)
+    assert bool(tt.eq_mask(a, b, mask))
+    # flip a bit outside the mask: still equal under mask
+    b2 = b.copy()
+    b2[7] ^= np.uint32(1)
+    assert bool(tt.eq_mask(a, b2, mask))
+    # flip a bit inside the mask
+    b3 = b.copy()
+    b3[0] ^= np.uint32(1)
+    assert not bool(tt.eq_mask(a, b3, mask))
+
+
+def test_eq_mask_batched(rng):
+    batch = tt.from_bits(rng.integers(0, 2, size=(10, 256)).astype(bool))
+    target = batch[3]
+    mask = tt.mask_table(8)
+    eq = tt.eq_mask(batch, target, mask)
+    assert eq.shape == (10,)
+    assert eq[3]
+
+
+def test_eval_gate2_all_functions():
+    """Every 2-input function value matches its defining bit layout:
+    f(1,1)=bit0, f(1,0)=bit1, f(0,1)=bit2, f(0,0)=bit3."""
+    a = tt.input_table(0)
+    b = tt.input_table(1)
+    abits = tt.to_bits(a)
+    bbits = tt.to_bits(b)
+    for fun in range(16):
+        got = tt.to_bits(tt.eval_gate2(fun, a, b))
+        expected = np.array(
+            [bf.get_val(fun, int(x), int(y)) for x, y in zip(abits, bbits)],
+            dtype=bool,
+        )
+        assert np.array_equal(got, expected), f"fun={fun}"
+
+
+def test_eval_gate2_named_gates(rng):
+    a = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    b = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    assert np.array_equal(tt.eval_gate2(bf.AND, a, b), a & b)
+    assert np.array_equal(tt.eval_gate2(bf.OR, a, b), a | b)
+    assert np.array_equal(tt.eval_gate2(bf.XOR, a, b), a ^ b)
+    assert np.array_equal(tt.eval_gate2(bf.NAND, a, b), ~(a & b))
+    assert np.array_equal(tt.eval_gate2(bf.NOR, a, b), ~(a | b))
+    assert np.array_equal(tt.eval_gate2(bf.XNOR, a, b), ~(a ^ b))
+    assert np.array_equal(tt.eval_gate2(bf.A, a, b), a)
+    assert np.array_equal(tt.eval_gate2(bf.B, a, b), b)
+    assert np.array_equal(tt.eval_gate2(bf.FALSE_GATE, a, b), tt.zero())
+    assert np.array_equal(tt.eval_gate2(bf.TRUE_GATE, a, b), tt.ones())
+    assert np.array_equal(tt.eval_gate2(bf.A_AND_NOT_B, a, b), a & ~b)
+
+
+def test_eval_gate2_vectorized_funs(rng):
+    """fun may be an array: one output table per function."""
+    a = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    b = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    funs = np.arange(16, dtype=np.uint32)[:, None]  # [16, 1] broadcasts over words
+    batch = tt.eval_gate2(funs, a, b)
+    assert batch.shape == (16, 8)
+    for f in range(16):
+        assert np.array_equal(batch[f], tt.eval_gate2(f, a, b))
+
+
+def test_eval_lut_oracle(rng):
+    a = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    b = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    c = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    abits, bbits, cbits = tt.to_bits(a), tt.to_bits(b), tt.to_bits(c)
+    for func in rng.integers(0, 256, size=32):
+        func = int(func)
+        got = tt.to_bits(tt.eval_lut(func, a, b, c))
+        idx = (abits.astype(int) << 2) | (bbits.astype(int) << 1) | cbits.astype(int)
+        expected = ((func >> idx) & 1).astype(bool)
+        assert np.array_equal(got, expected)
+
+
+def test_eval_lut_mux():
+    """LUT function 0xac is the multiplexer sel ? c : b used by the
+    reference's LUT mux construction (sboxgates.c:506-508)."""
+    sel = tt.input_table(0)
+    b = tt.input_table(1)
+    c = tt.input_table(2)
+    got = tt.eval_lut(0xAC, sel, b, c)
+    expected = (sel & c) | (~sel & b)
+    assert np.array_equal(got, expected)
+
+
+def test_jnp_compat():
+    """The same functions run on jax arrays inside jit."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.asarray(tt.input_table(0))
+    b = jnp.asarray(tt.input_table(1))
+    out = jax.jit(lambda x, y: tt.eval_gate2(bf.XOR, x, y))(a, b)
+    assert np.array_equal(np.asarray(out), tt.input_table(0) ^ tt.input_table(1))
+    eq = jax.jit(lambda x, y: tt.eq_mask(x, y, jnp.asarray(tt.mask_table(8))))(a, a)
+    assert bool(eq)
