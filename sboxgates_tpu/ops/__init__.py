@@ -1,0 +1,1 @@
+from . import combinatorics, sweeps  # noqa: F401

@@ -1,0 +1,128 @@
+"""Combination-space enumeration.
+
+The LUT searches sweep C(G, k) combinations of gates.  The reference walks
+this space with a per-rank contiguous range via combinatorial unranking
+(lut.c:635-662) and a successor function (lut.c:743-758).  Here the space is
+streamed as fixed-size numpy chunks which the driver ships to the device
+(sharded over the mesh axis); a single sequential stream replaces per-rank
+ranges because chunks themselves are split across devices.
+"""
+
+from __future__ import annotations
+
+import itertools
+from math import comb
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def n_choose_k(n: int, k: int) -> int:
+    if n < 0 or k < 0 or k > n:
+        return 0
+    return comb(n, k)
+
+
+def unrank_combination(rank: int, n: int, k: int) -> np.ndarray:
+    """The rank'th k-combination of {0..n-1} in lexicographic order.
+
+    Same ordering as the reference's get_nth_combination (lut.c:635-662).
+    """
+    assert 0 <= rank < n_choose_k(n, k)
+    out = np.empty(k, dtype=np.int32)
+    e = 0
+    for pos in range(k):
+        while True:
+            cnt = n_choose_k(n - e - 1, k - pos - 1)
+            if rank < cnt:
+                break
+            rank -= cnt
+            e += 1
+        out[pos] = e
+        e += 1
+    return out
+
+
+def combination_rank(combo: Sequence[int], n: int) -> int:
+    """Inverse of unrank_combination."""
+    k = len(combo)
+    rank = 0
+    prev = -1
+    for pos, e in enumerate(combo):
+        for x in range(prev + 1, e):
+            rank += n_choose_k(n - x - 1, k - pos - 1)
+        prev = e
+    return rank
+
+
+class CombinationStream:
+    """Streams C(n, k) combinations as [chunk, k] int32 arrays.
+
+    ``start``/``stop`` allow walking a sub-range mid-space (used when a
+    search is split across hosts; the reference's per-rank ranges,
+    lut.c:138-149).  Rejection of combinations containing already-used mux
+    bits is done per chunk by :func:`filter_exclude`, keeping device-visible
+    chunk sizes static.
+    """
+
+    def __init__(self, n: int, k: int, start: int = 0, stop: Optional[int] = None):
+        self.n = n
+        self.k = k
+        self.total = n_choose_k(n, k)
+        self.stop = self.total if stop is None else min(stop, self.total)
+        self.pos = min(start, self.stop)
+        if self.pos >= self.total:
+            self._it: Iterator = iter(())  # empty tail range
+        elif self.pos == 0:
+            self._it = itertools.combinations(range(n), k)
+        else:
+            self._it = self._resume_iter(unrank_combination(self.pos, n, k))
+
+    def _resume_iter(self, first: np.ndarray):
+        combo = list(int(x) for x in first)
+        n, k = self.n, self.k
+        while True:
+            yield tuple(combo)
+            # successor in lexicographic order (reference: next_combination,
+            # lut.c:743-758)
+            i = k - 1
+            while i >= 0 and combo[i] + k - i >= n:
+                i -= 1
+            if i < 0:
+                return
+            combo[i] += 1
+            for j in range(i + 1, k):
+                combo[j] = combo[j - 1] + 1
+
+    @property
+    def remaining(self) -> int:
+        return self.stop - self.pos
+
+    def next_chunk(self, chunk: int) -> Optional[np.ndarray]:
+        """Up to ``chunk`` combinations, or None when exhausted."""
+        take = min(chunk, self.remaining)
+        if take <= 0:
+            return None
+        rows = list(itertools.islice(self._it, take))
+        self.pos += len(rows)
+        if not rows:
+            return None
+        return np.asarray(rows, dtype=np.int32)
+
+
+def filter_exclude(combos: np.ndarray, exclude: Sequence[int]) -> np.ndarray:
+    """Drops rows containing any excluded element."""
+    if len(exclude) == 0 or combos.size == 0:
+        return combos
+    bad = np.isin(combos, np.asarray(list(exclude), dtype=np.int32)).any(axis=1)
+    return combos[~bad]
+
+
+def pad_rows(a: np.ndarray, size: int, fill: int = 0) -> tuple:
+    """Pads axis 0 to ``size``; returns (padded, valid_count)."""
+    valid = a.shape[0]
+    assert valid <= size
+    if valid == size:
+        return a, valid
+    pad = np.full((size - valid,) + a.shape[1:], fill, dtype=a.dtype)
+    return np.concatenate([a, pad], axis=0), valid

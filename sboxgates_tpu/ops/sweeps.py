@@ -1,0 +1,409 @@
+"""Batched candidate sweeps — the device compute core of the search.
+
+The reference's hot loops scan candidate gate tuples and try Boolean
+functions over them, evaluating a 256-bit truth table per (tuple, function)
+pair (sboxgates.c:323-435, lut.c:116-487).  The TPU-native formulation used
+here is different and strictly cheaper:
+
+**Karnaugh-cell constraints.**  For a candidate tuple of k gate tables, group
+the 256 truth-table positions into 2^k *cells* by the tuple's bit pattern.
+A function of the tuple realizes the target under the mask iff no cell mixes
+required-0 and required-1 positions, and its (2^k)-bit function table is then
+fully determined on constrained cells (free on don't-cares).  So each tuple
+reduces to two bit-vectors ``req1``/``req0`` over cells, computed with a
+handful of fused elementwise ops — and *function matching collapses to
+integer compares against precomputed byte tables*, with no per-function
+truth-table evaluation at all.  This subsumes the reference's
+``check_n_lut_possible`` (lut.c:34-66) and ``get_lut_function``
+(lut.c:79-109) in one pass.
+
+For the 5-LUT and 7-LUT decomposition searches the entire inner loop runs in
+the packed cell domain: a 5-input tuple's constraints are two uint32s, a
+7-input tuple's two uint32[4]s, and testing an (outer, middle) function pair
+is ~a dozen 32-bit logic ops instead of 256-bit vector algebra.
+
+Everything is shaped [chunk, ...] with static sizes; invalid rows are
+masked.  Randomized tie-breaking among matches uses a hashed priority seeded
+per call, replacing the reference's Fisher-Yates shuffles of the scan order
+(sboxgates.c:285-299, lut.c:126-135) with equivalent search diversification.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import ttable as tt
+
+# -------------------------------------------------------------------------
+# Cell-constraint computation
+# -------------------------------------------------------------------------
+
+
+def _cell_constraints(tabs, target, mask):
+    """Per-tuple cell constraints.
+
+    tabs: [N, k, W] uint32 gate tables; target/mask: [W] uint32.
+    Returns (req1, req0): [N, 2^k] bool — cells that must map to 1 / to 0.
+    Cell index bit (k-1-i) is input i's value, so input 0 is the MSB,
+    matching the LUT function bit convention f at k = A<<2|B<<1|C.
+    """
+    k = tabs.shape[-2]
+    need1 = mask & target
+    need0 = mask & ~target
+    full = jnp.full(tabs.shape[-1:], 0xFFFFFFFF, dtype=jnp.uint32)
+    cells = jnp.broadcast_to(full, tabs.shape[:-2] + (1, tabs.shape[-1]))
+    for i in range(k - 1, -1, -1):  # reverse so input 0 lands on the MSB
+        t = tabs[..., i, None, :]
+        cells = jnp.concatenate([cells & ~t, cells & t], axis=-2)
+    req1 = ((cells & need1) != 0).any(axis=-1)
+    req0 = ((cells & need0) != 0).any(axis=-1)
+    return req1, req0
+
+
+def _pack_bits(bits):
+    """[..., C] bool -> packed integer(s): uint32 for C<=32, [..., C/32] else."""
+    c = bits.shape[-1]
+    if c <= 32:
+        w = (bits.astype(jnp.uint32) << jnp.arange(c, dtype=jnp.uint32)).sum(
+            axis=-1, dtype=jnp.uint32
+        )
+        return w
+    assert c % 32 == 0
+    r = bits.reshape(bits.shape[:-1] + (c // 32, 32))
+    return (r.astype(jnp.uint32) << jnp.arange(32, dtype=jnp.uint32)).sum(
+        axis=-1, dtype=jnp.uint32
+    )
+
+
+def _priority(n, seed):
+    """Hashed per-row random priority (never zero) for match tie-breaking."""
+    x = jnp.arange(n, dtype=jnp.uint32) + jnp.asarray(seed).astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x | jnp.uint32(1)
+
+
+# -------------------------------------------------------------------------
+# Match tables: (req1, constrained) -> first matching available function
+# -------------------------------------------------------------------------
+
+
+def build_match_table(funs_cellorder: Sequence[int], num_cells: int) -> np.ndarray:
+    """Lookup table over (R, C) constraint keys -> matching function slot.
+
+    ``funs_cellorder[s]`` is the s'th available function's table with bit j =
+    value at cell j.  Key = R | C << num_cells.  Entry = smallest slot s with
+    ``(funs[s] ^ R) & C == 0``, or -1.  Collapses the reference's inner
+    function loops (sboxgates.c:337-349, 406-432) into one device gather.
+    """
+    assert num_cells in (4, 8)
+    size = 1 << num_cells
+    funs = np.asarray(list(funs_cellorder), dtype=np.int64)
+    table = np.full(size * size, -1, dtype=np.int16)
+    r = np.arange(size, dtype=np.int64)
+    for cbits in range(size):
+        valid = (r & ~cbits) == 0
+        keys = r[valid] + (cbits << num_cells)
+        best = np.full(keys.shape, -1, dtype=np.int16)
+        for s in range(len(funs) - 1, -1, -1):
+            hit = ((funs[s] ^ r[valid]) & cbits) == 0
+            best[hit] = s
+        table[keys] = best
+    return table
+
+
+# -------------------------------------------------------------------------
+# Jitted sweep kernels
+# -------------------------------------------------------------------------
+
+
+class SweepResult(NamedTuple):
+    found: jax.Array        # bool scalar
+    index: jax.Array        # int32: row into the combos chunk
+    slot: jax.Array         # int32: matching function slot (or packed R|C<<cells)
+    num_feasible: jax.Array # int32: candidates passing the feasibility filter
+
+
+@functools.partial(jax.jit, static_argnames=("num_cells",))
+def tuple_match_sweep(
+    tables, combos, valid, target, mask, match_table, seed, *, num_cells
+):
+    """Generic k-tuple sweep against an available-function match table.
+
+    tables: [G, W] uint32; combos: [N, k] int32; valid: [N] bool;
+    match_table: [4^num_cells] int16.  Returns SweepResult where ``slot`` is
+    the matching function slot for the selected row.
+    """
+    tabs = tables[combos]
+    req1, req0 = _cell_constraints(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=-1)
+    r = _pack_bits(req1).astype(jnp.int32)
+    c = _pack_bits(req1 | req0).astype(jnp.int32)
+    key = r + (c << num_cells)
+    slot = match_table[key].astype(jnp.int32)
+    ok = feasible & (slot >= 0)
+    prio = jnp.where(ok, _priority(ok.shape[0], seed), 0)
+    best = jnp.argmax(prio).astype(jnp.int32)
+    return SweepResult(ok.any(), best, slot[best], feasible.sum(dtype=jnp.int32))
+
+
+@jax.jit
+def match_scan(tables, valid, target, mask, seed):
+    """Steps 1-2 of the algorithm: existing gate or its complement matching
+    the target (sboxgates.c:301-321).  Returns (found, index, inverted) for
+    a randomly-chosen match, preferring direct matches."""
+    eq = tt.eq_mask(tables, target, mask) & valid
+    neq = tt.eq_mask(~tables, target, mask) & valid
+    prio = _priority(valid.shape[0], seed)
+    direct = jnp.where(eq, prio, 0)
+    inverted = jnp.where(neq, prio, 0)
+    use_inv = ~eq.any()
+    score = jnp.where(use_inv, inverted, direct)
+    best = jnp.argmax(score).astype(jnp.int32)
+    return (eq.any() | neq.any()), best, use_inv
+
+
+@jax.jit
+def lut3_sweep(tables, combos, valid, target, mask, seed):
+    """3-LUT search sweep (reference: lut_search phase 1, lut.c:501-523).
+
+    Any feasible triple admits a LUT function; returns the packed
+    (req1, constrained) byte pair for the selected row so the host can fill
+    don't-cares randomly (lut.c:102-108)."""
+    tabs = tables[combos]
+    req1, req0 = _cell_constraints(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=-1)
+    prio = jnp.where(feasible, _priority(feasible.shape[0], seed), 0)
+    best = jnp.argmax(prio).astype(jnp.int32)
+    packed = (_pack_bits(req1) | (_pack_bits(req1 | req0) << 8)).astype(jnp.int32)
+    return SweepResult(
+        feasible.any(), best, packed[best], feasible.sum(dtype=jnp.int32)
+    )
+
+
+@jax.jit
+def lut_filter(tables, combos, valid, target, mask):
+    """5/7-LUT stage A: feasibility + packed cell constraints per tuple
+    (reference: the check_n_lut_possible prefilter, lut.c:187, 307).  The
+    tuple arity comes from the combos shape; jit specializes per shape."""
+    tabs = tables[combos]
+    req1, req0 = _cell_constraints(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=-1)
+    return feasible, _pack_bits(req1), _pack_bits(req0)
+
+
+@jax.jit
+def lut5_solve(req1p, req0p, w_tab, m_tab, seed):
+    """5-LUT stage B: find (split, outer function) decompositions.
+
+    req1p/req0p: [T] uint32 packed cell constraints.
+    w_tab: [10, 256] uint32 — cells where outer func g outputs 1, per split.
+    m_tab: [10, 4] uint32 — cells by inner-input bit pattern, per split.
+
+    A decomposition LUT(LUT(a,b,c), d, e) exists iff no inner-function cell
+    (outer output o, inner pattern m) mixes req1 and req0 cells.  Replaces
+    the reference's 10 x 256 ttable evaluations + bit-serial solves per
+    combination (lut.c:189-230) with uint32 logic.
+    """
+    r1 = req1p[:, None, None]
+    r0 = req0p[:, None, None]
+    w = w_tab[None, :, :]
+    conflict = jnp.zeros(r1.shape[:1] + w_tab.shape, dtype=bool)
+    for m in range(4):
+        mm = m_tab[None, :, m, None]
+        for o in (0, 1):
+            cells = (w if o else ~w) & mm
+            conflict = conflict | (((r1 & cells) != 0) & ((r0 & cells) != 0))
+    ok = ~conflict  # [T, 10, 256]
+    any_t = ok.any(axis=(1, 2))
+    prio = jnp.where(any_t, _priority(any_t.shape[0], seed), 0)
+    best_t = jnp.argmax(prio).astype(jnp.int32)
+    # Randomize which (split, outer-function) decomposition is taken — the
+    # counterpart of the reference's per-call func_order shuffle
+    # (lut.c:126-135), so repeated iterations explore different circuits.
+    flat_ok = ok[best_t].reshape(-1)
+    flat_prio = jnp.where(flat_ok, _priority(flat_ok.shape[0], seed ^ 0x5BD1), 0)
+    sel = jnp.argmax(flat_prio).astype(jnp.int32)
+    return any_t.any(), best_t, sel
+
+
+@jax.jit
+def lut7_solve(req1p, req0p, wo_tab, wm_tab, g_tab, seed):
+    """7-LUT stage B: find (ordering, outer, middle) function triples.
+
+    req1p/req0p: [T, 4] uint32 (128 cells packed).
+    wo_tab/wm_tab: [S, 256, 4] uint32 — cells where the outer / middle
+    function outputs 1, per ordering.  g_tab: [S, 4] — cells where the
+    seventh input is 1.  Scans orderings to bound memory; each step tests
+    all 256 x 256 function pairs for every tuple at once (reference inner
+    loops: lut.c:416-475).
+    """
+    num_t = req1p.shape[0]
+
+    def step(carry, sigma):
+        found, sel_sigma, sel_flat = carry
+        wo = wo_tab[sigma]        # [256, 4]
+        wm = wm_tab[sigma]        # [256, 4]
+        gm = g_tab[sigma]         # [4]
+        r1 = req1p[:, None, None, :]  # [T, 1, 1, 4]
+        r0 = req0p[:, None, None, :]
+        conflict = jnp.zeros((num_t, 256, 256), dtype=bool)
+        for xg in (0, 1):
+            gmask = gm if xg else ~gm
+            for o in (0, 1):
+                a1 = r1 & (wo if o else ~wo)[None, :, None, :] & gmask
+                a0 = r0 & (wo if o else ~wo)[None, :, None, :] & gmask
+                for mi in (0, 1):
+                    wmm = (wm if mi else ~wm)[None, None, :, :]
+                    conflict = conflict | (
+                        ((a1 & wmm) != 0).any(-1) & ((a0 & wmm) != 0).any(-1)
+                    )
+        ok = ~conflict  # [T, 256, 256]
+        any_t = ok.any(axis=(1, 2))
+        newly = any_t & ~found
+        # Random choice among matching (outer, middle) function pairs —
+        # counterpart of the reference's shuffled func orders (lut.c:362-378).
+        fprio = _priority(256 * 256, seed ^ (sigma * 2 + 1))[None, :]
+        flat = jnp.argmax(
+            jnp.where(ok.reshape(num_t, -1), fprio, 0), axis=-1
+        ).astype(jnp.int32)
+        sel_sigma = jnp.where(newly, sigma, sel_sigma)
+        sel_flat = jnp.where(newly, flat, sel_flat)
+        return (found | any_t, sel_sigma, sel_flat), None
+
+    init = (
+        jnp.zeros(num_t, dtype=bool),
+        jnp.full(num_t, -1, dtype=jnp.int32),
+        jnp.zeros(num_t, dtype=jnp.int32),
+    )
+    (found, sel_sigma, sel_flat), _ = jax.lax.scan(
+        step, init, jnp.arange(wo_tab.shape[0], dtype=jnp.int32)
+    )
+    prio = jnp.where(found, _priority(num_t, seed), 0)
+    best_t = jnp.argmax(prio).astype(jnp.int32)
+    return found.any(), best_t, sel_sigma[best_t], sel_flat[best_t]
+
+
+# -------------------------------------------------------------------------
+# Host-side split tables for the 5/7-LUT solvers
+# -------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def lut5_split_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(splits[10, 5], w_tab[10, 256], m_tab[10, 4]).
+
+    splits[s] = (a, b, c, d, e): positions of the outer LUT inputs (a,b,c)
+    and inner LUT extra inputs (d,e) within the 5-tuple — the reference's 10
+    order[] configurations (lut.c:189-230).  Cell j of a 5-tuple has input i
+    value (j >> (4-i)) & 1.
+    """
+    import itertools
+
+    cells = np.arange(32, dtype=np.uint64)
+    x = [(cells >> np.uint64(4 - i)) & np.uint64(1) for i in range(5)]
+    splits, w_rows, m_rows = [], [], []
+    for outer in itertools.combinations(range(5), 3):
+        inner = [i for i in range(5) if i not in outer]
+        a, b, c = outer
+        d, e = inner
+        splits.append((a, b, c, d, e))
+        idx_outer = x[a] * np.uint64(4) + x[b] * np.uint64(2) + x[c]  # [32] in 0..7
+        g = np.arange(256, dtype=np.uint64)
+        bits = (g[:, None] >> idx_outer[None, :]) & np.uint64(1)      # [256, 32]
+        w_rows.append(
+            ((bits << cells[None, :]).sum(axis=1) & 0xFFFFFFFF).astype(np.uint32)
+        )
+        idx_inner = x[d] * np.uint64(2) + x[e]                        # [32] in 0..3
+        m_rows.append(
+            np.array(
+                [
+                    int((np.uint64(1) << cells[idx_inner == m]).sum()) & 0xFFFFFFFF
+                    for m in range(4)
+                ],
+                dtype=np.uint32,
+            )
+        )
+    return (
+        np.asarray(splits, dtype=np.int32),
+        np.stack(w_rows).astype(np.uint32),
+        np.stack(m_rows).astype(np.uint32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def lut7_split_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(orders[70, 7], wo_tab[70, 256, 4], wm_tab[70, 256, 4], g_tab[70, 4]).
+
+    orders[s] = (a,b,c, d,e,f, g): outer triple, middle triple, free input —
+    the 70 distinct ways to split 7 inputs into 3+3+1 with outer/middle
+    interchangeable (the reference's static order[] table, lut.c:396-415).
+    """
+    import itertools
+
+    cells = np.arange(128, dtype=np.uint64)
+    x = [(cells >> np.uint64(6 - i)) & np.uint64(1) for i in range(7)]
+
+    def pack128(bits):  # [..., 128] 0/1 -> [..., 4] uint32
+        b = bits.reshape(bits.shape[:-1] + (4, 32)).astype(np.uint64)
+        return (b << np.arange(32, dtype=np.uint64)).sum(axis=-1).astype(np.uint32)
+
+    orders, wo_rows, wm_rows, g_rows = [], [], [], []
+    for outer in itertools.combinations(range(7), 3):
+        rest = [i for i in range(7) if i not in outer]
+        for middle in itertools.combinations(rest, 3):
+            if outer[0] > middle[0]:
+                continue  # outer/middle are interchangeable; keep one
+            free = [i for i in rest if i not in middle][0]
+            orders.append(tuple(outer) + tuple(middle) + (free,))
+            g = np.arange(256, dtype=np.uint64)
+            u = np.uint64
+            idx_o = x[outer[0]] * u(4) + x[outer[1]] * u(2) + x[outer[2]]
+            idx_m = x[middle[0]] * u(4) + x[middle[1]] * u(2) + x[middle[2]]
+            wo_rows.append(pack128((g[:, None] >> idx_o[None, :]) & u(1)))
+            wm_rows.append(pack128((g[:, None] >> idx_m[None, :]) & u(1)))
+            g_rows.append(pack128((x[free] & 1)[None, :])[0])
+    return (
+        np.asarray(orders, dtype=np.int32),
+        np.stack(wo_rows),
+        np.stack(wm_rows),
+        np.stack(g_rows),
+    )
+
+
+def solve_inner_function(
+    req1_cells: np.ndarray,
+    req0_cells: np.ndarray,
+    groups: np.ndarray,
+    rng: Optional[np.random.Generator],
+) -> Optional[int]:
+    """Host-side: derive the n-input function for grouped cells.
+
+    groups[j] = which function cell each constraint cell belongs to.  Returns
+    the function with don't-cares randomized (None on conflict) — the host
+    mirror of get_lut_function (lut.c:79-109) used to reconstruct functions
+    for a device-selected decomposition.
+    """
+    num_f = int(groups.max()) + 1 if groups.size else 0
+    func = 0
+    setmask = 0
+    for j in range(num_f):
+        sel = groups == j
+        has1 = bool(req1_cells[sel].any())
+        has0 = bool(req0_cells[sel].any())
+        if has1 and has0:
+            return None
+        if has1:
+            func |= 1 << j
+        if has1 or has0:
+            setmask |= 1 << j
+    if rng is not None:
+        free = ~setmask & ((1 << num_f) - 1)
+        func |= int(rng.integers(0, 1 << num_f)) & free
+    return func

@@ -1,0 +1,9 @@
+from .context import Options, SearchContext  # noqa: F401
+from .kwan import create_circuit  # noqa: F401
+from .lut import lut_search  # noqa: F401
+from .orchestrator import (  # noqa: F401
+    generate_graph,
+    generate_graph_one_output,
+    make_targets,
+    sbox_num_outputs,
+)

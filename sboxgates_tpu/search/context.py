@@ -1,0 +1,261 @@
+"""Search context: options, derived function tables, and device-sweep drivers.
+
+This is the host side of the engine: it owns the available-function lists
+(reference: the ``options`` struct, sboxgates.h:49-66), the precomputed
+constraint-match tables, the seeded PRNG, and chunked drivers that stream
+candidate spaces through the jitted kernels in :mod:`sboxgates_tpu.ops.sweeps`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import boolfunc as bf
+from ..graph.state import GATES, State
+from ..ops import combinatorics as comb
+from ..ops import sweeps
+
+# Gate-count buckets: live tables are zero-padded up to the next bucket so
+# jitted sweeps see a small, fixed set of shapes.
+BUCKETS = (16, 32, 64, 96, 128, 192, 256, 384, 512)
+
+TRIPLE_CHUNK = 1 << 17
+LUT5_CHUNK = 1 << 17
+LUT5_SOLVE_CHUNK = 4096
+LUT7_CHUNK = 1 << 17
+LUT7_CAP = 100_000       # reference: 100k-hit buffer, lut.c:291,316
+LUT7_SOLVE_CHUNK = 16
+
+
+@dataclass
+class Options:
+    """User configuration (reference: options struct + defaults,
+    sboxgates.c:1060-1078)."""
+
+    iterations: int = 1
+    oneoutput: int = -1
+    permute: int = 0
+    metric: int = GATES
+    lut_graph: bool = False
+    randomize: bool = True
+    try_nots: bool = False
+    avail_gates_bitfield: int = bf.DEFAULT_AVAILABLE
+    verbosity: int = 0
+    seed: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class MatchEntry:
+    """One effective function byte in a match table: the function to
+    materialize and the operand order to apply it with."""
+
+    fun: bf.BoolFunc
+    perm: Tuple[int, ...]  # operand order: input slot i takes gate perm[i]
+
+
+def _pair_cell_fun(fun_nibble: int, swapped: bool) -> int:
+    """Nibble re-encoded to cell order: bit (a<<1 | b) = f(a, b)."""
+    v = 0
+    for a in (0, 1):
+        for b in (0, 1):
+            x, y = (b, a) if swapped else (a, b)
+            v |= bf.get_val(fun_nibble, x, y) << ((a << 1) | b)
+    return v
+
+
+def _build_pair_table(funs: Sequence[bf.BoolFunc]):
+    """Match table + entries for a 2-input sweep, including swapped operand
+    orders for non-commutative functions (sboxgates.c:342-347)."""
+    entries: List[MatchEntry] = []
+    bytes_: List[int] = []
+    seen = {}
+    ranked = sorted(range(len(funs)), key=lambda i: funs[i].extra_gates)
+    for i in ranked:
+        f = funs[i]
+        orders = [(0, 1)] if f.ab_commutative else [(0, 1), (1, 0)]
+        for perm in orders:
+            eff = _pair_cell_fun(f.fun, perm == (1, 0))
+            if eff not in seen:
+                seen[eff] = True
+                entries.append(MatchEntry(f, perm))
+                bytes_.append(eff)
+    table = sweeps.build_match_table(bytes_, num_cells=4)
+    return jnp.asarray(table), entries
+
+
+def _build_triple_table(funs: Sequence[bf.BoolFunc]):
+    """Match table + entries for the 3-input sweep.  Non-commutative operand
+    orders become distinct effective function bytes (replacing the
+    permutation re-evaluations at sboxgates.c:406-432; the reference's
+    avail_3[m] indexing quirk is corrected by using each function's own
+    commutativity flags)."""
+    entries: List[MatchEntry] = []
+    bytes_: List[int] = []
+    seen = {}
+    ranked = sorted(range(len(funs)), key=lambda i: funs[i].extra_gates)
+    for i in ranked:
+        f = funs[i]
+        orders = [(0, 1, 2)]
+        if not f.ab_commutative:
+            orders.append((1, 0, 2))
+        if not f.ac_commutative:
+            orders.append((2, 1, 0))
+        if not f.bc_commutative:
+            orders.append((0, 2, 1))
+        for perm in orders:
+            eff = bf.permute_fun3(f.fun, perm)
+            if eff not in seen:
+                seen[eff] = True
+                entries.append(MatchEntry(f, perm))
+                bytes_.append(eff)
+    table = sweeps.build_match_table(bytes_, num_cells=8)
+    return jnp.asarray(table), entries
+
+
+def bucket_size(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"too many gates: {n}")
+
+
+CHUNK_SIZES = (1024, 8192, 32768, 1 << 17)
+
+
+def pick_chunk(n: int, cap: int) -> int:
+    """Smallest static chunk size covering n, capped — keeps the jit cache
+    small while avoiding huge padded sweeps for tiny candidate spaces."""
+    for c in CHUNK_SIZES:
+        if c >= cap:
+            return cap
+        if n <= c:
+            return c
+    return cap
+
+
+class SearchContext:
+    """Derived state shared by every create_circuit call of one run."""
+
+    def __init__(self, opt: Options):
+        self.opt = opt
+        self.rng = np.random.default_rng(opt.seed)
+        self.avail_gates = bf.create_avail_gates(opt.avail_gates_bitfield)
+        self.avail_not = (
+            bf.get_not_functions(self.avail_gates) if opt.try_nots else []
+        )
+        self.avail_3 = bf.get_3_input_function_list(self.avail_gates, opt.try_nots)
+        self.pair_table, self.pair_entries = _build_pair_table(self.avail_gates)
+        if self.avail_not:
+            self.not_table, self.not_entries = _build_pair_table(self.avail_not)
+        else:
+            self.not_table, self.not_entries = None, []
+        self.triple_table, self.triple_entries = _build_triple_table(self.avail_3)
+        self._pair_combo_cache = {}
+        # Sweep statistics (candidates examined), for benchmarking.
+        self.stats = {
+            "pair_candidates": 0,
+            "triple_candidates": 0,
+            "lut3_candidates": 0,
+            "lut5_candidates": 0,
+            "lut5_solved": 0,
+            "lut7_candidates": 0,
+            "lut7_solved": 0,
+        }
+
+    # -- helpers ----------------------------------------------------------
+
+    def next_seed(self) -> int:
+        if self.opt.randomize:
+            return int(self.rng.integers(0, 2**31))
+        return 12345
+
+    def device_tables(self, st: State):
+        """Zero-padded [bucket, 8] live tables."""
+        g = st.num_gates
+        b = bucket_size(g)
+        padded = np.zeros((b, 8), dtype=np.uint32)
+        padded[:g] = st.live_tables()
+        return jnp.asarray(padded), g
+
+    def _pair_combos(self, bucket: int):
+        if bucket not in self._pair_combo_cache:
+            i, j = np.triu_indices(bucket, k=1)
+            combos = np.stack([i, j], axis=1).astype(np.int32)
+            self._pair_combo_cache[bucket] = jnp.asarray(combos)
+        return self._pair_combo_cache[bucket]
+
+    # -- sweep drivers ----------------------------------------------------
+
+    def scan_matches(self, st: State, target, mask):
+        """Steps 1-2: existing gate / complement match.  Returns
+        (found, gid, inverted)."""
+        tables, g = self.device_tables(st)
+        valid = jnp.arange(tables.shape[0]) < g
+        found, idx, inv = sweeps.match_scan(
+            tables, valid, jnp.asarray(target), jnp.asarray(mask), self.next_seed()
+        )
+        return bool(found), int(idx), bool(inv)
+
+    def pair_search(self, st: State, target, mask, use_not_table: bool):
+        """Step 3 / step 4a: one function over all gate pairs.  Returns
+        (found, gid1, gid2, entry)."""
+        table = self.not_table if use_not_table else self.pair_table
+        entries = self.not_entries if use_not_table else self.pair_entries
+        if table is None:
+            return False, 0, 0, None
+        tables, g = self.device_tables(st)
+        combos = self._pair_combos(tables.shape[0])
+        valid = (combos < g).all(axis=1)
+        self.stats["pair_candidates"] += g * (g - 1) // 2
+        res = sweeps.tuple_match_sweep(
+            tables,
+            combos,
+            valid,
+            jnp.asarray(target),
+            jnp.asarray(mask),
+            table,
+            self.next_seed(),
+            num_cells=4,
+        )
+        if not bool(res.found):
+            return False, 0, 0, None
+        pair = np.asarray(combos[int(res.index)])
+        entry = entries[int(res.slot)]
+        gids = [int(pair[p]) for p in entry.perm]
+        return True, gids[0], gids[1], entry
+
+    def triple_search(self, st: State, target, mask):
+        """Step 4b: three-gate combinations x available 3-input functions.
+        Chunked stream with early exit.  Returns (found, gids, entry)."""
+        g = st.num_gates
+        tables, _ = self.device_tables(st)
+        target = jnp.asarray(target)
+        mask = jnp.asarray(mask)
+        stream = comb.CombinationStream(g, 3)
+        csize = pick_chunk(stream.total, TRIPLE_CHUNK)
+        while True:
+            chunk = stream.next_chunk(csize)
+            if chunk is None:
+                return False, None, None
+            padded, nvalid = comb.pad_rows(chunk, csize)
+            self.stats["triple_candidates"] += nvalid
+            valid = jnp.arange(csize) < nvalid
+            res = sweeps.tuple_match_sweep(
+                tables,
+                jnp.asarray(padded),
+                valid,
+                target,
+                mask,
+                self.triple_table,
+                self.next_seed(),
+                num_cells=8,
+            )
+            if bool(res.found):
+                row = padded[int(res.index)]
+                entry = self.triple_entries[int(res.slot)]
+                gids = [int(row[p]) for p in entry.perm]
+                return True, gids, entry

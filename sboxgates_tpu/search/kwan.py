@@ -1,0 +1,220 @@
+"""Kwan's recursive circuit construction.
+
+Host-side mirror of the reference's ``create_circuit``
+(sboxgates.c:282-616): cheap, branchy control flow stays in Python while
+every candidate scan (steps 1-4 and the LUT searches) dispatches to batched
+device sweeps.  States are value-copied around the step-5 multiplexer
+recursion exactly as in the reference — the copy semantics are load-bearing
+for backtracking.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import boolfunc as bf
+from ..graph.state import (
+    GATES,
+    NO_GATE,
+    State,
+    check_num_gates_possible,
+    get_sat_metric,
+)
+from .context import SearchContext
+from .lut import lut_search
+
+
+def create_circuit(
+    ctx: SearchContext, st: State, target, mask, inbits: List[int]
+) -> int:
+    """Returns the id of a gate realizing ``target`` under ``mask``, adding
+    gates to ``st`` as needed; NO_GATE on failure.  Step numbers reference
+    Kwan's paper, as in the reference implementation."""
+    opt = ctx.opt
+    metric = opt.metric
+
+    # Steps 1-2: an existing gate, or the complement of one (sboxgates.c:301-321).
+    found, gid, inverted = ctx.scan_matches(st, target, mask)
+    if found and not inverted:
+        st.verify_gate(gid, target, mask)
+        return gid
+    if not check_num_gates_possible(st, 1, get_sat_metric(bf.NOT), metric):
+        return NO_GATE
+    if found and inverted:
+        ret = st.add_not_gate(gid, metric)
+        st.verify_gate(ret, target, mask)
+        return ret
+
+    # Step 3: one available gate over all pairs (sboxgates.c:323-350).
+    if not check_num_gates_possible(st, 1, get_sat_metric(bf.AND), metric):
+        return NO_GATE
+    if st.num_gates >= 2:
+        found, g1, g2, entry = ctx.pair_search(st, target, mask, use_not_table=False)
+        if found:
+            ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
+            st.verify_gate(ret, target, mask)
+            return ret
+
+    if opt.lut_graph:
+        ret = lut_search(ctx, st, target, mask, inbits)
+        if ret != NO_GATE:
+            st.verify_gate(ret, target, mask)
+            return ret
+    else:
+        # Step 4a: pairs with NOT-augmented functions (sboxgates.c:366-386).
+        if not check_num_gates_possible(
+            st, 2, get_sat_metric(bf.AND) + get_sat_metric(bf.NOT), metric
+        ):
+            return NO_GATE
+        if ctx.not_entries and st.num_gates >= 2:
+            found, g1, g2, entry = ctx.pair_search(
+                st, target, mask, use_not_table=True
+            )
+            if found:
+                ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
+                st.verify_gate(ret, target, mask)
+                return ret
+
+        # Step 4b: gate triples x 3-input functions (sboxgates.c:392-435).
+        if not check_num_gates_possible(
+            st, 3, 2 * get_sat_metric(bf.AND) + get_sat_metric(bf.NOT), metric
+        ):
+            return NO_GATE
+        if st.num_gates >= 3:
+            found, gids, entry = ctx.triple_search(st, target, mask)
+            if found:
+                ret = st.add_boolfunc_3(entry.fun, gids[0], gids[1], gids[2], metric)
+                st.verify_gate(ret, target, mask)
+                return ret
+
+    # Step 5: multiplex over an unused input bit and recurse on the two
+    # Karnaugh-map halves (sboxgates.c:438-607).  Only the first six used
+    # bits are tracked — deeper levels may remux an earlier bit, but one
+    # branch then gets an empty mask and terminates immediately (the
+    # reference truncates identically, sboxgates.c:443-449).
+    tracked = inbits[:6]
+    num_inputs = st.num_inputs
+    best: State = None
+    best_out = NO_GATE
+
+    bit_order = [b for b in range(num_inputs) if b not in tracked]
+    if not bit_order:
+        return NO_GATE
+    if opt.randomize:
+        ctx.rng.shuffle(bit_order)
+
+    for bit in bit_order:
+        next_inbits = tracked + [bit]
+        fsel = st.table(bit).copy()
+
+        if opt.lut_graph:
+            nst = st.copy()
+            nst.max_gates -= 1  # reserve room for the mux LUT
+            fb = create_circuit(ctx, nst, target, mask & ~fsel, next_inbits)
+            if fb == NO_GATE:
+                continue
+            fc = create_circuit(ctx, nst, target, mask & fsel, next_inbits)
+            if fc == NO_GATE:
+                continue
+            nst.max_gates += 1
+            if fb == fc:
+                nst_out = fb
+            elif fb == bit:
+                nst_out = nst.add_and_gate(fb, fc, metric)
+            elif fc == bit:
+                nst_out = nst.add_or_gate(fb, fc, metric)
+            else:
+                # LUT mux 0xac = sel ? fc : fb (sboxgates.c:506-508)
+                nst_out = nst.add_lut(0xAC, bit, fb, fc)
+            if nst_out == NO_GATE:
+                continue
+            nst.verify_gate(nst_out, target, mask)
+        else:
+            # AND-based mux: out = fb ^ (sel & fc') (sboxgates.c:516-537)
+            nst_and = st.copy()
+            nst_and.max_gates -= 2
+            nst_and.max_sat_metric -= get_sat_metric(bf.AND) + get_sat_metric(bf.XOR)
+            fb = create_circuit(
+                ctx, nst_and, target & ~fsel, mask & ~fsel, next_inbits
+            )
+            mux_out_and = NO_GATE
+            if fb != NO_GATE:
+                fc = create_circuit(
+                    ctx,
+                    nst_and,
+                    nst_and.table(fb) ^ target,
+                    mask & fsel,
+                    next_inbits,
+                )
+                nst_and.max_gates += 2
+                nst_and.max_sat_metric += get_sat_metric(bf.AND) + get_sat_metric(
+                    bf.XOR
+                )
+                andg = nst_and.add_and_gate(fc, bit, metric)
+                mux_out_and = nst_and.add_xor_gate(fb, andg, metric)
+                if mux_out_and != NO_GATE:
+                    nst_and.verify_gate(mux_out_and, target, mask)
+
+            # OR-based mux: out = fd ^ (sel | fe) (sboxgates.c:539-567)
+            nst_or = st.copy()
+            if mux_out_and != NO_GATE:
+                nst_or.max_gates = nst_and.num_gates
+                nst_or.max_sat_metric = nst_and.sat_metric
+            nst_or.max_gates -= 2
+            nst_or.max_sat_metric -= get_sat_metric(bf.OR) + get_sat_metric(bf.XOR)
+            fd = create_circuit(
+                ctx, nst_or, ~target & fsel, mask & fsel, next_inbits
+            )
+            mux_out_or = NO_GATE
+            if fd != NO_GATE:
+                fe = create_circuit(
+                    ctx,
+                    nst_or,
+                    nst_or.table(fd) ^ target,
+                    mask & ~fsel,
+                    next_inbits,
+                )
+                nst_or.max_gates += 2
+                nst_or.max_sat_metric += get_sat_metric(bf.AND) + get_sat_metric(
+                    bf.XOR
+                )
+                org = nst_or.add_or_gate(fe, bit, metric)
+                mux_out_or = nst_or.add_xor_gate(fd, org, metric)
+                if mux_out_or != NO_GATE:
+                    nst_or.verify_gate(mux_out_or, target, mask)
+                nst_or.max_gates = st.max_gates
+                nst_or.max_sat_metric = st.max_sat_metric
+
+            if mux_out_and == NO_GATE and mux_out_or == NO_GATE:
+                continue
+            if metric == GATES:
+                use_and = mux_out_or == NO_GATE or (
+                    mux_out_and != NO_GATE and nst_and.num_gates < nst_or.num_gates
+                )
+            else:
+                use_and = mux_out_or == NO_GATE or (
+                    mux_out_and != NO_GATE and nst_and.sat_metric < nst_or.sat_metric
+                )
+            nst, nst_out = (nst_and, mux_out_and) if use_and else (nst_or, mux_out_or)
+
+        # Keep the best mux construction over all select bits
+        # (sboxgates.c:593-606).
+        if metric == GATES:
+            better = best is None or nst.num_gates < best.num_gates
+        else:
+            better = best is None or nst.sat_metric < best.sat_metric
+        if better:
+            best = nst
+            best_out = nst_out
+
+    if best is None:
+        return NO_GATE
+    best.verify_gate(best_out, target, mask)
+    # Adopt the best sub-state in place (the reference's *st = best).
+    st.max_sat_metric = best.max_sat_metric
+    st.sat_metric = best.sat_metric
+    st.max_gates = best.max_gates
+    st.gates = best.gates
+    st.outputs = best.outputs
+    st.tables = best.tables
+    return best_out

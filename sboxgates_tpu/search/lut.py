@@ -1,0 +1,289 @@
+"""Distributed 3/5/7-input LUT search.
+
+The reference parallelizes these sweeps over MPI ranks with static range
+partitioning and a racy first-hit early-quit protocol (lut.c:116-487,
+§2.5-2.6 of SURVEY.md).  Here each sweep is a chunked stream of candidate
+combinations through jitted constraint kernels; early termination is a
+found-flag check between chunks (deterministic "first hit in chunk order"),
+and multi-device scale-out shards each chunk across the mesh
+(:mod:`sboxgates_tpu.parallel.mesh`) instead of splitting the range per rank.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.state import NO_GATE, State, check_num_gates_possible
+from ..ops import combinatorics as comb
+from ..ops import sweeps
+from .context import (
+    LUT5_CHUNK,
+    LUT5_SOLVE_CHUNK,
+    LUT7_CAP,
+    LUT7_CHUNK,
+    LUT7_SOLVE_CHUNK,
+    SearchContext,
+    pick_chunk,
+)
+
+
+def _unpack32(word: int) -> np.ndarray:
+    return ((int(word) >> np.arange(32)) & 1).astype(bool)
+
+
+def _unpack128(words: np.ndarray) -> np.ndarray:
+    out = np.zeros(128, dtype=bool)
+    for w in range(4):
+        out[w * 32 : (w + 1) * 32] = _unpack32(int(words[w]))
+    return out
+
+
+def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
+    """All gate triples x any 3-input function (reference: lut_search phase 1,
+    lut.c:501-523).  Returns the new LUT's gate id or NO_GATE."""
+    g = st.num_gates
+    if g < 3:
+        return NO_GATE
+    tables, _ = ctx.device_tables(st)
+    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    stream = comb.CombinationStream(g, 3)
+    csize = pick_chunk(stream.total, 1 << 17)
+    while True:
+        chunk = stream.next_chunk(csize)
+        if chunk is None:
+            return NO_GATE
+        padded, nvalid = comb.pad_rows(chunk, csize)
+        ctx.stats["lut3_candidates"] += nvalid
+        valid = jnp.arange(csize) < nvalid
+        res = sweeps.lut3_sweep(
+            tables, jnp.asarray(padded), valid, jtarget, jmask, ctx.next_seed()
+        )
+        if bool(res.found):
+            row = padded[int(res.index)]
+            packed = int(res.slot)
+            req1, constrained = packed & 0xFF, (packed >> 8) & 0xFF
+            func = req1
+            if ctx.opt.randomize:
+                func |= int(ctx.rng.integers(0, 256)) & ~constrained & 0xFF
+            a, b, c = (int(x) for x in row)
+            gid = st.add_lut(func, a, b, c)
+            st.verify_gate(gid, target, mask)
+            return gid
+
+
+def _combo_stream(g: int, k: int, inbits) -> Tuple[comb.CombinationStream, list]:
+    excl = [b for b in inbits if b >= 0]
+    return comb.CombinationStream(g, k), excl
+
+
+def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
+    """5-LUT search: find LUT(LUT(a,b,c), d, e) realizing the target
+    (reference: search_5lut, lut.c:116-249).
+
+    Returns {outer_func, inner_func, gates: (a,b,c,d,e)} or None.
+    """
+    g = st.num_gates
+    if g < 5:
+        return None
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = jnp.asarray(w_tab), jnp.asarray(m_tab)
+    tables, _ = ctx.device_tables(st)
+    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    stream, excl = _combo_stream(g, 5, inbits)
+    csize = pick_chunk(stream.total, LUT5_CHUNK)
+    while True:
+        chunk = stream.next_chunk(csize)
+        if chunk is None:
+            return None
+        chunk = comb.filter_exclude(chunk, excl)
+        padded, nvalid = comb.pad_rows(chunk, csize)
+        ctx.stats["lut5_candidates"] += nvalid
+        valid = jnp.arange(csize) < nvalid
+        feas, req1p, req0p = sweeps.lut_filter(
+            tables, jnp.asarray(padded), valid, jtarget, jmask
+        )
+        feas = np.asarray(feas)
+        if not feas.any():
+            continue
+        fidx = np.nonzero(feas)[0]
+        freq1 = np.asarray(req1p)[fidx]
+        freq0 = np.asarray(req0p)[fidx]
+        fcombos = padded[fidx]
+        # Solve feasible tuples in sub-chunks.
+        for lo in range(0, len(fidx), LUT5_SOLVE_CHUNK):
+            hi = min(lo + LUT5_SOLVE_CHUNK, len(fidx))
+            scs = pick_chunk(hi - lo, LUT5_SOLVE_CHUNK)
+            # pad both constraint vectors with all-ones so padded rows
+            # conflict in every cell and can never be selected
+            r1, _ = comb.pad_rows(freq1[lo:hi], scs, fill=0xFFFFFFFF)
+            r0, _ = comb.pad_rows(freq0[lo:hi], scs, fill=0xFFFFFFFF)
+            ctx.stats["lut5_solved"] += hi - lo
+            found, best_t, sel = sweeps.lut5_solve(
+                jnp.asarray(r1), jnp.asarray(r0), jw, jm, ctx.next_seed()
+            )
+            if not bool(found):
+                continue
+            t = lo + int(best_t)
+            sigma, func_outer = divmod(int(sel), 256)
+            combo = fcombos[t]
+            a, b, c, d, e = (int(combo[p]) for p in splits[sigma])
+            # Reconstruct the inner function on the host: group the 32 cells
+            # by (outer output, inner input pattern).
+            req1_cells = _unpack32(freq1[t])
+            req0_cells = _unpack32(freq0[t])
+            wbits = _unpack32(w_tab[sigma, func_outer])
+            groups = np.zeros(32, dtype=np.int64)
+            for m in range(4):
+                mm = _unpack32(m_tab[sigma, m])
+                groups[mm & wbits] = 4 + m
+                groups[mm & ~wbits] = m
+            func_inner = sweeps.solve_inner_function(
+                req1_cells,
+                req0_cells,
+                groups,
+                ctx.rng if ctx.opt.randomize else None,
+            )
+            assert func_inner is not None, "device reported spurious 5-LUT hit"
+            return {
+                "func_outer": func_outer,
+                "func_inner": func_inner,
+                "gates": (a, b, c, d, e),
+            }
+
+
+def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
+    """7-LUT search: LUT(LUT(a,b,c), LUT(d,e,f), g) (reference: search_7lut,
+    lut.c:256-487).  Two stages, mirroring the reference: (A) stream the full
+    C(G,7) space through the feasibility filter, capped at LUT7_CAP hits; (B)
+    sweep (ordering x outer x middle) function space over the hits."""
+    g = st.num_gates
+    if g < 7:
+        return None
+    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+    tables, _ = ctx.device_tables(st)
+    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    stream, excl = _combo_stream(g, 7, inbits)
+
+    hit_combos: List[np.ndarray] = []
+    hit_req1: List[np.ndarray] = []
+    hit_req0: List[np.ndarray] = []
+    nhits = 0
+    csize = pick_chunk(stream.total, LUT7_CHUNK)
+    while nhits < LUT7_CAP:
+        chunk = stream.next_chunk(csize)
+        if chunk is None:
+            break
+        chunk = comb.filter_exclude(chunk, excl)
+        padded, nvalid = comb.pad_rows(chunk, csize)
+        ctx.stats["lut7_candidates"] += nvalid
+        valid = jnp.arange(csize) < nvalid
+        feas, req1p, req0p = sweeps.lut_filter(
+            tables, jnp.asarray(padded), valid, jtarget, jmask
+        )
+        feas = np.asarray(feas)
+        if feas.any():
+            fidx = np.nonzero(feas)[0]
+            hit_combos.append(padded[fidx])
+            hit_req1.append(np.asarray(req1p)[fidx])
+            hit_req0.append(np.asarray(req0p)[fidx])
+            nhits += len(fidx)
+    if nhits == 0:
+        return None
+    combos = np.concatenate(hit_combos)[:LUT7_CAP]
+    req1 = np.concatenate(hit_req1)[:LUT7_CAP]
+    req0 = np.concatenate(hit_req0)[:LUT7_CAP]
+    if ctx.opt.randomize:
+        perm = ctx.rng.permutation(len(combos))
+        combos, req1, req0 = combos[perm], req1[perm], req0[perm]
+
+    jwo, jwm, jg = jnp.asarray(wo_tab), jnp.asarray(wm_tab), jnp.asarray(g_tab)
+    for lo in range(0, len(combos), LUT7_SOLVE_CHUNK):
+        hi = min(lo + LUT7_SOLVE_CHUNK, len(combos))
+        r1, _ = comb.pad_rows(req1[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
+        r0, _ = comb.pad_rows(req0[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
+        ctx.stats["lut7_solved"] += hi - lo
+        found, best_t, sigma, flat = sweeps.lut7_solve(
+            jnp.asarray(r1), jnp.asarray(r0), jwo, jwm, jg, ctx.next_seed()
+        )
+        if not bool(found):
+            continue
+        t = lo + int(best_t)
+        sigma = int(sigma)
+        func_outer, func_middle = divmod(int(flat), 256)
+        combo = combos[t]
+        order = orders[sigma]
+        a, b, c, d, e, f = (int(combo[p]) for p in order[:6])
+        gg = int(combo[order[6]])
+        # Inner function: group 128 cells by (outer out, middle out, x_g).
+        req1_cells = _unpack128(req1[t])
+        req0_cells = _unpack128(req0[t])
+        wobits = _unpack128(wo_tab[sigma, func_outer])
+        wmbits = _unpack128(wm_tab[sigma, func_middle])
+        gbits = _unpack128(g_tab[sigma])
+        groups = (
+            wobits.astype(np.int64) * 4
+            + wmbits.astype(np.int64) * 2
+            + gbits.astype(np.int64)
+        )
+        func_inner = sweeps.solve_inner_function(
+            req1_cells, req0_cells, groups, ctx.rng if ctx.opt.randomize else None
+        )
+        assert func_inner is not None, "device reported spurious 7-LUT hit"
+        return {
+            "func_outer": func_outer,
+            "func_middle": func_middle,
+            "func_inner": func_inner,
+            "gates": (a, b, c, d, e, f, gg),
+        }
+    return None
+
+
+def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
+    """Full LUT search: 3-LUT, then 5-LUT (2 new gates), then 7-LUT (3 new
+    gates), with budget gating between phases (reference: lut_search,
+    lut.c:489-631)."""
+    gid = lut3_search(ctx, st, target, mask, inbits)
+    if gid != NO_GATE:
+        return gid
+
+    if not check_num_gates_possible(st, 2, 0, ctx.opt.metric):
+        return NO_GATE
+
+    res = lut5_search(ctx, st, target, mask, inbits)
+    if res is not None:
+        a, b, c, d, e = res["gates"]
+        outer = st.add_lut(res["func_outer"], a, b, c)
+        gid = st.add_lut(res["func_inner"], outer, d, e)
+        st.verify_gate(gid, target, mask)
+        if ctx.opt.verbosity >= 1:
+            print(
+                "Found 5LUT: %02x %02x    %3d %3d %3d %3d %3d"
+                % (res["func_outer"], res["func_inner"], a, b, c, d, e)
+            )
+        return gid
+
+    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
+        return NO_GATE
+
+    res = lut7_search(ctx, st, target, mask, inbits)
+    if res is not None:
+        a, b, c, d, e, f, gg = res["gates"]
+        outer = st.add_lut(res["func_outer"], a, b, c)
+        middle = st.add_lut(res["func_middle"], d, e, f)
+        gid = st.add_lut(res["func_inner"], outer, middle, gg)
+        st.verify_gate(gid, target, mask)
+        if ctx.opt.verbosity >= 1:
+            print(
+                "Found 7LUT: %02x %02x %02x %3d %3d %3d %3d %3d %3d %3d"
+                % (
+                    res["func_outer"],
+                    res["func_middle"],
+                    res["func_inner"],
+                    a, b, c, d, e, f, gg,
+                )
+            )
+        return gid
+    return NO_GATE

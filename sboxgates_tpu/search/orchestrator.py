@@ -1,0 +1,142 @@
+"""Search orchestration: the per-output iteration driver and the greedy
+multi-output beam search (reference: generate_graph_one_output
+sboxgates.c:661-688, generate_graph sboxgates.c:701-788)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..core import ttable as tt
+from ..graph.state import GATES, INT_MAX, MAX_GATES, NO_GATE, State
+from ..graph.xmlio import save_state
+from .context import Options, SearchContext
+from .kwan import create_circuit
+
+BEAM_WIDTH = 20  # reference: out_states[20], sboxgates.c:704,713
+
+
+def make_targets(sbox: np.ndarray) -> List[np.ndarray]:
+    return [tt.target_table(sbox, bit) for bit in range(8)]
+
+
+def sbox_num_outputs(targets) -> int:
+    for i in range(7, -1, -1):
+        if (targets[i] != 0).any():
+            return i + 1
+    raise ValueError("S-box has no outputs")
+
+
+def generate_graph_one_output(
+    ctx: SearchContext,
+    st: State,
+    targets,
+    output: int,
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+) -> List[State]:
+    """``iterations`` independent attempts at one output bit, ratcheting the
+    budget down after each success (sboxgates.c:661-688).  Returns all
+    successful states, best last."""
+    opt = ctx.opt
+    mask = tt.mask_table(st.num_inputs)
+    results = []
+    for it in range(opt.iterations):
+        nst = st.copy()
+        nst.outputs[output] = create_circuit(ctx, nst, targets[output], mask, [])
+        if nst.outputs[output] == NO_GATE:
+            log(f"({it + 1}/{opt.iterations}): Not found.")
+            continue
+        log(
+            f"({it + 1}/{opt.iterations}): {nst.num_gates - nst.num_inputs} gates. "
+            f"SAT metric: {nst.sat_metric}"
+        )
+        if save_dir is not None:
+            save_state(nst, save_dir)
+        results.append(nst)
+        if opt.metric == GATES:
+            st.max_gates = min(st.max_gates, nst.num_gates)
+        else:
+            st.max_sat_metric = min(st.max_sat_metric, nst.sat_metric)
+    return results
+
+
+def generate_graph(
+    ctx: SearchContext,
+    st: State,
+    targets,
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+) -> List[State]:
+    """Greedy beam search over output order: repeatedly add every missing
+    output to every surviving start state, keeping up to BEAM_WIDTH
+    minimal-metric states per round (sboxgates.c:701-788).  Returns the
+    final beam."""
+    opt = ctx.opt
+    num_outputs = sbox_num_outputs(targets)
+    mask = tt.mask_table(st.num_inputs)
+    start_states = [st]
+
+    while sum(1 for o in start_states[0].outputs if o != NO_GATE) < num_outputs:
+        done = sum(1 for o in start_states[0].outputs if o != NO_GATE)
+        max_gates = MAX_GATES
+        max_sat_metric = INT_MAX
+        out_states: List[State] = []
+
+        for it in range(opt.iterations):
+            log(
+                f"Generating circuits with {done + 1} output"
+                f"{'' if done == 0 else 's'}. ({it + 1}/{opt.iterations})"
+            )
+            for start in start_states:
+                for output in range(num_outputs):
+                    if start.outputs[output] != NO_GATE:
+                        continue
+                    nst = start.copy()
+                    if opt.metric == GATES:
+                        nst.max_gates = max_gates
+                    else:
+                        nst.max_sat_metric = max_sat_metric
+                    nst.outputs[output] = create_circuit(
+                        ctx, nst, targets[output], mask, []
+                    )
+                    if nst.outputs[output] == NO_GATE:
+                        log(f"No solution for output {output}.")
+                        continue
+                    if save_dir is not None:
+                        save_state(nst, save_dir)
+                    if opt.metric == GATES:
+                        if max_gates > nst.num_gates:
+                            max_gates = nst.num_gates
+                            out_states = []
+                        if nst.num_gates <= max_gates:
+                            if len(out_states) < BEAM_WIDTH:
+                                out_states.append(nst)
+                            else:
+                                log("Output state buffer full! Throwing away valid state.")
+                    else:
+                        if max_sat_metric > nst.sat_metric:
+                            max_sat_metric = nst.sat_metric
+                            out_states = []
+                        if nst.sat_metric <= max_sat_metric:
+                            if len(out_states) < BEAM_WIDTH:
+                                out_states.append(nst)
+                            else:
+                                log("Output state buffer full! Throwing away valid state.")
+        if not out_states:
+            return []
+        if opt.metric == GATES:
+            log(
+                f"Found {len(out_states)} state"
+                f"{'' if len(out_states) == 1 else 's'} with "
+                f"{max_gates - out_states[0].num_inputs} gates."
+            )
+        else:
+            log(
+                f"Found {len(out_states)} state"
+                f"{'' if len(out_states) == 1 else 's'} with SAT metric "
+                f"{max_sat_metric}."
+            )
+        start_states = out_states
+    return start_states

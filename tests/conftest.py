@@ -7,12 +7,20 @@ imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment may register an 'axon' TPU-tunnel backend that
+# (a) supports only one client process and (b) programmatically overrides
+# JAX_PLATFORMS at interpreter start — so both the env var and the config
+# must be pinned before any backend initializes.  Tests never touch the TPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

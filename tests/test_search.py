@@ -1,0 +1,130 @@
+"""End-to-end search tests on real S-boxes."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import GATES, NO_GATE, SAT, State
+from sboxgates_tpu.search import (
+    Options,
+    SearchContext,
+    create_circuit,
+    generate_graph,
+    generate_graph_one_output,
+    make_targets,
+    sbox_num_outputs,
+)
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def verify_outputs(st, sbox, num_inputs):
+    """Every mapped output gate's table must equal the S-box bit on the
+    valid positions."""
+    mask = tt.mask_table(num_inputs)
+    for bit, gid in enumerate(st.outputs):
+        if gid == NO_GATE:
+            continue
+        target = tt.target_table(sbox, bit)
+        assert bool(tt.eq_mask(st.table(gid), target, mask)), f"output {bit}"
+
+
+def run_single_output(path, output, **opt_kwargs):
+    sbox, n = load_sbox(path)
+    targets = make_targets(sbox)
+    opt = Options(seed=42, **opt_kwargs)
+    ctx = SearchContext(opt)
+    st = State.init_inputs(n)
+    results = generate_graph_one_output(
+        ctx, st, targets, output, save_dir=None, log=lambda s: None
+    )
+    return results, sbox, n
+
+
+def test_identity_sbox_trivial():
+    """identity.txt outputs are just the input variables — zero new gates."""
+    results, sbox, n = run_single_output(os.path.join(DATA, "identity.txt"), 0)
+    assert results
+    st = results[-1]
+    assert st.num_gates - st.num_inputs == 0
+    verify_outputs(st, sbox, n)
+
+
+def test_crypto1_fa_search():
+    """4-input single-output filter function: a real but fast search."""
+    results, sbox, n = run_single_output(os.path.join(DATA, "crypto1_fa.txt"), 0)
+    assert results, "search failed"
+    st = results[-1]
+    verify_outputs(st, sbox, n)
+    assert st.num_gates - st.num_inputs <= 12
+
+
+def test_des_s1_bit0_search():
+    """DES S1 output bit 0 — the reference's showcase example finds 19
+    gates (README.md:33-34); we only require a valid circuit."""
+    results, sbox, n = run_single_output(os.path.join(DATA, "des_s1.txt"), 0)
+    assert results, "search failed"
+    st = results[-1]
+    verify_outputs(st, sbox, n)
+    assert st.num_gates - st.num_inputs <= 40
+
+
+def test_des_s1_bit0_sat_metric_with_nots():
+    """SAT-metric objective with NOT-augmented functions (the CI config
+    mpirun -N 4 ... -i 3 -o 0 -s -n, .travis.yml:40)."""
+    results, sbox, n = run_single_output(
+        os.path.join(DATA, "des_s1.txt"), 0, metric=SAT, try_nots=True, iterations=2
+    )
+    assert results
+    verify_outputs(results[-1], sbox, n)
+    assert results[-1].sat_metric > 0
+
+
+def test_crypto1_fa_lut_search():
+    """LUT-mode search on the 4-input filter function."""
+    results, sbox, n = run_single_output(
+        os.path.join(DATA, "crypto1_fa.txt"), 0, lut_graph=True
+    )
+    assert results
+    st = results[-1]
+    verify_outputs(st, sbox, n)
+    # LUT graphs should be very small for a 4-input function
+    assert st.num_gates - st.num_inputs <= 4
+
+
+def test_budget_ratchet():
+    """Second iteration must not produce a worse circuit than the first."""
+    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
+    targets = make_targets(sbox)
+    ctx = SearchContext(Options(seed=7, iterations=3))
+    st = State.init_inputs(n)
+    results = generate_graph_one_output(
+        ctx, st, targets, 0, save_dir=None, log=lambda s: None
+    )
+    sizes = [r.num_gates for r in results]
+    # ratchet: every later success is no bigger than earlier ones
+    for a, b in zip(sizes, sizes[1:]):
+        assert b <= a
+
+
+@pytest.mark.slow
+def test_full_graph_linear_sbox():
+    """Full multi-output beam search on the 8x8 linear sanity box."""
+    sbox, n = load_sbox(os.path.join(DATA, "linear.txt"))
+    targets = make_targets(sbox)
+    ctx = SearchContext(Options(seed=3))
+    st = State.init_inputs(n)
+    beam = generate_graph(ctx, st, targets, save_dir=None, log=lambda s: None)
+    assert beam
+    final = beam[0]
+    assert all(o != NO_GATE for o in final.outputs[: sbox_num_outputs(targets)])
+    verify_outputs(final, sbox, n)
+
+
+def test_single_output_oneoutput_range():
+    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
+    targets = make_targets(sbox)
+    assert sbox_num_outputs(targets) == 1

@@ -1,0 +1,381 @@
+"""Device sweep kernels vs. brute-force numpy oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.ops import combinatorics as comb
+from sboxgates_tpu.ops import sweeps
+
+
+def random_tables(rng, n):
+    return tt.from_bits(rng.integers(0, 2, size=(n, 256)).astype(bool))
+
+
+# -- oracle implementations ----------------------------------------------
+
+
+def oracle_feasible(tabs, target, mask, k):
+    """check_n_lut_possible oracle: partition positions by input pattern."""
+    bits = [tt.to_bits(tabs[i]) for i in range(k)]
+    tbits = tt.to_bits(target)
+    mbits = tt.to_bits(mask)
+    idx = np.zeros(256, dtype=int)
+    for i in range(k):
+        idx = (idx << 1) | bits[i].astype(int)
+    for cell in range(1 << k):
+        sel = (idx == cell) & mbits
+        if sel.any() and tbits[sel].any() and (~tbits[sel]).any():
+            return False
+    return True
+
+
+def oracle_lut_function(tabs, target, mask):
+    """get_lut_function oracle for 3 inputs: (func, setmask) or None."""
+    bits = [tt.to_bits(t) for t in tabs]
+    tbits, mbits = tt.to_bits(target), tt.to_bits(mask)
+    func, setmask = 0, 0
+    for pos in range(256):
+        if not mbits[pos]:
+            continue
+        cell = (int(bits[0][pos]) << 2) | (int(bits[1][pos]) << 1) | int(bits[2][pos])
+        want = int(tbits[pos])
+        if setmask & (1 << cell):
+            if ((func >> cell) & 1) != want:
+                return None
+        else:
+            func |= want << cell
+            setmask |= 1 << cell
+    return func, setmask
+
+
+# -- cell constraints ----------------------------------------------------
+
+
+def test_cell_constraints_match_oracle(rng):
+    tables = random_tables(rng, 8)
+    target = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    mask = tt.mask_table(8)
+    for k in (2, 3, 5):
+        combos = np.asarray(
+            list(__import__("itertools").combinations(range(8), k)), dtype=np.int32
+        )
+        tabs = jnp.asarray(tables)[jnp.asarray(combos)]
+        req1, req0 = sweeps._cell_constraints(
+            tabs, jnp.asarray(target), jnp.asarray(mask)
+        )
+        req1, req0 = np.asarray(req1), np.asarray(req0)
+        for row, combo in enumerate(combos):
+            feas_oracle = oracle_feasible(tables[combo], target, mask, k)
+            feas_got = not (req1[row] & req0[row]).any()
+            assert feas_got == feas_oracle, (k, combo)
+
+
+def test_cell_constraints_lut_function(rng):
+    """For feasible triples, req1/constrained must equal the oracle's
+    derived LUT function and set-mask."""
+    tables = random_tables(rng, 6)
+    # use a target expressible from the tables so some triples are feasible
+    target = tt.eval_lut(0xC5, tables[0], tables[1], tables[2])
+    mask = tt.mask_table(8)
+    combos = np.asarray(
+        list(__import__("itertools").combinations(range(6), 3)), dtype=np.int32
+    )
+    tabs = jnp.asarray(tables)[jnp.asarray(combos)]
+    req1, req0 = sweeps._cell_constraints(tabs, jnp.asarray(target), jnp.asarray(mask))
+    req1, req0 = np.asarray(req1), np.asarray(req0)
+    feasible_rows = 0
+    for row, combo in enumerate(combos):
+        oracle = oracle_lut_function([tables[c] for c in combo], target, mask)
+        if oracle is None:
+            assert (req1[row] & req0[row]).any(), combo
+            continue
+        feasible_rows += 1
+        func, setmask = oracle
+        r = sum(int(req1[row][j]) << j for j in range(8))
+        c = sum(int(req1[row][j] | req0[row][j]) << j for j in range(8))
+        assert c == setmask
+        assert r == func & setmask
+    assert feasible_rows >= 1  # triple (0,1,2) at least
+
+
+# -- match tables --------------------------------------------------------
+
+
+def test_build_match_table_pairs():
+    funs = [0b0001, 0b0110]  # AND, XOR in cell order? no — raw bytes
+    table = sweeps.build_match_table(funs, num_cells=4)
+    # R=0b0001 (cell0 ->1), C=0b1111: only fun 0 matches exactly
+    assert table[0b0001 | (0b1111 << 4)] == 0
+    # R=0b0110, C=0b1111: fun 1
+    assert table[0b0110 | (0b1111 << 4)] == 1
+    # R=0, C=0 (no constraints): first fun wins
+    assert table[0] == 0
+    # R=0b1111, C=0b1111: no match
+    assert table[0b1111 | (0b1111 << 4)] == -1
+    # partially constrained: C=0b0011, R=0b0010 matches XOR (0b0110)
+    assert table[0b0010 | (0b0011 << 4)] == 1
+
+
+def test_tuple_match_sweep_finds_pair(rng):
+    """Plant a pair whose AND equals the target; the sweep must find it."""
+    from sboxgates_tpu.search.context import _build_pair_table
+
+    tables = random_tables(rng, 10)
+    target = tables[2] & tables[7]
+    mask = tt.mask_table(8)
+    jtable, entries = _build_pair_table(
+        bf.create_avail_gates(bf.DEFAULT_AVAILABLE)
+    )
+    i, j = np.triu_indices(10, k=1)
+    combos = np.stack([i, j], axis=1).astype(np.int32)
+    res = sweeps.tuple_match_sweep(
+        jnp.asarray(tables),
+        jnp.asarray(combos),
+        jnp.ones(len(combos), dtype=bool),
+        jnp.asarray(target),
+        jnp.asarray(mask),
+        jtable,
+        0,
+        num_cells=4,
+    )
+    assert bool(res.found)
+    pair = combos[int(res.index)]
+    entry = entries[int(res.slot)]
+    gids = [int(pair[p]) for p in entry.perm]
+    got = tt.eval_gate2(entry.fun.fun, tables[gids[0]], tables[gids[1]])
+    if entry.fun.not_out:
+        got = ~got
+    assert bool(tt.eq_mask(got, target, mask))
+
+
+def test_tuple_match_sweep_noncommutative(rng):
+    """A_AND_NOT_B requires operand-order handling."""
+    from sboxgates_tpu.search.context import _build_pair_table
+
+    tables = random_tables(rng, 6)
+    # plant: tables[1] & ~tables[4] — only expressible with the right order
+    target = ~tables[1] & tables[4]
+    mask = tt.mask_table(8)
+    funs = [bf.create_2_input_fun(bf.A_AND_NOT_B)]
+    jtable, entries = _build_pair_table(funs)
+    i, j = np.triu_indices(6, k=1)
+    combos = np.stack([i, j], axis=1).astype(np.int32)
+    res = sweeps.tuple_match_sweep(
+        jnp.asarray(tables),
+        jnp.asarray(combos),
+        jnp.ones(len(combos), dtype=bool),
+        jnp.asarray(target),
+        jnp.asarray(mask),
+        jtable,
+        1,
+        num_cells=4,
+    )
+    assert bool(res.found)
+    pair = combos[int(res.index)]
+    entry = entries[int(res.slot)]
+    gids = [int(pair[p]) for p in entry.perm]
+    got = tt.eval_gate2(bf.A_AND_NOT_B, tables[gids[0]], tables[gids[1]])
+    assert bool(tt.eq_mask(got, target, mask))
+
+
+def test_match_scan(rng):
+    tables = random_tables(rng, 12)
+    mask = tt.mask_table(8)
+    found, idx, inv = sweeps.match_scan(
+        jnp.asarray(tables),
+        jnp.ones(12, dtype=bool),
+        jnp.asarray(tables[5]),
+        jnp.asarray(mask),
+        7,
+    )
+    assert bool(found) and not bool(inv) and int(idx) == 5
+    found, idx, inv = sweeps.match_scan(
+        jnp.asarray(tables),
+        jnp.ones(12, dtype=bool),
+        jnp.asarray(~tables[3]),
+        jnp.asarray(mask),
+        7,
+    )
+    assert bool(found) and bool(inv) and int(idx) == 3
+
+
+# -- LUT kernels ---------------------------------------------------------
+
+
+def test_lut3_sweep_planted(rng):
+    tables = random_tables(rng, 8)
+    target = tt.eval_lut(0x3A, tables[1], tables[4], tables[6])
+    mask = tt.mask_table(8)
+    combos = np.asarray(
+        list(__import__("itertools").combinations(range(8), 3)), dtype=np.int32
+    )
+    res = sweeps.lut3_sweep(
+        jnp.asarray(tables),
+        jnp.asarray(combos),
+        jnp.ones(len(combos), dtype=bool),
+        jnp.asarray(target),
+        jnp.asarray(mask),
+        3,
+    )
+    assert bool(res.found)
+    row = combos[int(res.index)]
+    packed = int(res.slot)
+    req1, constrained = packed & 0xFF, (packed >> 8) & 0xFF
+    func = req1  # don't-cares zero
+    got = tt.eval_lut(
+        func, tables[row[0]], tables[row[1]], tables[row[2]]
+    )
+    assert bool(tt.eq_mask(got, target, mask))
+
+
+def test_lut5_pipeline_planted(rng):
+    """Plant LUT(LUT(a,b,c),d,e); filter + solve must recover a valid
+    decomposition."""
+    tables = random_tables(rng, 9)
+    a, b, c, d, e = 0, 2, 4, 6, 8
+    outer = tt.eval_lut(0x5B, tables[a], tables[b], tables[c])
+    target = tt.eval_lut(0xC9, outer, tables[d], tables[e])
+    mask = tt.mask_table(8)
+    combos = np.asarray(
+        list(__import__("itertools").combinations(range(9), 5)), dtype=np.int32
+    )
+    feas, req1p, req0p = sweeps.lut_filter(
+        jnp.asarray(tables),
+        jnp.asarray(combos),
+        jnp.ones(len(combos), dtype=bool),
+        jnp.asarray(target),
+        jnp.asarray(mask),
+    )
+    feas = np.asarray(feas)
+    assert feas.any()
+    # the planted tuple must be feasible
+    planted = [a, b, c, d, e]
+    planted_row = next(
+        i for i, row in enumerate(combos) if list(row) == planted
+    )
+    assert feas[planted_row]
+
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    fidx = np.nonzero(feas)[0]
+    found, best_t, sel = sweeps.lut5_solve(
+        jnp.asarray(np.asarray(req1p)[fidx]),
+        jnp.asarray(np.asarray(req0p)[fidx]),
+        jnp.asarray(w_tab),
+        jnp.asarray(m_tab),
+        5,
+    )
+    assert bool(found)
+    t = int(best_t)
+    sigma, func_outer = divmod(int(sel), 256)
+    combo = combos[fidx[t]]
+    ga, gb, gc, gd, ge = (int(combo[p]) for p in splits[sigma])
+    req1_cells = ((int(np.asarray(req1p)[fidx][t]) >> np.arange(32)) & 1).astype(bool)
+    req0_cells = ((int(np.asarray(req0p)[fidx][t]) >> np.arange(32)) & 1).astype(bool)
+    wbits = ((int(w_tab[sigma, func_outer]) >> np.arange(32)) & 1).astype(bool)
+    groups = np.zeros(32, dtype=np.int64)
+    for m in range(4):
+        mm = ((int(m_tab[sigma, m]) >> np.arange(32)) & 1).astype(bool)
+        groups[mm & wbits] = 4 + m
+        groups[mm & ~wbits] = m
+    func_inner = sweeps.solve_inner_function(req1_cells, req0_cells, groups, None)
+    assert func_inner is not None
+    outer_t = tt.eval_lut(func_outer, tables[ga], tables[gb], tables[gc])
+    inner_t = tt.eval_lut(func_inner, outer_t, tables[gd], tables[ge])
+    assert bool(tt.eq_mask(inner_t, target, mask))
+
+
+def test_lut7_pipeline_planted(rng):
+    """Plant LUT(LUT(a,b,c),LUT(d,e,f),g); the 7-LUT solver must recover a
+    valid decomposition."""
+    tables = random_tables(rng, 7)
+    outer = tt.eval_lut(0x1D, tables[0], tables[1], tables[2])
+    middle = tt.eval_lut(0xB2, tables[3], tables[4], tables[5])
+    target = tt.eval_lut(0x6A, outer, middle, tables[6])
+    mask = tt.mask_table(8)
+    combos = np.asarray([[0, 1, 2, 3, 4, 5, 6]], dtype=np.int32)
+    feas, req1p, req0p = sweeps.lut_filter(
+        jnp.asarray(tables),
+        jnp.asarray(combos),
+        jnp.ones(1, dtype=bool),
+        jnp.asarray(target),
+        jnp.asarray(mask),
+    )
+    assert bool(np.asarray(feas)[0])
+    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+    found, best_t, sigma, flat = sweeps.lut7_solve(
+        jnp.asarray(req1p),
+        jnp.asarray(req0p),
+        jnp.asarray(wo_tab),
+        jnp.asarray(wm_tab),
+        jnp.asarray(g_tab),
+        11,
+    )
+    assert bool(found)
+    sigma = int(sigma)
+    func_outer, func_middle = divmod(int(flat), 256)
+    order = orders[sigma]
+    req1_cells = np.concatenate(
+        [((int(w) >> np.arange(32)) & 1) for w in np.asarray(req1p)[0]]
+    ).astype(bool)
+    req0_cells = np.concatenate(
+        [((int(w) >> np.arange(32)) & 1) for w in np.asarray(req0p)[0]]
+    ).astype(bool)
+    wobits = np.concatenate(
+        [((int(w) >> np.arange(32)) & 1) for w in wo_tab[sigma, func_outer]]
+    ).astype(bool)
+    wmbits = np.concatenate(
+        [((int(w) >> np.arange(32)) & 1) for w in wm_tab[sigma, func_middle]]
+    ).astype(bool)
+    gbits = np.concatenate(
+        [((int(w) >> np.arange(32)) & 1) for w in g_tab[sigma]]
+    ).astype(bool)
+    groups = wobits * 4 + wmbits * 2 + gbits * 1
+    func_inner = sweeps.solve_inner_function(
+        req1_cells, req0_cells, groups.astype(np.int64), None
+    )
+    assert func_inner is not None
+    a, b, c, d, e, f = (int(combos[0][p]) for p in order[:6])
+    gg = int(combos[0][order[6]])
+    t_outer = tt.eval_lut(func_outer, tables[a], tables[b], tables[c])
+    t_middle = tt.eval_lut(func_middle, tables[d], tables[e], tables[f])
+    t_inner = tt.eval_lut(func_inner, t_outer, t_middle, tables[gg])
+    assert bool(tt.eq_mask(t_inner, target, mask))
+
+
+# -- combinatorics -------------------------------------------------------
+
+
+def test_unrank_and_stream():
+    import itertools
+
+    all_combos = list(itertools.combinations(range(9), 4))
+    for r in (0, 1, 17, 125):
+        assert tuple(comb.unrank_combination(r, 9, 4)) == all_combos[r]
+        assert comb.combination_rank(all_combos[r], 9) == r
+    # stream from an offset
+    s = comb.CombinationStream(9, 4, start=100)
+    chunk = s.next_chunk(1000)
+    assert [tuple(row) for row in chunk] == all_combos[100:]
+    assert s.next_chunk(10) is None
+
+
+def test_stream_chunking():
+    s = comb.CombinationStream(10, 3)
+    seen = []
+    while True:
+        c = s.next_chunk(17)
+        if c is None:
+            break
+        seen.extend(tuple(r) for r in c)
+    import itertools
+
+    assert seen == list(itertools.combinations(range(10), 3))
+
+
+def test_filter_exclude():
+    combos = np.asarray([[0, 1, 2], [1, 2, 3], [2, 3, 4]], dtype=np.int32)
+    out = comb.filter_exclude(combos, [0, 4])
+    assert [tuple(r) for r in out] == [(1, 2, 3)]
