@@ -377,6 +377,25 @@ def lut7_split_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
     )
 
 
+def host_cell_constraints(
+    tables: np.ndarray, combo: Sequence[int], target, mask
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy mirror of :func:`_cell_constraints` for a single tuple — used
+    to reconstruct inner functions for a device-selected decomposition
+    without fetching per-row constraint arrays."""
+    k = len(combo)
+    tbits = tt.to_bits(np.asarray(target))
+    mbits = tt.to_bits(np.asarray(mask))
+    idx = np.zeros(tt.TABLE_BITS, dtype=np.int64)
+    for i, gid in enumerate(combo):
+        idx |= tt.to_bits(tables[gid]).astype(np.int64) << (k - 1 - i)
+    req1 = np.zeros(1 << k, dtype=bool)
+    req0 = np.zeros(1 << k, dtype=bool)
+    np.logical_or.at(req1, idx[mbits & tbits], True)
+    np.logical_or.at(req0, idx[mbits & ~tbits], True)
+    return req1, req0
+
+
 def solve_inner_function(
     req1_cells: np.ndarray,
     req0_cells: np.ndarray,

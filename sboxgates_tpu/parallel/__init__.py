@@ -1,0 +1,5 @@
+from .mesh import (  # noqa: F401
+    MeshPlan,
+    lut5_fused_step,
+    make_mesh,
+)

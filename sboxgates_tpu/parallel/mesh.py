@@ -1,0 +1,116 @@
+"""Distributed candidate-space parallelism over a device mesh.
+
+The reference scales out by statically partitioning the combination index
+space across MPI ranks, broadcasting the whole search state to every rank,
+and racing to the first hit (lut.c:138-149, sboxgates.c:619-642; SURVEY.md
+§2.6).  The TPU-native equivalent implemented here:
+
+- the (small) search state, target, and mask are **replicated** — the SPMD
+  analog of the reference's ``MPI_Bcast(mpi_work)``;
+- each candidate chunk is **sharded along its leading axis** over a 1-D
+  ``jax.sharding.Mesh`` axis (``"candidates"``); XLA GSPMD partitions the
+  constraint sweeps and inserts the all-reduce for the found-flag /
+  priority-argmax reductions — replacing the hand-rolled Isend/Irecv
+  first-hit protocol and its cancel/drain epilogue (lut.c:665-740);
+- early termination is the found-flag check between chunks, identical to
+  the single-device path, so multi-chip changes throughput, not semantics.
+
+Multi-host (``jax.distributed``) scale-out keeps this sharding layout with
+collectives riding ICI inside each host; the host-side compaction between
+filter and solve then needs process-local gathers
+(``multihost_utils.process_allgather``) or the fused single-dispatch mode
+(:func:`lut5_fused_step`, ``Options.fused_lut5``) which avoids the host
+round-trip entirely — wiring the gather path is tracked for a later round.
+
+A second mesh axis (``"restarts"``) batches independent randomized search
+restarts — parallelism the reference lacks (SURVEY.md §2.10): ``vmap`` over
+per-restart targets/seeds composes with the candidate sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import sweeps
+
+CANDIDATES_AXIS = "candidates"
+RESTARTS_AXIS = "restarts"
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None, restarts: int = 1
+) -> Mesh:
+    """A (restarts, candidates) mesh over the given (default: all) devices.
+
+    With ``restarts=1`` this is the plain 1-D candidate-sharding mesh; with
+    more, devices split between independent-restart batching and candidate
+    sharding."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % restarts == 0, (n, restarts)
+    arr = np.asarray(devices).reshape(restarts, n // restarts)
+    return Mesh(arr, (RESTARTS_AXIS, CANDIDATES_AXIS))
+
+
+class MeshPlan:
+    """Sharding helper bound to a mesh: placement of chunks and replicated
+    operands for the sweep kernels."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.n_candidate_shards = mesh.shape[CANDIDATES_AXIS]
+        self._sharded = NamedSharding(mesh, P(CANDIDATES_AXIS))
+        self._replicated = NamedSharding(mesh, P())
+
+    def shard_chunk(self, arr, fill=0):
+        """Places a [N, ...] candidate array sharded along axis 0, padding
+        with ``fill`` rows up to a shard multiple.
+
+        Callers choose ``fill`` so padded rows are inert: 0 for combo rows
+        (masked by a False valid bit), all-ones for packed constraint rows
+        (every cell conflicts, so they can never be selected).
+        """
+        n = arr.shape[0]
+        s = self.n_candidate_shards
+        if n % s:
+            arr = np.concatenate(
+                [
+                    np.asarray(arr),
+                    np.full((s - n % s,) + arr.shape[1:], fill, dtype=arr.dtype),
+                ]
+            )
+        return jax.device_put(arr, self._sharded)
+
+    def replicate(self, arr):
+        return jax.device_put(arr, self._replicated)
+
+
+@jax.jit
+def lut5_fused_step(tables, combos, valid, target, mask, w_tab, m_tab, seed):
+    """One fused, shardable 5-LUT search step: feasibility filter + split /
+    outer-function solve over a whole candidate chunk.
+
+    This is the multi-chip execution shape: ``combos``/``valid`` sharded on
+    the candidate axis, everything else replicated; the final any/argmax
+    reductions become cross-chip collectives under GSPMD.  Infeasible rows
+    are given all-conflicting constraints so they can never be selected.
+    Returns (found, combo_index, sel) with sel = split * 256 + outer_func.
+    """
+    feasible, req1p, req0p = sweeps.lut_filter(tables, combos, valid, target, mask)
+    full = jnp.uint32(0xFFFFFFFF)
+    req1p = jnp.where(feasible, req1p, full)
+    req0p = jnp.where(feasible, req0p, full)
+    found, best_t, sel = sweeps.lut5_solve(req1p, req0p, w_tab, m_tab, seed)
+    return found, best_t, sel
+
+
+def restart_batched_filter():
+    """vmap of the LUT feasibility filter over a leading restarts axis of
+    targets — the batch parallelism axis (multiple S-box outputs, permuted
+    boxes, or random restarts searched simultaneously)."""
+    return jax.vmap(sweeps.lut_filter, in_axes=(None, None, None, 0, None))

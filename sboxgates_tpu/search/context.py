@@ -43,6 +43,11 @@ class Options:
     lut_graph: bool = False
     randomize: bool = True
     try_nots: bool = False
+    # Fused 5-LUT mode: single-dispatch filter+solve per chunk (no host
+    # compaction round-trip).  Wins when feasibility is dense or when host
+    # syncs dominate (multi-host meshes); the default two-kernel path wins
+    # when the feasibility filter is very selective.
+    fused_lut5: bool = False
     avail_gates_bitfield: int = bf.DEFAULT_AVAILABLE
     verbosity: int = 0
     seed: Optional[int] = None
@@ -138,10 +143,16 @@ def pick_chunk(n: int, cap: int) -> int:
 
 
 class SearchContext:
-    """Derived state shared by every create_circuit call of one run."""
+    """Derived state shared by every create_circuit call of one run.
 
-    def __init__(self, opt: Options):
+    ``mesh_plan`` (a :class:`sboxgates_tpu.parallel.MeshPlan`) opts in to
+    multi-device execution: candidate chunks are sharded over the mesh's
+    candidate axis and small operands replicated; kernels are unchanged
+    (GSPMD partitions them)."""
+
+    def __init__(self, opt: Options, mesh_plan=None):
         self.opt = opt
+        self.mesh_plan = mesh_plan
         self.rng = np.random.default_rng(opt.seed)
         self.avail_gates = bf.create_avail_gates(opt.avail_gates_bitfield)
         self.avail_not = (
@@ -174,18 +185,33 @@ class SearchContext:
         return 12345
 
     def device_tables(self, st: State):
-        """Zero-padded [bucket, 8] live tables."""
+        """Zero-padded [bucket, 8] live tables (replicated across the mesh)."""
         g = st.num_gates
         b = bucket_size(g)
         padded = np.zeros((b, 8), dtype=np.uint32)
         padded[:g] = st.live_tables()
-        return jnp.asarray(padded), g
+        return self.place_replicated(padded), g
+
+    def place_chunk(self, arr, fill=0):
+        """Shards a [N, ...] candidate array over the mesh (no-op without one)."""
+        if self.mesh_plan is None:
+            return jnp.asarray(arr)
+        return self.mesh_plan.shard_chunk(np.asarray(arr), fill=fill)
+
+    def place_replicated(self, arr):
+        if self.mesh_plan is None:
+            return jnp.asarray(arr)
+        return self.mesh_plan.replicate(np.asarray(arr))
 
     def _pair_combos(self, bucket: int):
+        """Device-cached (and mesh-sharded) pair index grid per bucket."""
         if bucket not in self._pair_combo_cache:
             i, j = np.triu_indices(bucket, k=1)
             combos = np.stack([i, j], axis=1).astype(np.int32)
-            self._pair_combo_cache[bucket] = jnp.asarray(combos)
+            # pad fill is out-of-range so `combos < g` masks pad rows off
+            self._pair_combo_cache[bucket] = self.place_chunk(
+                combos, fill=np.int32(2**30)
+            )
         return self._pair_combo_cache[bucket]
 
     # -- sweep drivers ----------------------------------------------------
@@ -196,7 +222,11 @@ class SearchContext:
         tables, g = self.device_tables(st)
         valid = jnp.arange(tables.shape[0]) < g
         found, idx, inv = sweeps.match_scan(
-            tables, valid, jnp.asarray(target), jnp.asarray(mask), self.next_seed()
+            tables,
+            valid,
+            self.place_replicated(target),
+            self.place_replicated(mask),
+            self.next_seed(),
         )
         return bool(found), int(idx), bool(inv)
 
@@ -215,8 +245,8 @@ class SearchContext:
             tables,
             combos,
             valid,
-            jnp.asarray(target),
-            jnp.asarray(mask),
+            self.place_replicated(target),
+            self.place_replicated(mask),
             table,
             self.next_seed(),
             num_cells=4,
@@ -233,8 +263,8 @@ class SearchContext:
         Chunked stream with early exit.  Returns (found, gids, entry)."""
         g = st.num_gates
         tables, _ = self.device_tables(st)
-        target = jnp.asarray(target)
-        mask = jnp.asarray(mask)
+        target = self.place_replicated(target)
+        mask = self.place_replicated(mask)
         stream = comb.CombinationStream(g, 3)
         csize = pick_chunk(stream.total, TRIPLE_CHUNK)
         while True:
@@ -243,10 +273,10 @@ class SearchContext:
                 return False, None, None
             padded, nvalid = comb.pad_rows(chunk, csize)
             self.stats["triple_candidates"] += nvalid
-            valid = jnp.arange(csize) < nvalid
+            valid = self.place_chunk(np.arange(csize) < nvalid)
             res = sweeps.tuple_match_sweep(
                 tables,
-                jnp.asarray(padded),
+                self.place_chunk(padded),
                 valid,
                 target,
                 mask,

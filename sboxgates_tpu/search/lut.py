@@ -48,7 +48,7 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     if g < 3:
         return NO_GATE
     tables, _ = ctx.device_tables(st)
-    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
     stream = comb.CombinationStream(g, 3)
     csize = pick_chunk(stream.total, 1 << 17)
     while True:
@@ -57,9 +57,9 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
             return NO_GATE
         padded, nvalid = comb.pad_rows(chunk, csize)
         ctx.stats["lut3_candidates"] += nvalid
-        valid = jnp.arange(csize) < nvalid
+        valid = ctx.place_chunk(np.arange(csize) < nvalid)
         res = sweeps.lut3_sweep(
-            tables, jnp.asarray(padded), valid, jtarget, jmask, ctx.next_seed()
+            tables, ctx.place_chunk(padded), valid, jtarget, jmask, ctx.next_seed()
         )
         if bool(res.found):
             row = padded[int(res.index)]
@@ -79,19 +79,54 @@ def _combo_stream(g: int, k: int, inbits) -> Tuple[comb.CombinationStream, list]
     return comb.CombinationStream(g, k), excl
 
 
+def _decode_lut5(
+    ctx: SearchContext,
+    combo,
+    sigma: int,
+    func_outer: int,
+    req1_cells: np.ndarray,
+    req0_cells: np.ndarray,
+    splits,
+    w_tab,
+    m_tab,
+) -> dict:
+    """Reconstructs the inner LUT function for a device-selected
+    decomposition: group the 32 cells by (outer output, inner pattern)."""
+    a, b, c, d, e = (int(combo[p]) for p in splits[sigma])
+    wbits = _unpack32(w_tab[sigma, func_outer])
+    groups = np.zeros(32, dtype=np.int64)
+    for m in range(4):
+        mm = _unpack32(m_tab[sigma, m])
+        groups[mm & wbits] = 4 + m
+        groups[mm & ~wbits] = m
+    func_inner = sweeps.solve_inner_function(
+        req1_cells, req0_cells, groups, ctx.rng if ctx.opt.randomize else None
+    )
+    assert func_inner is not None, "device reported spurious 5-LUT hit"
+    return {
+        "func_outer": func_outer,
+        "func_inner": func_inner,
+        "gates": (a, b, c, d, e),
+    }
+
+
 def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
     """5-LUT search: find LUT(LUT(a,b,c), d, e) realizing the target
     (reference: search_5lut, lut.c:116-249).
 
-    Returns {outer_func, inner_func, gates: (a,b,c,d,e)} or None.
+    Returns {func_outer, func_inner, gates: (a,b,c,d,e)} or None.  Two
+    execution modes: the default filters feasibility then solves the
+    compacted survivors (best when the filter is selective); with
+    ``Options.fused_lut5`` each chunk runs the fused single-dispatch
+    filter+solve step with no host compaction round-trip.
     """
     g = st.num_gates
     if g < 5:
         return None
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
-    jw, jm = jnp.asarray(w_tab), jnp.asarray(m_tab)
+    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
     tables, _ = ctx.device_tables(st)
-    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
     stream, excl = _combo_stream(g, 5, inbits)
     csize = pick_chunk(stream.total, LUT5_CHUNK)
     while True:
@@ -101,11 +136,38 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         chunk = comb.filter_exclude(chunk, excl)
         padded, nvalid = comb.pad_rows(chunk, csize)
         ctx.stats["lut5_candidates"] += nvalid
-        valid = jnp.arange(csize) < nvalid
+        valid = ctx.place_chunk(np.arange(csize) < nvalid)
+
+        if ctx.opt.fused_lut5:
+            from ..parallel.mesh import lut5_fused_step
+
+            ctx.stats["lut5_solved"] += nvalid
+            found, best_t, sel = lut5_fused_step(
+                tables,
+                ctx.place_chunk(padded),
+                valid,
+                jtarget,
+                jmask,
+                jw,
+                jm,
+                ctx.next_seed(),
+            )
+            if not bool(found):
+                continue
+            combo = padded[int(best_t)]
+            sigma, func_outer = divmod(int(sel), 256)
+            req1_cells, req0_cells = sweeps.host_cell_constraints(
+                st.tables, combo, target, mask
+            )
+            return _decode_lut5(
+                ctx, combo, sigma, func_outer, req1_cells, req0_cells,
+                splits, w_tab, m_tab,
+            )
+
         feas, req1p, req0p = sweeps.lut_filter(
-            tables, jnp.asarray(padded), valid, jtarget, jmask
+            tables, ctx.place_chunk(padded), valid, jtarget, jmask
         )
-        feas = np.asarray(feas)
+        feas = np.asarray(feas)[:csize]
         if not feas.any():
             continue
         fidx = np.nonzero(feas)[0]
@@ -122,36 +184,21 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
             r0, _ = comb.pad_rows(freq0[lo:hi], scs, fill=0xFFFFFFFF)
             ctx.stats["lut5_solved"] += hi - lo
             found, best_t, sel = sweeps.lut5_solve(
-                jnp.asarray(r1), jnp.asarray(r0), jw, jm, ctx.next_seed()
+                ctx.place_chunk(r1, fill=0xFFFFFFFF),
+                ctx.place_chunk(r0, fill=0xFFFFFFFF),
+                jw,
+                jm,
+                ctx.next_seed(),
             )
             if not bool(found):
                 continue
             t = lo + int(best_t)
             sigma, func_outer = divmod(int(sel), 256)
-            combo = fcombos[t]
-            a, b, c, d, e = (int(combo[p]) for p in splits[sigma])
-            # Reconstruct the inner function on the host: group the 32 cells
-            # by (outer output, inner input pattern).
-            req1_cells = _unpack32(freq1[t])
-            req0_cells = _unpack32(freq0[t])
-            wbits = _unpack32(w_tab[sigma, func_outer])
-            groups = np.zeros(32, dtype=np.int64)
-            for m in range(4):
-                mm = _unpack32(m_tab[sigma, m])
-                groups[mm & wbits] = 4 + m
-                groups[mm & ~wbits] = m
-            func_inner = sweeps.solve_inner_function(
-                req1_cells,
-                req0_cells,
-                groups,
-                ctx.rng if ctx.opt.randomize else None,
+            return _decode_lut5(
+                ctx, fcombos[t], sigma, func_outer,
+                _unpack32(freq1[t]), _unpack32(freq0[t]),
+                splits, w_tab, m_tab,
             )
-            assert func_inner is not None, "device reported spurious 5-LUT hit"
-            return {
-                "func_outer": func_outer,
-                "func_inner": func_inner,
-                "gates": (a, b, c, d, e),
-            }
 
 
 def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
@@ -164,7 +211,7 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         return None
     orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
     tables, _ = ctx.device_tables(st)
-    jtarget, jmask = jnp.asarray(target), jnp.asarray(mask)
+    jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
     stream, excl = _combo_stream(g, 7, inbits)
 
     hit_combos: List[np.ndarray] = []
@@ -179,11 +226,11 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         chunk = comb.filter_exclude(chunk, excl)
         padded, nvalid = comb.pad_rows(chunk, csize)
         ctx.stats["lut7_candidates"] += nvalid
-        valid = jnp.arange(csize) < nvalid
+        valid = ctx.place_chunk(np.arange(csize) < nvalid)
         feas, req1p, req0p = sweeps.lut_filter(
-            tables, jnp.asarray(padded), valid, jtarget, jmask
+            tables, ctx.place_chunk(padded), valid, jtarget, jmask
         )
-        feas = np.asarray(feas)
+        feas = np.asarray(feas)[:csize]
         if feas.any():
             fidx = np.nonzero(feas)[0]
             hit_combos.append(padded[fidx])
@@ -199,14 +246,23 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         perm = ctx.rng.permutation(len(combos))
         combos, req1, req0 = combos[perm], req1[perm], req0[perm]
 
-    jwo, jwm, jg = jnp.asarray(wo_tab), jnp.asarray(wm_tab), jnp.asarray(g_tab)
+    jwo, jwm, jg = (
+        ctx.place_replicated(wo_tab),
+        ctx.place_replicated(wm_tab),
+        ctx.place_replicated(g_tab),
+    )
     for lo in range(0, len(combos), LUT7_SOLVE_CHUNK):
         hi = min(lo + LUT7_SOLVE_CHUNK, len(combos))
         r1, _ = comb.pad_rows(req1[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
         r0, _ = comb.pad_rows(req0[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
         ctx.stats["lut7_solved"] += hi - lo
         found, best_t, sigma, flat = sweeps.lut7_solve(
-            jnp.asarray(r1), jnp.asarray(r0), jwo, jwm, jg, ctx.next_seed()
+            ctx.place_chunk(r1, fill=0xFFFFFFFF),
+            ctx.place_chunk(r0, fill=0xFFFFFFFF),
+            jwo,
+            jwm,
+            jg,
+            ctx.next_seed(),
         )
         if not bool(found):
             continue
