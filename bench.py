@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Benchmark: 5-LUT candidate sweep throughput on the AES S-box.
+
+The north-star metric (BASELINE.json) is LUT candidates/sec/chip on the
+Rijndael S-box.  One candidate = one 5-combination of gates examined for a
+LUT(LUT(a,b,c),d,e) decomposition of target output bit 0 — the unit the
+reference's search_5lut partitions over MPI ranks (lut.c:116-249).
+
+Two measurements:
+
+- **device**: the framework's fused filter+solve sweep
+  (sboxgates_tpu.parallel.mesh.lut5_fused_step) streamed over the full
+  C(G,5) space on the default JAX backend, end to end (host combination
+  streaming included).
+- **cpu baseline**: the reference-shaped single-core C++ loop
+  (csrc/runtime.cpp: sbg_lut5_search_cpu — same semantics and per-candidate
+  work shape as the reference's serial inner loop; the reference binary
+  itself needs MPI + libxml2, not present in this image).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+G = 40          # gates in the bench state: C(40,5) = 658,008 candidates
+CHUNK = 1 << 17
+CPU_COMBOS = 1 << 16
+REPEATS = 3     # timed full-space sweeps (device path)
+
+
+def build_state():
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.utils.sbox import parse_sbox
+
+    with open("sboxes/rijndael.txt") as f:
+        sbox, n = parse_sbox(f.read())
+    st = State.init_inputs(n)
+    rng = np.random.default_rng(0)
+    while st.num_gates < G:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    return st, tt.target_table(sbox, 0), tt.mask_table(n)
+
+
+def bench_device(st, target, mask) -> float:
+    """Full C(G,5) sweep throughput (candidates/sec/chip) on the default
+    JAX backend."""
+    import jax
+
+    from sboxgates_tpu.ops import combinatorics as comb
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.parallel.mesh import lut5_fused_step
+
+    n_chips = max(1, jax.local_device_count())
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    tables = np.zeros((64, 8), dtype=np.uint32)
+    tables[:G] = st.live_tables()
+    jt = jax.device_put(tables)
+    jtarget, jmask = jax.device_put(np.asarray(target)), jax.device_put(np.asarray(mask))
+    jw, jm = jax.device_put(w_tab), jax.device_put(m_tab)
+
+    def sweep() -> int:
+        stream = comb.CombinationStream(G, 5)
+        n = 0
+        while True:
+            chunk = stream.next_chunk(CHUNK)
+            if chunk is None:
+                return n
+            padded, nvalid = comb.pad_rows(chunk, CHUNK)
+            valid = np.arange(CHUNK) < nvalid
+            found, _, _ = lut5_fused_step(
+                jt, jax.device_put(padded), jax.device_put(valid),
+                jtarget, jmask, jw, jm, 7,
+            )
+            n += nvalid
+            assert not bool(found)  # AES bit 0 from XOR layers: no hit
+
+    sweep()  # warmup: jit compile + cache combination chunks
+    t0 = time.perf_counter()
+    total = sum(sweep() for _ in range(REPEATS))
+    dt = time.perf_counter() - t0
+    return total / dt / n_chips
+
+
+def bench_cpu_baseline(st, target, mask) -> float:
+    """Reference-shaped serial C++ loop, candidates/sec on one core."""
+    from sboxgates_tpu import native
+    from sboxgates_tpu.ops import combinatorics as comb
+
+    if not native.available():
+        return float("nan")
+    combos = comb.CombinationStream(G, 5).next_chunk(CPU_COMBOS)
+    t64 = native.tables32_to_64(st.live_tables())
+    tg64 = native.tables32_to_64(np.asarray(target))
+    mk64 = native.tables32_to_64(np.asarray(mask))
+    native.lut5_search_cpu(t64, tg64, mk64, combos[:1024])  # warmup
+    t0 = time.perf_counter()
+    idx, _ = native.lut5_search_cpu(t64, tg64, mk64, combos)
+    dt = time.perf_counter() - t0
+    assert idx == -1
+    return combos.shape[0] / dt
+
+
+def main() -> None:
+    st, target, mask = build_state()
+    cpu = bench_cpu_baseline(st, target, mask)
+    dev = bench_device(st, target, mask)
+    vs = dev / cpu if cpu == cpu and cpu > 0 else float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "lut5_candidates_per_sec_per_chip_aes",
+                "value": round(dev, 1),
+                "unit": "candidates/s",
+                "vs_baseline": round(vs, 3) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

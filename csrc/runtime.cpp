@@ -1,0 +1,296 @@
+// Native host runtime for sboxgates_tpu.
+//
+// The reference implementation's runtime is C99 (truth-table primitives,
+// combination unranking, XML-state fingerprinting, and the per-process LUT
+// search inner loop; see /root/reference/state.c, lut.c).  This library is
+// the TPU framework's native counterpart: the device compute path is
+// JAX/XLA, while the host-side runtime pieces that want native speed live
+// here behind a plain C ABI consumed via ctypes
+// (sboxgates_tpu/native/__init__.py):
+//
+//  - sbg_fingerprint:        Speck-round state hash (state.c:56-105 parity)
+//  - sbg_combinations_from_rank: combinatorial unranking + successor
+//                            streaming (lut.c:635-662, 743-758 parity)
+//  - sbg_execute_circuit:    bitslice circuit interpreter over 256-bit
+//                            truth tables (the native validation/execution
+//                            backend for loaded XML graphs)
+//  - sbg_lut5_search_cpu:    a faithful single-core implementation of the
+//                            reference's 5-LUT search inner loop
+//                            (lut.c:116-249 semantics), used by bench.py as
+//                            the measured CPU-baseline for candidates/sec
+//                            comparisons (the reference binary itself needs
+//                            MPI + libxml2, unavailable in this image).
+//
+// Build: see csrc/Makefile (g++ -O3 -march=native -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// 256-bit truth tables as uint64[4], LSB-first global bit order
+// ---------------------------------------------------------------------
+
+struct TT {
+  uint64_t w[4];
+};
+
+inline TT tt_and(const TT& a, const TT& b) {
+  return {a.w[0] & b.w[0], a.w[1] & b.w[1], a.w[2] & b.w[2], a.w[3] & b.w[3]};
+}
+inline TT tt_or(const TT& a, const TT& b) {
+  return {a.w[0] | b.w[0], a.w[1] | b.w[1], a.w[2] | b.w[2], a.w[3] | b.w[3]};
+}
+inline TT tt_xor(const TT& a, const TT& b) {
+  return {a.w[0] ^ b.w[0], a.w[1] ^ b.w[1], a.w[2] ^ b.w[2], a.w[3] ^ b.w[3]};
+}
+inline TT tt_not(const TT& a) { return {~a.w[0], ~a.w[1], ~a.w[2], ~a.w[3]}; }
+inline bool tt_any(const TT& a) { return (a.w[0] | a.w[1] | a.w[2] | a.w[3]) != 0; }
+
+// 2-input gate evaluation: the gate-type nibble is the function's truth
+// table with f(1,1)=bit0, f(1,0)=bit1, f(0,1)=bit2, f(0,0)=bit3
+// (reference get_val, boolfunc.c:22-25).  Sum of minterms.
+inline TT tt_gate2(int fun, const TT& a, const TT& b) {
+  TT r = {0, 0, 0, 0};
+  if (fun & 1) r = tt_or(r, tt_and(a, b));
+  if (fun & 2) r = tt_or(r, tt_and(a, tt_not(b)));
+  if (fun & 4) r = tt_or(r, tt_and(tt_not(a), b));
+  if (fun & 8) r = tt_or(r, tt_and(tt_not(a), tt_not(b)));
+  return r;
+}
+
+// 3-input LUT evaluation: bit k of func is the output for A<<2|B<<1|C
+// (reference generate_lut_ttable, state.c:202-230).
+inline TT tt_lut(int func, const TT& a, const TT& b, const TT& c) {
+  TT r = {0, 0, 0, 0};
+  for (int k = 0; k < 8; k++) {
+    if (!((func >> k) & 1)) continue;
+    TT m = (k & 4) ? a : tt_not(a);
+    m = tt_and(m, (k & 2) ? b : tt_not(b));
+    m = tt_and(m, (k & 1) ? c : tt_not(c));
+    r = tt_or(r, m);
+  }
+  return r;
+}
+
+// Gate-type enum values shared with sboxgates_tpu.core.boolfunc.
+enum { GT_NOT = 16, GT_IN = 17, GT_LUT = 18 };
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Speck-round fingerprint (byte-stream form of state.c:56-105)
+// ---------------------------------------------------------------------
+
+uint32_t sbg_fingerprint(const uint8_t* data, uint64_t len) {
+  uint16_t p1 = 0, p2 = 0;
+  auto round_ = [&](uint16_t k) {
+    p1 = (uint16_t)((p1 >> 7) | (p1 << 9));
+    p1 = (uint16_t)(p1 + p2);
+    p2 = (uint16_t)((p2 >> 14) | (p2 << 2));
+    p1 ^= k;
+    p2 ^= p1;
+  };
+  for (uint64_t i = 0; i + 1 < len; i += 2) {
+    round_((uint16_t)(data[i] | (data[i + 1] << 8)));
+  }
+  for (int i = 0; i < 22; i++) round_(0);
+  return ((uint32_t)p1 << 16) | p2;
+}
+
+// ---------------------------------------------------------------------
+// Combination streaming: unrank the `rank`-th k-combination of {0..g-1}
+// in lexicographic order, then step with the successor rule.
+// (Counterparts: get_nth_combination lut.c:635-662, next_combination
+// lut.c:743-758 — re-derived, not transcribed.)
+// ---------------------------------------------------------------------
+
+static uint64_t n_choose_k(uint64_t n, uint64_t k) {
+  if (k > n) return 0;
+  if (k > n - k) k = n - k;
+  uint64_t r = 1;
+  for (uint64_t i = 0; i < k; i++) {
+    r = r * (n - i) / (i + 1);
+  }
+  return r;
+}
+
+uint64_t sbg_n_choose_k(uint64_t n, uint64_t k) { return n_choose_k(n, k); }
+
+// Fills out[count][k]; returns the number of combinations written (fewer
+// than `count` when the space is exhausted).
+int64_t sbg_combinations_from_rank(int32_t g, int32_t k, uint64_t rank,
+                                   int64_t count, int32_t* out) {
+  uint64_t total = n_choose_k((uint64_t)g, (uint64_t)k);
+  if (rank >= total) return 0;
+  // Unrank: choose the smallest first element whose suffix space covers rank.
+  int32_t combo[16];
+  uint64_t r = rank;
+  int32_t lo = 0;
+  for (int32_t i = 0; i < k; i++) {
+    for (int32_t v = lo;; v++) {
+      uint64_t below = n_choose_k((uint64_t)(g - v - 1), (uint64_t)(k - i - 1));
+      if (r < below) {
+        combo[i] = v;
+        lo = v + 1;
+        break;
+      }
+      r -= below;
+    }
+  }
+  int64_t written = 0;
+  for (;;) {
+    for (int32_t i = 0; i < k; i++) out[written * k + i] = combo[i];
+    written++;
+    if (written >= count) break;
+    // successor: bump the rightmost index that can still grow
+    int32_t i = k - 1;
+    while (i >= 0 && combo[i] == g - k + i) i--;
+    if (i < 0) break;  // space exhausted
+    combo[i]++;
+    for (int32_t j = i + 1; j < k; j++) combo[j] = combo[j - 1] + 1;
+  }
+  return written;
+}
+
+// ---------------------------------------------------------------------
+// Bitslice circuit interpreter (native execution backend)
+// ---------------------------------------------------------------------
+
+// Evaluates every gate's 256-bit truth table in topological (index) order.
+// types/in1/in2/in3/funcs: per-gate arrays using the shared enum; IN gates
+// read consecutive rows of in_tables.  Writes num_gates rows (4 x uint64
+// each) to out_tables.  Returns 0 on success, -1 on malformed input.
+int32_t sbg_execute_circuit(int32_t num_gates, const int32_t* types,
+                            const int32_t* in1, const int32_t* in2,
+                            const int32_t* in3, const uint8_t* funcs,
+                            const uint64_t* in_tables, uint64_t* out_tables) {
+  TT* t = reinterpret_cast<TT*>(out_tables);
+  int32_t next_input = 0;
+  for (int32_t i = 0; i < num_gates; i++) {
+    int32_t ty = types[i];
+    if (ty == GT_IN) {
+      std::memcpy(t[i].w, in_tables + 4 * next_input++, sizeof(TT));
+    } else if (ty == GT_NOT) {
+      if (in1[i] < 0 || in1[i] >= i) return -1;
+      t[i] = tt_not(t[in1[i]]);
+    } else if (ty == GT_LUT) {
+      if (in1[i] < 0 || in1[i] >= i || in2[i] < 0 || in2[i] >= i ||
+          in3[i] < 0 || in3[i] >= i)
+        return -1;
+      t[i] = tt_lut(funcs[i], t[in1[i]], t[in2[i]], t[in3[i]]);
+    } else if (ty >= 0 && ty <= 15) {
+      if (in1[i] < 0 || in1[i] >= i || in2[i] < 0 || in2[i] >= i) return -1;
+      t[i] = tt_gate2(ty, t[in1[i]], t[in2[i]]);
+    } else {
+      return -1;
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Reference-shaped 5-LUT CPU search (the bench baseline)
+// ---------------------------------------------------------------------
+
+namespace {
+
+// Can ANY function of the n given tables realize target under mask?
+// Direct cell formulation of the reference's recursive partition test
+// (check_n_lut_possible, lut.c:34-66).
+inline bool lut_feasible(const TT* tabs, int n, const TT& need1,
+                         const TT& need0) {
+  int cells = 1 << n;
+  for (int c = 0; c < cells; c++) {
+    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+    for (int i = 0; i < n; i++) {
+      const TT& t = tabs[i];
+      m = tt_and(m, ((c >> (n - 1 - i)) & 1) ? t : tt_not(t));
+    }
+    if (tt_any(tt_and(m, need1)) && tt_any(tt_and(m, need0))) return false;
+  }
+  return true;
+}
+
+// Bit-serial derivation of the unique-if-consistent 3-input LUT function
+// mapping (a, b, c) to target under mask — the same per-position walk as
+// the reference's get_lut_function (lut.c:79-109).
+inline bool solve_lut_function(const TT& a, const TT& b, const TT& c,
+                               const TT& target, const TT& mask,
+                               uint8_t* func_out) {
+  uint8_t func = 0, setb = 0;
+  for (int w = 0; w < 4; w++) {
+    uint64_t aw = a.w[w], bw = b.w[w], cw = c.w[w];
+    uint64_t tw = target.w[w], mw = mask.w[w];
+    for (int bit = 0; bit < 64; bit++) {
+      if (mw & 1) {
+        int idx = (int)(((aw & 1) << 2) | ((bw & 1) << 1) | (cw & 1));
+        uint8_t want = (uint8_t)(tw & 1);
+        if (setb & (1 << idx)) {
+          if (((func >> idx) & 1) != want) return false;
+        } else {
+          setb |= (uint8_t)(1 << idx);
+          func |= (uint8_t)(want << idx);
+        }
+      }
+      aw >>= 1; bw >>= 1; cw >>= 1; tw >>= 1; mw >>= 1;
+    }
+  }
+  *func_out = func;
+  return true;
+}
+
+// The 10 ways to pick the outer LUT's 3 inputs out of 5 (C(5,3); the inner
+// LUT gets the outer output + the remaining 2 inputs).
+static const int SPLITS5[10][5] = {
+    {0, 1, 2, 3, 4}, {0, 1, 3, 2, 4}, {0, 1, 4, 2, 3}, {0, 2, 3, 1, 4},
+    {0, 2, 4, 1, 3}, {0, 3, 4, 1, 2}, {1, 2, 3, 0, 4}, {1, 2, 4, 0, 3},
+    {1, 3, 4, 0, 2}, {2, 3, 4, 0, 1}};
+
+}  // namespace
+
+// Scans `n` 5-combinations (combos[n][5], indices into tables[g][4]) for a
+// LUT(LUT(a,b,c),d,e) decomposition of target-under-mask, with the
+// reference's per-candidate work shape: feasibility filter, then 10 splits
+// x 256 outer functions, each evaluating an outer truth table and
+// bit-serially solving the inner function.  Returns the index of the first
+// hit (writing {outer_func, inner_func, a,b,c,d,e} to result7) or -1.
+int64_t sbg_lut5_search_cpu(const uint64_t* tables, int32_t g,
+                            const uint64_t* target, const uint64_t* mask,
+                            const int32_t* combos, int64_t n,
+                            int32_t* result7) {
+  (void)g;
+  const TT* T = reinterpret_cast<const TT*>(tables);
+  TT tgt, msk;
+  std::memcpy(tgt.w, target, sizeof(TT));
+  std::memcpy(msk.w, mask, sizeof(TT));
+  const TT need1 = tt_and(msk, tgt);
+  const TT need0 = tt_and(msk, tt_not(tgt));
+  for (int64_t i = 0; i < n; i++) {
+    const int32_t* cmb = combos + i * 5;
+    TT tabs[5];
+    for (int j = 0; j < 5; j++) tabs[j] = T[cmb[j]];
+    if (!lut_feasible(tabs, 5, need1, need0)) continue;
+    for (int s = 0; s < 10; s++) {
+      const int* sp = SPLITS5[s];
+      const TT &a = tabs[sp[0]], &b = tabs[sp[1]], &c = tabs[sp[2]];
+      const TT &d = tabs[sp[3]], &e = tabs[sp[4]];
+      for (int f = 0; f < 256; f++) {
+        TT outer = tt_lut(f, a, b, c);
+        uint8_t inner;
+        if (solve_lut_function(outer, d, e, tgt, msk, &inner)) {
+          result7[0] = f;
+          result7[1] = inner;
+          for (int j = 0; j < 5; j++) result7[2 + j] = cmb[sp[j]];
+          return i;
+        }
+      }
+    }
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,255 @@
+"""ctypes bindings for the native host runtime (csrc/runtime.cpp).
+
+The library is built on demand with g++ the first time it's needed and
+cached next to this file.  Every entry point has a pure-Python fallback in
+the main package, so the framework degrades gracefully when no C++
+toolchain is present: callers check :func:`available` or catch
+:class:`NativeUnavailable`.
+
+Exposed surface (mirrors the C ABI):
+
+- :func:`fingerprint`          — Speck-round hash of a byte string
+- :func:`combinations_from_rank` — stream k-combinations lexicographically
+- :func:`execute_circuit`      — bitslice interpreter for a gate program
+- :func:`lut5_search_cpu`      — reference-shaped CPU 5-LUT search
+  (the measured baseline for bench.py)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libsboxg_runtime.so")
+_SRC_PATH = os.path.join(_HERE, "..", "..", "csrc", "runtime.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+class NativeUnavailable(RuntimeError):
+    """The native runtime could not be built or loaded."""
+
+
+def _build() -> Optional[str]:
+    """Compiles the shared library; returns an error string or None."""
+    src = os.path.abspath(_SRC_PATH)
+    if not os.path.exists(src):
+        return f"source not found: {src}"
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        _LIB_PATH,
+        src,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"compiler launch failed: {e}"
+    if proc.returncode != 0:
+        return f"compile failed: {proc.stderr[-2000:]}"
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        src_mtime = (
+            os.path.getmtime(_SRC_PATH) if os.path.exists(_SRC_PATH) else 0
+        )
+        if (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH) < src_mtime
+        ):
+            _build_error = _build()
+            if _build_error is not None:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = f"dlopen failed: {e}"
+            return None
+
+        lib.sbg_fingerprint.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+        ]
+        lib.sbg_fingerprint.restype = ctypes.c_uint32
+
+        lib.sbg_n_choose_k.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.sbg_n_choose_k.restype = ctypes.c_uint64
+
+        lib.sbg_combinations_from_rank.argtypes = [
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_combinations_from_rank.restype = ctypes.c_int64
+
+        lib.sbg_execute_circuit.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbg_execute_circuit.restype = ctypes.c_int32
+
+        lib.sbg_lut5_search_cpu.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_lut5_search_cpu.restype = ctypes.c_int64
+
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def _require() -> ctypes.CDLL:
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable(_build_error or "unknown load failure")
+    return lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# -- wrappers -------------------------------------------------------------
+
+
+def fingerprint(data: bytes) -> int:
+    lib = _require()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return int(lib.sbg_fingerprint(buf, len(data)))
+
+
+def n_choose_k(n: int, k: int) -> int:
+    return int(_require().sbg_n_choose_k(n, k))
+
+
+def combinations_from_rank(
+    g: int, k: int, rank: int, count: int
+) -> np.ndarray:
+    """Up to ``count`` consecutive lexicographic k-combinations of
+    {0..g-1} starting at ``rank``, as int32[written, k]."""
+    lib = _require()
+    out = np.empty((count, k), dtype=np.int32)
+    written = lib.sbg_combinations_from_rank(
+        g, k, rank, count, _ptr(out, ctypes.c_int32)
+    )
+    return out[:written]
+
+
+def execute_circuit(
+    types: np.ndarray,
+    in1: np.ndarray,
+    in2: np.ndarray,
+    in3: np.ndarray,
+    funcs: np.ndarray,
+    input_tables64: np.ndarray,
+) -> np.ndarray:
+    """Evaluates every gate's 256-bit truth table; returns uint64[G, 4]."""
+    lib = _require()
+    g = len(types)
+    types = np.ascontiguousarray(types, dtype=np.int32)
+    in1 = np.ascontiguousarray(in1, dtype=np.int32)
+    in2 = np.ascontiguousarray(in2, dtype=np.int32)
+    in3 = np.ascontiguousarray(in3, dtype=np.int32)
+    funcs = np.ascontiguousarray(funcs, dtype=np.uint8)
+    itab = np.ascontiguousarray(input_tables64, dtype=np.uint64)
+    out = np.empty((g, 4), dtype=np.uint64)
+    rc = lib.sbg_execute_circuit(
+        g,
+        _ptr(types, ctypes.c_int32),
+        _ptr(in1, ctypes.c_int32),
+        _ptr(in2, ctypes.c_int32),
+        _ptr(in3, ctypes.c_int32),
+        _ptr(funcs, ctypes.c_uint8),
+        _ptr(itab, ctypes.c_uint64),
+        _ptr(out, ctypes.c_uint64),
+    )
+    if rc != 0:
+        raise ValueError("malformed circuit program")
+    return out
+
+
+def lut5_search_cpu(
+    tables64: np.ndarray,
+    target64: np.ndarray,
+    mask64: np.ndarray,
+    combos: np.ndarray,
+) -> Tuple[int, Optional[dict]]:
+    """Reference-shaped serial 5-LUT search over the given combinations.
+
+    Returns (hit_index, decomposition) with hit_index -1 when no
+    combination admits a decomposition."""
+    lib = _require()
+    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
+    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
+    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
+    combos = np.ascontiguousarray(combos, dtype=np.int32)
+    res = np.zeros(7, dtype=np.int32)
+    idx = lib.sbg_lut5_search_cpu(
+        _ptr(tables64, ctypes.c_uint64),
+        tables64.shape[0],
+        _ptr(target64, ctypes.c_uint64),
+        _ptr(mask64, ctypes.c_uint64),
+        _ptr(combos, ctypes.c_int32),
+        combos.shape[0],
+        _ptr(res, ctypes.c_int32),
+    )
+    if idx < 0:
+        return -1, None
+    return int(idx), {
+        "func_outer": int(res[0]),
+        "func_inner": int(res[1]),
+        "gates": tuple(int(x) for x in res[2:7]),
+    }
+
+
+def tables32_to_64(tables32: np.ndarray) -> np.ndarray:
+    """uint32[..., 8] ttables -> the uint64[..., 4] layout the C ABI uses."""
+    t = np.ascontiguousarray(tables32, dtype=np.uint32)
+    assert t.shape[-1] == 8
+    return t.view(np.uint64) if t.dtype.byteorder in ("=", "<", "|") else (
+        t.astype("<u4").view(np.uint64)
+    )

@@ -23,6 +23,24 @@ def n_choose_k(n: int, k: int) -> int:
     return comb(n, k)
 
 
+_native_ok: Optional[bool] = None
+
+
+def _native_stream_available() -> bool:
+    """The native combination generator (csrc/runtime.cpp) is preferred for
+    chunk materialization; probed once, with the pure-Python iterator as the
+    fallback."""
+    global _native_ok
+    if _native_ok is None:
+        try:
+            from .. import native
+
+            _native_ok = native.available()
+        except Exception:
+            _native_ok = False
+    return _native_ok
+
+
 def unrank_combination(rank: int, n: int, k: int) -> np.ndarray:
     """The rank'th k-combination of {0..n-1} in lexicographic order.
 
@@ -103,6 +121,14 @@ class CombinationStream:
         take = min(chunk, self.remaining)
         if take <= 0:
             return None
+        if _native_stream_available():
+            from .. import native
+
+            rows_arr = native.combinations_from_rank(self.n, self.k, self.pos, take)
+            self.pos += rows_arr.shape[0]
+            if rows_arr.shape[0] == 0:
+                return None
+            return rows_arr
         rows = list(itertools.islice(self._it, take))
         self.pos += len(rows)
         if not rows:

@@ -1,0 +1,164 @@
+"""Native runtime (csrc/runtime.cpp) vs. the pure-Python/JAX paths."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu import native
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import GATES, State
+from sboxgates_tpu.graph import xmlio
+from sboxgates_tpu.ops import combinatorics as comb
+from sboxgates_tpu.utils.sbox import parse_sbox
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib unavailable: {native.build_error()}"
+)
+
+
+def _state_bytes(st: State) -> bytes:
+    """The serialized layout state_fingerprint absorbs (xmlio docstring)."""
+    import struct
+
+    parts = [
+        struct.pack(
+            "<iiHH8H4x",
+            0,
+            0,
+            st.max_gates & 0xFFFF,
+            st.num_gates & 0xFFFF,
+            *[o & 0xFFFF for o in st.outputs],
+        )
+    ]
+    for i, g in enumerate(st.gates):
+        parts.append(st.tables[i].astype("<u4").tobytes())
+        parts.append(
+            struct.pack(
+                "<iHHHB21x",
+                g.type,
+                g.in1 & 0xFFFF,
+                g.in2 & 0xFFFF,
+                g.in3 & 0xFFFF,
+                g.function & 0xFF,
+            )
+        )
+    return b"".join(parts)
+
+
+def _rand_state(seed: int, num_inputs: int = 6, extra: int = 10) -> State:
+    rng = np.random.default_rng(seed)
+    st = State.init_inputs(num_inputs)
+    while st.num_gates < num_inputs + extra:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            st.add_gate(int(rng.choice([bf.AND, bf.OR, bf.XOR, bf.NAND])), int(a), int(b), GATES)
+        elif kind == 1:
+            st.add_not_gate(int(a), GATES)
+        else:
+            c = int(rng.choice([x for x in range(st.num_gates) if x not in (a, b)]))
+            st.add_lut(int(rng.integers(1, 256)), int(a), int(b), c)
+    st.outputs[0] = st.num_gates - 1
+    return st
+
+
+def test_fingerprint_matches_python():
+    for seed in range(5):
+        st = _rand_state(seed)
+        assert native.fingerprint(_state_bytes(st)) == xmlio.state_fingerprint(st)
+
+
+def test_n_choose_k():
+    for n in (0, 1, 7, 30, 100):
+        for k in (0, 1, 3, 5, 7):
+            assert native.n_choose_k(n, k) == comb.n_choose_k(n, k)
+
+
+def test_combinations_from_rank_full_space():
+    ref = np.asarray(list(itertools.combinations(range(9), 4)), dtype=np.int32)
+    got = native.combinations_from_rank(9, 4, 0, 1000)
+    assert got.shape == ref.shape
+    assert (got == ref).all()
+
+
+def test_combinations_from_rank_mid_stream():
+    ref = np.asarray(list(itertools.combinations(range(12), 5)), dtype=np.int32)
+    got = native.combinations_from_rank(12, 5, 100, 57)
+    assert (got == ref[100:157]).all()
+    # tail clipping
+    total = comb.n_choose_k(12, 5)
+    got = native.combinations_from_rank(12, 5, total - 3, 10)
+    assert got.shape[0] == 3
+    assert (got == ref[-3:]).all()
+
+
+def test_stream_uses_native_and_matches_python():
+    stream = comb.CombinationStream(10, 3, start=17)
+    rows = stream.next_chunk(25)
+    ref = np.asarray(list(itertools.combinations(range(10), 3)), dtype=np.int32)
+    assert (rows == ref[17:42]).all()
+
+
+def test_execute_circuit_matches_state_tables():
+    for seed in range(5):
+        st = _rand_state(seed, num_inputs=5, extra=12)
+        g = st.num_gates
+        types = np.array([x.type for x in st.gates], dtype=np.int32)
+        in1 = np.array([x.in1 if x.in1 != 0xFFFF else -1 for x in st.gates], dtype=np.int32)
+        in2 = np.array([x.in2 if x.in2 != 0xFFFF else -1 for x in st.gates], dtype=np.int32)
+        in3 = np.array([x.in3 if x.in3 != 0xFFFF else -1 for x in st.gates], dtype=np.int32)
+        funcs = np.array([x.function for x in st.gates], dtype=np.uint8)
+        n_in = st.num_inputs
+        itab = native.tables32_to_64(st.tables[:n_in])
+        out = native.execute_circuit(types, in1, in2, in3, funcs, itab)
+        expect = native.tables32_to_64(st.live_tables())
+        assert (out == expect).all(), f"seed {seed}"
+
+
+def test_lut5_search_cpu_finds_planted_decomposition():
+    st = State.init_inputs(8)
+    rng = np.random.default_rng(7)
+    while st.num_gates < 12:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x6B, st.table(2), st.table(5), st.table(7))
+    target = tt.eval_lut(0x9C, outer, st.table(3), st.table(9))
+    mask = tt.mask_table(8)
+
+    stream = comb.CombinationStream(st.num_gates, 5)
+    combos = stream.next_chunk(1 << 12)
+    idx, res = native.lut5_search_cpu(
+        native.tables32_to_64(st.live_tables()),
+        native.tables32_to_64(target),
+        native.tables32_to_64(mask),
+        combos,
+    )
+    assert idx >= 0
+    a, b, c, d, e = res["gates"]
+    got = tt.eval_lut(
+        res["func_inner"],
+        tt.eval_lut(res["func_outer"], st.table(a), st.table(b), st.table(c)),
+        st.table(d),
+        st.table(e),
+    )
+    assert bool(tt.eq_mask(got, target, mask))
+
+
+def test_lut5_search_cpu_no_false_positives():
+    with open("sboxes/rijndael.txt") as f:
+        sbox, n = parse_sbox(f.read())
+    st = State.init_inputs(8)
+    rng = np.random.default_rng(1)
+    while st.num_gates < 11:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    # AES bit 0 is far beyond a single 5-LUT of XOR layers: must be a miss.
+    idx, res = native.lut5_search_cpu(
+        native.tables32_to_64(st.live_tables()),
+        native.tables32_to_64(tt.target_table(sbox, 0)),
+        native.tables32_to_64(tt.mask_table(n)),
+        comb.CombinationStream(st.num_gates, 5).next_chunk(1 << 9),
+    )
+    assert idx == -1 and res is None
