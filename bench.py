@@ -8,10 +8,9 @@ reference's search_5lut partitions over MPI ranks (lut.c:116-249).
 
 Two measurements:
 
-- **device**: the framework's fused filter+solve sweep
-  (sboxgates_tpu.parallel.mesh.lut5_fused_step) streamed over the full
-  C(G,5) space on the default JAX backend, end to end (host combination
-  streaming included).
+- **device**: the framework's real search path — one `lut5_search` call,
+  which sweeps the full C(G,5) space inside a single jitted while_loop
+  dispatch with device-side unranking (sboxgates_tpu.search.lut).
 - **cpu baseline**: the reference-shaped single-core C++ loop
   (csrc/runtime.cpp: sbg_lut5_search_cpu — same semantics and per-candidate
   work shape as the reference's serial inner loop; the reference binary
@@ -23,12 +22,16 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 import time
 
 import numpy as np
 
-G = 40          # gates in the bench state: C(40,5) = 658,008 candidates
-CHUNK = 1 << 17
+G = 80          # gates in the bench state (mid-LUT-search scale): C(80,5) = 24,040,016
 CPU_COMBOS = 1 << 16
 REPEATS = 3     # timed full-space sweeps (device path)
 
@@ -50,43 +53,34 @@ def build_state():
 
 
 def bench_device(st, target, mask) -> float:
-    """Full C(G,5) sweep throughput (candidates/sec/chip) on the default
-    JAX backend."""
+    """Full C(G,5) sweep throughput (candidates/sec/chip) through the real
+    search path: one `lut5_search` call sweeps the whole space inside a
+    single jitted while_loop dispatch (device-side unranking; no hit for
+    AES bit 0 over XOR layers, so the full space is examined)."""
     import jax
 
-    from sboxgates_tpu.ops import combinatorics as comb
-    from sboxgates_tpu.ops import sweeps
-    from sboxgates_tpu.parallel.mesh import lut5_fused_step
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.lut import lut5_search
 
-    n_chips = max(1, jax.local_device_count())
-    _, w_tab, m_tab = sweeps.lut5_split_tables()
-    tables = np.zeros((64, 8), dtype=np.uint32)
-    tables[:G] = st.live_tables()
-    jt = jax.device_put(tables)
-    jtarget, jmask = jax.device_put(np.asarray(target)), jax.device_put(np.asarray(mask))
-    jw, jm = jax.device_put(w_tab), jax.device_put(m_tab)
+    # The jitted stream executes on a single chip (no mesh plan), so the
+    # per-chip rate is the measured rate regardless of how many devices the
+    # host exposes.
+    n_chips = 1
+    ctx = SearchContext(Options(seed=1, lut_graph=True))
 
-    def sweep() -> int:
-        stream = comb.CombinationStream(G, 5)
-        n = 0
-        while True:
-            chunk = stream.next_chunk(CHUNK)
-            if chunk is None:
-                return n
-            padded, nvalid = comb.pad_rows(chunk, CHUNK)
-            valid = np.arange(CHUNK) < nvalid
-            found, _, _ = lut5_fused_step(
-                jt, jax.device_put(padded), jax.device_put(valid),
-                jtarget, jmask, jw, jm, 7,
-            )
-            n += nvalid
-            assert not bool(found)  # AES bit 0 from XOR layers: no hit
+    def run():
+        # AES bit 0 over XOR layers admits no 5-LUT: a hit means the bench
+        # state is wrong and the sweep stopped early.
+        if lut5_search(ctx, st, target, mask, []) is not None:
+            raise RuntimeError("unexpected 5-LUT hit in bench state")
 
-    sweep()  # warmup: jit compile + cache combination chunks
+    run()  # warmup/compile
+    base = ctx.stats["lut5_candidates"]
     t0 = time.perf_counter()
-    total = sum(sweep() for _ in range(REPEATS))
+    for _ in range(REPEATS):
+        run()
     dt = time.perf_counter() - t0
-    return total / dt / n_chips
+    return (ctx.stats["lut5_candidates"] - base) / dt / n_chips
 
 
 def bench_cpu_baseline(st, target, mask) -> float:
@@ -104,7 +98,8 @@ def bench_cpu_baseline(st, target, mask) -> float:
     t0 = time.perf_counter()
     idx, _ = native.lut5_search_cpu(t64, tg64, mk64, combos)
     dt = time.perf_counter() - t0
-    assert idx == -1
+    if idx != -1:
+        raise RuntimeError("unexpected 5-LUT hit in CPU baseline state")
     return combos.shape[0] / dt
 
 

@@ -124,6 +124,7 @@ uint64_t sbg_n_choose_k(uint64_t n, uint64_t k) { return n_choose_k(n, k); }
 // than `count` when the space is exhausted).
 int64_t sbg_combinations_from_rank(int32_t g, int32_t k, uint64_t rank,
                                    int64_t count, int32_t* out) {
+  if (k <= 0 || k > 16 || count <= 0) return 0;
   uint64_t total = n_choose_k((uint64_t)g, (uint64_t)k);
   if (rank >= total) return 0;
   // Unrank: choose the smallest first element whose suffix space covers rank.

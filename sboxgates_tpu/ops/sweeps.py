@@ -31,7 +31,7 @@ per call, replacing the reference's Fisher-Yates shuffles of the scan order
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,36 +48,53 @@ def _cell_constraints(tabs, target, mask):
     """Per-tuple cell constraints.
 
     tabs: [N, k, W] uint32 gate tables; target/mask: [W] uint32.
-    Returns (req1, req0): [N, 2^k] bool — cells that must map to 1 / to 0.
+    Returns (req1, req0): [2^k, N] bool — cells that must map to 1 / to 0.
     Cell index bit (k-1-i) is input i's value, so input 0 is the MSB,
     matching the LUT function bit convention f at k = A<<2|B<<1|C.
+
+    Layout note (the single biggest perf lever on TPU): all intermediates
+    are [cells, W, N] with the *candidate* axis minormost, so the VPU's
+    8x128 lanes run across candidates.  The naive [N, cells, W] orientation
+    puts the 8-word axis on the lanes (8/128 occupancy) and measures ~500x
+    slower on a v5 chip.
     """
-    k = tabs.shape[-2]
-    need1 = mask & target
-    need0 = mask & ~target
-    full = jnp.full(tabs.shape[-1:], 0xFFFFFFFF, dtype=jnp.uint32)
-    cells = jnp.broadcast_to(full, tabs.shape[:-2] + (1, tabs.shape[-1]))
+    tabs = jnp.transpose(tabs, (1, 2, 0))        # [k, W, N]
+    return _cell_constraints_t(tabs, target, mask)
+
+
+def _cell_constraints_t(tabs, target, mask):
+    """Transposed-domain core of :func:`_cell_constraints`.
+
+    tabs: [k, W, N] uint32 (candidate axis minormost).
+    Returns (req1, req0): [2^k, N] bool.
+    """
+    k = tabs.shape[0]
+    need1 = (mask & target)[None, :, None]       # [1, W, 1]
+    need0 = (mask & ~target)[None, :, None]
+    full = jnp.full(tabs.shape[1:], 0xFFFFFFFF, dtype=jnp.uint32)[None]
+    cells = full                                  # [1, W, N]
     for i in range(k - 1, -1, -1):  # reverse so input 0 lands on the MSB
-        t = tabs[..., i, None, :]
-        cells = jnp.concatenate([cells & ~t, cells & t], axis=-2)
-    req1 = ((cells & need1) != 0).any(axis=-1)
-    req0 = ((cells & need0) != 0).any(axis=-1)
+        t = tabs[i][None]
+        cells = jnp.concatenate([cells & ~t, cells & t], axis=0)
+    req1 = ((cells & need1) != 0).any(axis=1)    # [2^k, N]
+    req0 = ((cells & need0) != 0).any(axis=1)
     return req1, req0
 
 
-def _pack_bits(bits):
-    """[..., C] bool -> packed integer(s): uint32 for C<=32, [..., C/32] else."""
-    c = bits.shape[-1]
+def _pack_bits_t(bits):
+    """[C, N] bool -> packed: [N] uint32 for C<=32, [N, C/32] otherwise.
+
+    Cell axis leading (transposed domain); bit j of word w = cell w*32+j.
+    """
+    c = bits.shape[0]
     if c <= 32:
-        w = (bits.astype(jnp.uint32) << jnp.arange(c, dtype=jnp.uint32)).sum(
-            axis=-1, dtype=jnp.uint32
-        )
-        return w
+        sh = jnp.arange(c, dtype=jnp.uint32).reshape((c,) + (1,) * (bits.ndim - 1))
+        return (bits.astype(jnp.uint32) << sh).sum(axis=0, dtype=jnp.uint32)
     assert c % 32 == 0
-    r = bits.reshape(bits.shape[:-1] + (c // 32, 32))
-    return (r.astype(jnp.uint32) << jnp.arange(32, dtype=jnp.uint32)).sum(
-        axis=-1, dtype=jnp.uint32
-    )
+    r = bits.reshape((c // 32, 32) + bits.shape[1:])
+    sh = jnp.arange(32, dtype=jnp.uint32).reshape((32,) + (1,) * (bits.ndim - 1))
+    w = (r.astype(jnp.uint32) << sh).sum(axis=1, dtype=jnp.uint32)  # [C/32, N]
+    return jnp.moveaxis(w, 0, -1)
 
 
 def _priority(n, seed):
@@ -123,11 +140,14 @@ def build_match_table(funs_cellorder: Sequence[int], num_cells: int) -> np.ndarr
 # -------------------------------------------------------------------------
 
 
-class SweepResult(NamedTuple):
-    found: jax.Array        # bool scalar
-    index: jax.Array        # int32: row into the combos chunk
-    slot: jax.Array         # int32: matching function slot (or packed R|C<<cells)
-    num_feasible: jax.Array # int32: candidates passing the feasibility filter
+# All verdict-style kernels return ONE packed int32 vector rather than a
+# tuple of scalars: on real hardware every device->host fetch pays a full
+# round trip (tens of ms through the axon tunnel), so a search step must
+# cost exactly one fetch.
+
+
+def _bitcast_i32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_cells",))
@@ -137,27 +157,35 @@ def tuple_match_sweep(
     """Generic k-tuple sweep against an available-function match table.
 
     tables: [G, W] uint32; combos: [N, k] int32; valid: [N] bool;
-    match_table: [4^num_cells] int16.  Returns SweepResult where ``slot`` is
-    the matching function slot for the selected row.
+    match_table: [4^num_cells] int16.  Returns packed int32[4]:
+    [found, index, slot, num_feasible] for a randomly-selected match.
     """
     tabs = tables[combos]
     req1, req0 = _cell_constraints(tabs, target, mask)
-    feasible = valid & ~(req1 & req0).any(axis=-1)
-    r = _pack_bits(req1).astype(jnp.int32)
-    c = _pack_bits(req1 | req0).astype(jnp.int32)
+    feasible = valid & ~(req1 & req0).any(axis=0)
+    r = _pack_bits_t(req1).astype(jnp.int32)
+    c = _pack_bits_t(req1 | req0).astype(jnp.int32)
     key = r + (c << num_cells)
     slot = match_table[key].astype(jnp.int32)
     ok = feasible & (slot >= 0)
     prio = jnp.where(ok, _priority(ok.shape[0], seed), 0)
     best = jnp.argmax(prio).astype(jnp.int32)
-    return SweepResult(ok.any(), best, slot[best], feasible.sum(dtype=jnp.int32))
+    return jnp.stack(
+        [
+            ok.any().astype(jnp.int32),
+            best,
+            slot[best],
+            feasible.sum(dtype=jnp.int32),
+        ]
+    )
 
 
 @jax.jit
 def match_scan(tables, valid, target, mask, seed):
     """Steps 1-2 of the algorithm: existing gate or its complement matching
-    the target (sboxgates.c:301-321).  Returns (found, index, inverted) for
-    a randomly-chosen match, preferring direct matches."""
+    the target (sboxgates.c:301-321).  Returns packed int32[3]
+    [found, index, inverted] for a randomly-chosen match, preferring direct
+    matches."""
     eq = tt.eq_mask(tables, target, mask) & valid
     neq = tt.eq_mask(~tables, target, mask) & valid
     prio = _priority(valid.shape[0], seed)
@@ -166,24 +194,12 @@ def match_scan(tables, valid, target, mask, seed):
     use_inv = ~eq.any()
     score = jnp.where(use_inv, inverted, direct)
     best = jnp.argmax(score).astype(jnp.int32)
-    return (eq.any() | neq.any()), best, use_inv
-
-
-@jax.jit
-def lut3_sweep(tables, combos, valid, target, mask, seed):
-    """3-LUT search sweep (reference: lut_search phase 1, lut.c:501-523).
-
-    Any feasible triple admits a LUT function; returns the packed
-    (req1, constrained) byte pair for the selected row so the host can fill
-    don't-cares randomly (lut.c:102-108)."""
-    tabs = tables[combos]
-    req1, req0 = _cell_constraints(tabs, target, mask)
-    feasible = valid & ~(req1 & req0).any(axis=-1)
-    prio = jnp.where(feasible, _priority(feasible.shape[0], seed), 0)
-    best = jnp.argmax(prio).astype(jnp.int32)
-    packed = (_pack_bits(req1) | (_pack_bits(req1 | req0) << 8)).astype(jnp.int32)
-    return SweepResult(
-        feasible.any(), best, packed[best], feasible.sum(dtype=jnp.int32)
+    return jnp.stack(
+        [
+            (eq.any() | neq.any()).astype(jnp.int32),
+            best,
+            use_inv.astype(jnp.int32),
+        ]
     )
 
 
@@ -194,12 +210,11 @@ def lut_filter(tables, combos, valid, target, mask):
     tuple arity comes from the combos shape; jit specializes per shape."""
     tabs = tables[combos]
     req1, req0 = _cell_constraints(tabs, target, mask)
-    feasible = valid & ~(req1 & req0).any(axis=-1)
-    return feasible, _pack_bits(req1), _pack_bits(req0)
+    feasible = valid & ~(req1 & req0).any(axis=0)
+    return feasible, _pack_bits_t(req1), _pack_bits_t(req0)
 
 
-@jax.jit
-def lut5_solve(req1p, req0p, w_tab, m_tab, seed):
+def _lut5_solve_core(req1p, req0p, w_tab, m_tab, seed):
     """5-LUT stage B: find (split, outer function) decompositions.
 
     req1p/req0p: [T] uint32 packed cell constraints.
@@ -210,27 +225,38 @@ def lut5_solve(req1p, req0p, w_tab, m_tab, seed):
     (outer output o, inner pattern m) mixes req1 and req0 cells.  Replaces
     the reference's 10 x 256 ttable evaluations + bit-serial solves per
     combination (lut.c:189-230) with uint32 logic.
+
+    Returns (found bool, best_t, sel) with sel = split * 256 + outer_func.
     """
-    r1 = req1p[:, None, None]
-    r0 = req0p[:, None, None]
-    w = w_tab[None, :, :]
-    conflict = jnp.zeros(r1.shape[:1] + w_tab.shape, dtype=bool)
+    # Candidate axis minormost (see _cell_constraints layout note).
+    r1 = req1p[None, None, :]              # [1, 1, T]
+    r0 = req0p[None, None, :]
+    w = w_tab[:, :, None]                  # [10, 256, 1]
+    conflict = jnp.zeros(w_tab.shape + r1.shape[-1:], dtype=bool)
     for m in range(4):
-        mm = m_tab[None, :, m, None]
+        mm = m_tab[:, m, None, None]       # [10, 1, 1]
         for o in (0, 1):
             cells = (w if o else ~w) & mm
             conflict = conflict | (((r1 & cells) != 0) & ((r0 & cells) != 0))
-    ok = ~conflict  # [T, 10, 256]
-    any_t = ok.any(axis=(1, 2))
+    ok = ~conflict  # [10, 256, T]
+    any_t = ok.any(axis=(0, 1))
     prio = jnp.where(any_t, _priority(any_t.shape[0], seed), 0)
     best_t = jnp.argmax(prio).astype(jnp.int32)
     # Randomize which (split, outer-function) decomposition is taken — the
     # counterpart of the reference's per-call func_order shuffle
     # (lut.c:126-135), so repeated iterations explore different circuits.
-    flat_ok = ok[best_t].reshape(-1)
+    flat_ok = ok[:, :, best_t].reshape(-1)
     flat_prio = jnp.where(flat_ok, _priority(flat_ok.shape[0], seed ^ 0x5BD1), 0)
     sel = jnp.argmax(flat_prio).astype(jnp.int32)
     return any_t.any(), best_t, sel
+
+
+@jax.jit
+def lut5_solve(req1p, req0p, w_tab, m_tab, seed):
+    """Jitted wrapper of :func:`_lut5_solve_core` returning packed int32[3]
+    [found, best_t, sel]."""
+    found, best_t, sel = _lut5_solve_core(req1p, req0p, w_tab, m_tab, seed)
+    return jnp.stack([found.astype(jnp.int32), best_t, sel])
 
 
 @jax.jit
@@ -287,7 +313,278 @@ def lut7_solve(req1p, req0p, wo_tab, wm_tab, g_tab, seed):
     )
     prio = jnp.where(found, _priority(num_t, seed), 0)
     best_t = jnp.argmax(prio).astype(jnp.int32)
-    return found.any(), best_t, sel_sigma[best_t], sel_flat[best_t]
+    return jnp.stack(
+        [
+            found.any().astype(jnp.int32),
+            best_t,
+            sel_sigma[best_t],
+            sel_flat[best_t],
+        ]
+    )
+
+
+# -------------------------------------------------------------------------
+# Device-resident combination streaming
+#
+# Shipping materialized combo chunks host->device dominates sweep time on
+# real hardware (the TPU sits behind a network tunnel; a 131k x 5 chunk is
+# ~2.6 MB per dispatch).  Instead the whole C(G,k) space is swept inside ONE
+# jitted while_loop: each iteration unranks its own chunk of combination
+# ranks on device (pure int32 arithmetic against a binomial table) and stops
+# at the first chunk containing a feasible candidate.  The reference's
+# unranking (get_nth_combination, lut.c:635-662) runs per rank on the host;
+# here it is a vectorized fori_loop over gate ids.
+# -------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def binom_table(max_n: int = 513, max_k: int = 8) -> np.ndarray:
+    """C(n, k) for n < max_n, k <= max_k, saturating at uint32 max."""
+    t = np.zeros((max_n, max_k + 1), dtype=np.uint64)
+    t[:, 0] = 1
+    for n in range(1, max_n):
+        t[n, 1:] = t[n - 1, : max_k] + t[n - 1, 1:]
+        np.minimum(t[n], np.uint64(0xFFFFFFFF), out=t[n])
+    return t.astype(np.uint32)
+
+
+def device_rank_limit(g: int, k: int) -> bool:
+    """True when C(g, k) fits device int32 rank arithmetic."""
+    import math
+
+    return g < 513 and math.comb(g, k) < 2**31
+
+
+def _unrank_combos(binom, g, k, ranks):
+    """Vectorized lexicographic unranking.
+
+    binom: [513, 9] uint32; g: int32 scalar; ranks: [N] int32 (each < C(g,k)).
+    Returns combos [k, N] int32.  fori_loop over candidate elements v: a lane
+    whose remaining rank falls inside the C(g-v-1, k-pos-1) block takes v.
+
+    Perf note: a binary-search formulation (searchsorted over the binomial
+    column) looks asymptotically better but measures ~40x SLOWER per chunk on
+    TPU — per-lane gathers into small arrays are pathological there, while
+    this loop's per-iteration work is pure broadcast arithmetic.
+    """
+    n = ranks.shape[0]
+    pos0 = jnp.zeros(n, jnp.int32)
+    rem0 = ranks.astype(jnp.int32)
+    out0 = jnp.zeros((k, n), jnp.int32)
+
+    def body(v, state):
+        pos, rem, out = state
+        row = binom[jnp.maximum(g - v - 1, 0)]              # [9] uint32
+        c = row[jnp.clip(k - 1 - pos, 0, 8)].astype(jnp.int32)
+        active = pos < k
+        take = active & (rem < c)
+        sel = (jnp.arange(k, dtype=jnp.int32)[:, None] == pos[None, :]) & take[None, :]
+        out = jnp.where(sel, v, out)
+        rem = jnp.where(active & ~take, rem - c, rem)
+        pos = pos + take.astype(jnp.int32)
+        return pos, rem, out
+
+    _, _, out = jax.lax.fori_loop(0, g, body, (pos0, rem0, out0))
+    return out
+
+
+def _stream_chunk_constraints(tables, binom, g, k, target, mask, excl, ranks, total):
+    """Shared per-chunk work: unrank -> exclusion mask -> cell constraints.
+
+    Returns (feasible [N] bool, req1, req0 packed, combos [k, N]).
+    """
+    valid = ranks < total
+    combos = _unrank_combos(binom, g, k, jnp.minimum(ranks, total - 1))
+    hit_excl = (combos[:, :, None] == excl[None, None, :]).any(axis=(0, 2))
+    valid = valid & ~hit_excl
+    tabs = jnp.transpose(tables[combos], (0, 2, 1))          # [k, W, N]
+    req1, req0 = _cell_constraints_t(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=0)
+    return feasible, _pack_bits_t(req1), _pack_bits_t(req0)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def feasible_stream(tables, binom, g, target, mask, excl, start, total, *, k, chunk):
+    """Sweeps ranks [start, total) in chunks inside one dispatch; stops at the
+    first chunk containing a feasible k-tuple.
+
+    tables: [B, W] uint32 (zero-padded bucket); excl: [E] int32 (pad -1);
+    g/start/total: int32 scalars.  Returns (verdict int32[3] packed as
+    [found, chunk_start, examined], feasible [chunk] bool, req1, req0
+    packed) — candidate ranks are chunk_start + arange(chunk); `examined`
+    counts ranks swept including the returned chunk.  Fetch the verdict
+    first; pull the big arrays only on found.
+    """
+    start = jnp.asarray(start, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    r1_0 = jnp.zeros((chunk,) if k <= 5 else (chunk, (1 << k) // 32), jnp.uint32)
+    init = (start, jnp.bool_(False), start, jnp.zeros(chunk, bool), r1_0, r1_0)
+
+    def cond(s):
+        nxt, found = s[0], s[1]
+        return (~found) & (nxt < total)
+
+    def body(s):
+        nxt = s[0]
+        ranks = nxt + jnp.arange(chunk, dtype=jnp.int32)
+        feasible, r1, r0 = _stream_chunk_constraints(
+            tables, binom, g, k, target, mask, excl, ranks, total
+        )
+        return (nxt + chunk, feasible.any(), nxt, feasible, r1, r0)
+
+    nxt, found, cstart, feasible, r1, r0 = jax.lax.while_loop(cond, body, init)
+    examined = jnp.minimum(nxt, total) - start
+    verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
+    return verdict, feasible, r1, r0
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def lut3_stream(tables, binom, g, target, mask, excl, start, total, seed, *, chunk):
+    """Whole-space 3-LUT search in one dispatch (reference: lut_search
+    phase 1, lut.c:501-523): while_loop over rank chunks, stopping at the
+    first chunk with a feasible triple and selecting one by hashed priority
+    (the counterpart of the reference's shuffled scan order).
+
+    Returns packed int32[5]: [found, rank, req1, req0, examined].
+    """
+    start = jnp.asarray(start, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    z = jnp.int32(0)
+    init = (jnp.bool_(False), start, z, z, z)
+
+    def cond(s):
+        return (~s[0]) & (s[1] < total)
+
+    def body(s):
+        nxt = s[1]
+        ranks = nxt + jnp.arange(chunk, dtype=jnp.int32)
+        feasible, r1, r0 = _stream_chunk_constraints(
+            tables, binom, g, 3, target, mask, excl, ranks, total
+        )
+        prio = jnp.where(feasible, _priority(chunk, seed ^ nxt), 0)
+        best = jnp.argmax(prio).astype(jnp.int32)
+        return (
+            feasible.any(),
+            nxt + chunk,
+            ranks[best],
+            _bitcast_i32(r1[best]),
+            _bitcast_i32(r0[best]),
+        )
+
+    found, nxt, rank, r1, r0 = jax.lax.while_loop(cond, body, init)
+    examined = jnp.minimum(nxt, total) - start
+    return jnp.stack([found.astype(jnp.int32), rank, r1, r0, examined])
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "solve_rows"))
+def lut5_stream(
+    tables, binom, g, target, mask, excl, start, total, w_tab, m_tab, seed,
+    *, chunk, solve_rows=1024
+):
+    """Whole-space 5-LUT search in one dispatch (reference: search_5lut,
+    lut.c:116-249): each chunk runs the feasibility filter, compacts the
+    top-`solve_rows` feasible tuples by hashed priority, and solves for a
+    LUT(LUT(a,b,c),d,e) decomposition in the packed cell domain.  The loop
+    continues past chunks whose feasible tuples admit no decomposition.
+
+    Returns packed int32[8]:
+    [status, rank, sigma, func_outer, req1, req0, cstart, examined] with
+    status 0 = exhausted, 1 = found, 2 = a chunk had more than `solve_rows`
+    feasible tuples and none of the solved subset decomposed (the host must
+    re-drive that chunk via feasible_stream before resuming at
+    cstart + chunk).
+    """
+    start = jnp.asarray(start, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    z = jnp.int32(0)
+    init = (z, start, z, z, z, z, z, start)
+
+    def cond(s):
+        return (s[0] == 0) & (s[1] < total)
+
+    def body(s):
+        nxt = s[1]
+        ranks = nxt + jnp.arange(chunk, dtype=jnp.int32)
+        feasible, r1, r0 = _stream_chunk_constraints(
+            tables, binom, g, 5, target, mask, excl, ranks, total
+        )
+
+        # Compaction + solve are much more expensive than the filter, and
+        # almost every chunk has zero feasible tuples — gate them behind a
+        # real conditional so the common path pays only the filter.
+        def solve_chunk(_):
+            nfeas = feasible.sum(dtype=jnp.int32)
+            prio = jnp.where(feasible, _priority(chunk, seed ^ nxt), 0)
+            topv, topi = jax.lax.top_k(prio, solve_rows)
+            fsel = topv > 0
+            full = jnp.uint32(0xFFFFFFFF)
+            sr1 = jnp.where(fsel, r1[topi], full)
+            sr0 = jnp.where(fsel, r0[topi], full)
+            found, best_t, sel = _lut5_solve_core(
+                sr1, sr0, w_tab, m_tab, seed ^ nxt ^ 0x9E37
+            )
+            overflow = (nfeas > solve_rows) & ~found
+            status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
+            return (
+                status.astype(jnp.int32),
+                ranks[topi[best_t]],
+                sel // 256,
+                sel % 256,
+                _bitcast_i32(sr1[best_t]),
+                _bitcast_i32(sr0[best_t]),
+            )
+
+        def skip_chunk(_):
+            z = jnp.int32(0)
+            return (z, z, z, z, z, z)
+
+        status, rank, sigma, fo, r1b, r0b = jax.lax.cond(
+            feasible.any(), solve_chunk, skip_chunk, None
+        )
+        return (status, nxt + chunk, rank, sigma, fo, r1b, r0b, nxt)
+
+    status, nxt, rank, sigma, fo, r1, r0, cstart = jax.lax.while_loop(
+        cond, body, init
+    )
+    examined = jnp.minimum(nxt, total) - start
+    return jnp.stack([status, rank, sigma, fo, r1, r0, cstart, examined])
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk", "num_cells"))
+def match_stream(
+    tables, binom, g, target, mask, excl, start, total, match_table, seed,
+    *, k, chunk, num_cells
+):
+    """Streaming version of :func:`tuple_match_sweep` over ranks
+    [start, total): stops at the first chunk where some valid tuple matches
+    an available function.  Returns packed int32[4]
+    [found, abs_rank, slot, examined]."""
+    start = jnp.asarray(start, jnp.int32)
+    total = jnp.asarray(total, jnp.int32)
+    init = (start, jnp.bool_(False), jnp.int32(0), jnp.int32(-1))
+
+    def cond(s):
+        nxt, found = s[0], s[1]
+        return (~found) & (nxt < total)
+
+    def body(s):
+        nxt = s[0]
+        ranks = nxt + jnp.arange(chunk, dtype=jnp.int32)
+        feasible, r1, r0 = _stream_chunk_constraints(
+            tables, binom, g, k, target, mask, excl, ranks, total
+        )
+        # packing is per-cell bitwise, so pack(req1|req0) == r1 | r0
+        r = r1.astype(jnp.int32)
+        c = (r1 | r0).astype(jnp.int32)
+        slot = match_table[r + (c << num_cells)].astype(jnp.int32)
+        ok = feasible & (slot >= 0)
+        prio = jnp.where(ok, _priority(chunk, seed ^ nxt), 0)
+        best = jnp.argmax(prio).astype(jnp.int32)
+        return (nxt + chunk, ok.any(), nxt + best, slot[best])
+
+    nxt, found, abs_rank, slot = jax.lax.while_loop(cond, body, init)
+    examined = jnp.minimum(nxt, total) - start
+    return jnp.stack([found.astype(jnp.int32), abs_rank, slot, examined])
 
 
 # -------------------------------------------------------------------------

@@ -18,9 +18,9 @@ and racing to the first hit (lut.c:138-149, sboxgates.c:619-642; SURVEY.md
 Multi-host (``jax.distributed``) scale-out keeps this sharding layout with
 collectives riding ICI inside each host; the host-side compaction between
 filter and solve then needs process-local gathers
-(``multihost_utils.process_allgather``) or the fused single-dispatch mode
-(:func:`lut5_fused_step`, ``Options.fused_lut5``) which avoids the host
-round-trip entirely — wiring the gather path is tracked for a later round.
+(``multihost_utils.process_allgather``) or the fused single-dispatch step
+(:func:`lut5_fused_step`) which avoids the host round-trip entirely —
+wiring the gather path is tracked for a later round.
 
 A second mesh axis (``"restarts"``) batches independent randomized search
 restarts — parallelism the reference lacks (SURVEY.md §2.10): ``vmap`` over
@@ -29,12 +29,17 @@ per-restart targets/seeds composes with the candidate sharding.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 from ..ops import sweeps
 
@@ -105,8 +110,78 @@ def lut5_fused_step(tables, combos, valid, target, mask, w_tab, m_tab, seed):
     full = jnp.uint32(0xFFFFFFFF)
     req1p = jnp.where(feasible, req1p, full)
     req0p = jnp.where(feasible, req0p, full)
-    found, best_t, sel = sweeps.lut5_solve(req1p, req0p, w_tab, m_tab, seed)
+    found, best_t, sel = sweeps._lut5_solve_core(req1p, req0p, w_tab, m_tab, seed)
     return found, best_t, sel
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
+    """Compiled SPMD whole-space feasibility stream for one (mesh, k, chunk).
+
+    Each device sweeps a contiguous `per`-rank sub-block of every chunk, so
+    the gathered feasibility arrays concatenate to ranks
+    ``chunk_start + arange(chunk)`` exactly like the single-device
+    :func:`sboxgates_tpu.ops.sweeps.feasible_stream`.  The found flag is a
+    ``psum`` each iteration — the collective replacing the reference's
+    Isend/Irecv first-hit protocol (lut.c:213-238).
+    """
+    n = mesh.shape[CANDIDATES_AXIS]
+    per = -(-chunk // n)
+
+    def local(tables, binom, g, target, mask, excl, start, total):
+        d = jax.lax.axis_index(CANDIDATES_AXIS).astype(jnp.int32)
+        start = jnp.asarray(start, jnp.int32)
+        total = jnp.asarray(total, jnp.int32)
+        r1_0 = jnp.zeros((per,) if k <= 5 else (per, (1 << k) // 32), jnp.uint32)
+        init = (start, jnp.bool_(False), start, jnp.zeros(per, bool), r1_0, r1_0)
+
+        def cond(s):
+            nxt, found = s[0], s[1]
+            return (~found) & (nxt < total)
+
+        def body(s):
+            nxt = s[0]
+            ranks = nxt + d * per + jnp.arange(per, dtype=jnp.int32)
+            feasible, r1, r0 = sweeps._stream_chunk_constraints(
+                tables, binom, g, k, target, mask, excl, ranks, total
+            )
+            found = (
+                jax.lax.psum(feasible.any().astype(jnp.int32), CANDIDATES_AXIS)
+                > 0
+            )
+            return (nxt + per * n, found, nxt, feasible, r1, r0)
+
+        nxt, found, cstart, feasible, r1, r0 = jax.lax.while_loop(
+            cond, body, init
+        )
+        examined = jnp.minimum(nxt, total) - start
+        verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
+        return verdict, feasible, r1, r0
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(),) * 8,
+        out_specs=(
+            P(),
+            P(CANDIDATES_AXIS),
+            P(CANDIDATES_AXIS),
+            P(CANDIDATES_AXIS),
+        ),
+    )
+    try:  # jax >= 0.8 names the replication check check_vma
+        smapped = shard_map(local, check_vma=False, **specs)
+    except TypeError:
+        smapped = shard_map(local, check_rep=False, **specs)
+    return jax.jit(smapped)
+
+
+def sharded_feasible_stream(
+    plan: "MeshPlan", tables, binom, g, target, mask, excl, start, total,
+    *, k: int, chunk: int
+):
+    """Mesh-sharded counterpart of sweeps.feasible_stream (same contract)."""
+    fn = _sharded_stream_fn(plan.mesh, k, chunk)
+    return fn(tables, binom, g, target, mask, excl, start, total)
 
 
 def restart_batched_filter():

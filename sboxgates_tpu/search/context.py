@@ -20,8 +20,10 @@ from ..ops import combinatorics as comb
 from ..ops import sweeps
 
 # Gate-count buckets: live tables are zero-padded up to the next bucket so
-# jitted sweeps see a small, fixed set of shapes.
-BUCKETS = (16, 32, 64, 96, 128, 192, 256, 384, 512)
+# jitted sweeps see a small, fixed set of shapes.  Two buckets only — gather
+# cost is independent of table height, so the padding is free and every
+# extra bucket doubles the jit-cache shapes.
+BUCKETS = (64, 512)
 
 TRIPLE_CHUNK = 1 << 17
 LUT5_CHUNK = 1 << 17
@@ -29,6 +31,9 @@ LUT5_SOLVE_CHUNK = 4096
 LUT7_CHUNK = 1 << 17
 LUT7_CAP = 100_000       # reference: 100k-hit buffer, lut.c:291,316
 LUT7_SOLVE_CHUNK = 16
+
+# Per-arity chunk sizes for the device-resident streaming sweeps.
+STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 17}
 
 
 @dataclass
@@ -43,11 +48,6 @@ class Options:
     lut_graph: bool = False
     randomize: bool = True
     try_nots: bool = False
-    # Fused 5-LUT mode: single-dispatch filter+solve per chunk (no host
-    # compaction round-trip).  Wins when feasibility is dense or when host
-    # syncs dominate (multi-host meshes); the default two-kernel path wins
-    # when the feasibility filter is very selective.
-    fused_lut5: bool = False
     avail_gates_bitfield: int = bf.DEFAULT_AVAILABLE
     verbosity: int = 0
     seed: Optional[int] = None
@@ -128,7 +128,7 @@ def bucket_size(n: int) -> int:
     raise ValueError(f"too many gates: {n}")
 
 
-CHUNK_SIZES = (1024, 8192, 32768, 1 << 17)
+CHUNK_SIZES = (1024, 1 << 17)
 
 
 def pick_chunk(n: int, cap: int) -> int:
@@ -166,6 +166,7 @@ class SearchContext:
             self.not_table, self.not_entries = None, []
         self.triple_table, self.triple_entries = _build_triple_table(self.avail_3)
         self._pair_combo_cache = {}
+        self._binom = None
         # Sweep statistics (candidates examined), for benchmarking.
         self.stats = {
             "pair_candidates": 0,
@@ -214,6 +215,86 @@ class SearchContext:
             )
         return self._pair_combo_cache[bucket]
 
+    @property
+    def binom(self):
+        """Device-resident binomial table for in-kernel unranking."""
+        if self._binom is None:
+            self._binom = self.place_replicated(sweeps.binom_table())
+        return self._binom
+
+    @staticmethod
+    def excl_array(inbits) -> np.ndarray:
+        """Mux-used input bits as a padded exclusion list (reference:
+        the inbits rejection, lut.c:176-186)."""
+        excl = np.full(8, -1, dtype=np.int32)
+        for i, b in enumerate([b for b in inbits if b >= 0][:8]):
+            excl[i] = b
+        return excl
+
+    def stream_args(self, st: State, target, mask, inbits, k: int):
+        """Common device operands for the streaming kernels: returns
+        ((tables, binom, g, target, mask, excl), total, chunk)."""
+        g = st.num_gates
+        total = comb.n_choose_k(g, k)
+        tables, _ = self.device_tables(st)
+        chunk = pick_chunk(total, STREAM_CHUNK[k])
+        return (
+            (
+                tables,
+                self.binom,
+                g,
+                self.place_replicated(np.asarray(target)),
+                self.place_replicated(np.asarray(mask)),
+                self.place_replicated(self.excl_array(inbits)),
+            ),
+            total,
+            chunk,
+        )
+
+    def feasible_stream_driver(
+        self, st: State, target, mask, inbits, k: int, start: int = 0
+    ):
+        """One device dispatch sweeping combination ranks [start, total):
+        stops at the first chunk with a feasible k-tuple (whole-space
+        while_loop; see sweeps.feasible_stream).
+
+        Returns (found, chunk_start, feasible, req1, req0, examined, chunk).
+        """
+        g = st.num_gates
+        total = comb.n_choose_k(g, k)
+        tables, _ = self.device_tables(st)
+        chunk = pick_chunk(total, STREAM_CHUNK[k])
+        args = (
+            tables,
+            self.binom,
+            g,
+            self.place_replicated(np.asarray(target)),
+            self.place_replicated(np.asarray(mask)),
+            self.place_replicated(self.excl_array(inbits)),
+            start,
+            total,
+        )
+        if self.mesh_plan is not None:
+            from ..parallel.mesh import sharded_feasible_stream
+
+            # The sharded kernel rounds the chunk up to a device multiple and
+            # advances by that stride; report the effective chunk so callers
+            # resume at exactly the next unswept rank.
+            n = self.mesh_plan.n_candidate_shards
+            chunk = -(-chunk // n) * n
+            verdict, feas, r1, r0 = sharded_feasible_stream(
+                self.mesh_plan, *args, k=k, chunk=chunk
+            )
+        else:
+            verdict, feas, r1, r0 = sweeps.feasible_stream(
+                *args, k=k, chunk=chunk
+            )
+        # ONE verdict fetch; the big per-chunk arrays stay on device and are
+        # pulled by callers only on a hit (each fetch pays a full host link
+        # round trip).
+        found, cstart, examined = (int(x) for x in np.asarray(verdict))
+        return bool(found), cstart, feas, r1, r0, examined, chunk
+
     # -- sweep drivers ----------------------------------------------------
 
     def scan_matches(self, st: State, target, mask):
@@ -221,14 +302,16 @@ class SearchContext:
         (found, gid, inverted)."""
         tables, g = self.device_tables(st)
         valid = jnp.arange(tables.shape[0]) < g
-        found, idx, inv = sweeps.match_scan(
-            tables,
-            valid,
-            self.place_replicated(target),
-            self.place_replicated(mask),
-            self.next_seed(),
+        v = np.asarray(
+            sweeps.match_scan(
+                tables,
+                valid,
+                self.place_replicated(target),
+                self.place_replicated(mask),
+                self.next_seed(),
+            )
         )
-        return bool(found), int(idx), bool(inv)
+        return bool(v[0]), int(v[1]), bool(v[2])
 
     def pair_search(self, st: State, target, mask, use_not_table: bool):
         """Step 3 / step 4a: one function over all gate pairs.  Returns
@@ -241,51 +324,56 @@ class SearchContext:
         combos = self._pair_combos(tables.shape[0])
         valid = (combos < g).all(axis=1)
         self.stats["pair_candidates"] += g * (g - 1) // 2
-        res = sweeps.tuple_match_sweep(
-            tables,
-            combos,
-            valid,
-            self.place_replicated(target),
-            self.place_replicated(mask),
-            table,
-            self.next_seed(),
-            num_cells=4,
+        v = np.asarray(
+            sweeps.tuple_match_sweep(
+                tables,
+                combos,
+                valid,
+                self.place_replicated(target),
+                self.place_replicated(mask),
+                table,
+                self.next_seed(),
+                num_cells=4,
+            )
         )
-        if not bool(res.found):
+        if not bool(v[0]):
             return False, 0, 0, None
-        pair = np.asarray(combos[int(res.index)])
-        entry = entries[int(res.slot)]
+        pair = np.asarray(combos[int(v[1])])
+        entry = entries[int(v[2])]
         gids = [int(pair[p]) for p in entry.perm]
         return True, gids[0], gids[1], entry
 
     def triple_search(self, st: State, target, mask):
-        """Step 4b: three-gate combinations x available 3-input functions.
-        Chunked stream with early exit.  Returns (found, gids, entry)."""
+        """Step 4b: three-gate combinations x available 3-input functions,
+        swept on device as one streaming dispatch (early exit at the first
+        matching chunk).  Returns (found, gids, entry)."""
         g = st.num_gates
+        total = comb.n_choose_k(g, 3)
+        if total == 0:
+            return False, None, None
         tables, _ = self.device_tables(st)
-        target = self.place_replicated(target)
-        mask = self.place_replicated(mask)
-        stream = comb.CombinationStream(g, 3)
-        csize = pick_chunk(stream.total, TRIPLE_CHUNK)
-        while True:
-            chunk = stream.next_chunk(csize)
-            if chunk is None:
-                return False, None, None
-            padded, nvalid = comb.pad_rows(chunk, csize)
-            self.stats["triple_candidates"] += nvalid
-            valid = self.place_chunk(np.arange(csize) < nvalid)
-            res = sweeps.tuple_match_sweep(
+        chunk = pick_chunk(total, STREAM_CHUNK[3])
+        v = np.asarray(
+            sweeps.match_stream(
                 tables,
-                self.place_chunk(padded),
-                valid,
-                target,
-                mask,
+                self.binom,
+                g,
+                self.place_replicated(np.asarray(target)),
+                self.place_replicated(np.asarray(mask)),
+                self.place_replicated(self.excl_array([])),
+                0,
+                total,
                 self.triple_table,
                 self.next_seed(),
+                k=3,
+                chunk=chunk,
                 num_cells=8,
             )
-            if bool(res.found):
-                row = padded[int(res.index)]
-                entry = self.triple_entries[int(res.slot)]
-                gids = [int(row[p]) for p in entry.perm]
-                return True, gids, entry
+        )
+        self.stats["triple_candidates"] += int(v[3])
+        if not bool(v[0]):
+            return False, None, None
+        row = comb.unrank_combination(int(v[1]), g, 3)
+        entry = self.triple_entries[int(v[2])]
+        gids = [int(row[p]) for p in entry.perm]
+        return True, gids, entry

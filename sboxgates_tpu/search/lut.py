@@ -2,11 +2,18 @@
 
 The reference parallelizes these sweeps over MPI ranks with static range
 partitioning and a racy first-hit early-quit protocol (lut.c:116-487,
-§2.5-2.6 of SURVEY.md).  Here each sweep is a chunked stream of candidate
-combinations through jitted constraint kernels; early termination is a
-found-flag check between chunks (deterministic "first hit in chunk order"),
-and multi-device scale-out shards each chunk across the mesh
-(:mod:`sboxgates_tpu.parallel.mesh`) instead of splitting the range per rank.
+§2.5-2.6 of SURVEY.md).  Here the whole C(G,k) combination space is swept
+*inside one device dispatch*: a jitted while_loop unranks chunk-sized blocks
+of combination ranks on device, runs the Karnaugh-cell feasibility kernel,
+and stops at the first chunk containing a feasible tuple (deterministic
+"first hit in chunk order" replaces the reference's wall-clock race).  The
+host only sees (found, chunk_start, feasibility bitmap) — no combination
+data ever crosses the host↔device link.  Multi-device meshes shard each
+chunk's rank block over the ``candidates`` axis with a psum'd found flag
+(:func:`sboxgates_tpu.parallel.mesh.sharded_feasible_stream`).
+
+For spaces whose rank exceeds int32 (C(G,k) >= 2^31; G>~84 for k=5) the
+drivers fall back to host-side chunk streaming through the same kernels.
 """
 
 from __future__ import annotations
@@ -41,42 +48,64 @@ def _unpack128(words: np.ndarray) -> np.ndarray:
     return out
 
 
+def _pick_row(ctx: SearchContext, rows: np.ndarray) -> int:
+    """Random choice among candidate rows (the reference shuffles its scan
+    order, sboxgates.c:285-299); first row when not randomizing."""
+    if ctx.opt.randomize and len(rows) > 1:
+        return int(rows[int(ctx.rng.integers(0, len(rows)))])
+    return int(rows[0])
+
+
+# -------------------------------------------------------------------------
+# 3-LUT
+# -------------------------------------------------------------------------
+
+
 def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     """All gate triples x any 3-input function (reference: lut_search phase 1,
     lut.c:501-523).  Returns the new LUT's gate id or NO_GATE."""
     g = st.num_gates
     if g < 3:
         return NO_GATE
-    tables, _ = ctx.device_tables(st)
-    jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
-    stream = comb.CombinationStream(g, 3)
-    csize = pick_chunk(stream.total, 1 << 17)
-    while True:
-        chunk = stream.next_chunk(csize)
-        if chunk is None:
-            return NO_GATE
-        padded, nvalid = comb.pad_rows(chunk, csize)
-        ctx.stats["lut3_candidates"] += nvalid
-        valid = ctx.place_chunk(np.arange(csize) < nvalid)
-        res = sweeps.lut3_sweep(
-            tables, ctx.place_chunk(padded), valid, jtarget, jmask, ctx.next_seed()
+    # The reference's 3-LUT phase scans ALL triples — only the 5/7-LUT
+    # searches reject mux-used input bits (lut.c:178-186 vs lut.c:501-523).
+    del inbits
+    if ctx.mesh_plan is None:
+        # Fully-fused single-dispatch path: the kernel picks a feasible
+        # triple by hashed priority, so the whole search costs one verdict
+        # fetch.
+        args, total, chunk = ctx.stream_args(st, target, mask, [], 3)
+        v = np.asarray(
+            sweeps.lut3_stream(*args, 0, total, ctx.next_seed(), chunk=chunk)
         )
-        if bool(res.found):
-            row = padded[int(res.index)]
-            packed = int(res.slot)
-            req1, constrained = packed & 0xFF, (packed >> 8) & 0xFF
-            func = req1
-            if ctx.opt.randomize:
-                func |= int(ctx.rng.integers(0, 256)) & ~constrained & 0xFF
-            a, b, c = (int(x) for x in row)
-            gid = st.add_lut(func, a, b, c)
-            st.verify_gate(gid, target, mask)
-            return gid
+        ctx.stats["lut3_candidates"] += int(v[4])
+        if not v[0]:
+            return NO_GATE
+        rank, pr1, pr0 = int(v[1]), int(v[2]) & 0xFF, int(v[3]) & 0xFF
+        a, b, c = (int(x) for x in comb.unrank_combination(rank, g, 3))
+    else:
+        found, cstart, feas, r1, r0, examined, _ = ctx.feasible_stream_driver(
+            st, target, mask, [], k=3
+        )
+        ctx.stats["lut3_candidates"] += examined
+        if not found:
+            return NO_GATE
+        feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+        rows = np.nonzero(feas)[0]
+        row = _pick_row(ctx, rows)
+        a, b, c = (int(x) for x in comb.unrank_combination(cstart + row, g, 3))
+        pr1, pr0 = int(r1[row]) & 0xFF, int(r0[row]) & 0xFF
+    func = pr1
+    if ctx.opt.randomize:
+        func |= int(ctx.rng.integers(0, 256)) & ~(pr1 | pr0) & 0xFF
+    gid = st.add_lut(func, a, b, c)
+    st.verify_gate(gid, target, mask)
+    return gid
 
 
-def _combo_stream(g: int, k: int, inbits) -> Tuple[comb.CombinationStream, list]:
-    excl = [b for b in inbits if b >= 0]
-    return comb.CombinationStream(g, k), excl
+# -------------------------------------------------------------------------
+# 5-LUT
+# -------------------------------------------------------------------------
 
 
 def _decode_lut5(
@@ -110,24 +139,178 @@ def _decode_lut5(
     }
 
 
+def _solve_lut5_rows(
+    ctx: SearchContext,
+    st: State,
+    target,
+    mask,
+    combos: np.ndarray,
+    req1: np.ndarray,
+    req0: np.ndarray,
+    jw,
+    jm,
+    splits,
+    w_tab,
+    m_tab,
+) -> Optional[dict]:
+    """Runs the packed-cell decomposition solver over feasible tuples (in
+    sub-chunks) and decodes the first hit."""
+    for lo in range(0, len(combos), LUT5_SOLVE_CHUNK):
+        hi = min(lo + LUT5_SOLVE_CHUNK, len(combos))
+        scs = pick_chunk(hi - lo, LUT5_SOLVE_CHUNK)
+        # pad both constraint vectors with all-ones so padded rows conflict
+        # in every cell and can never be selected
+        p1, _ = comb.pad_rows(req1[lo:hi], scs, fill=0xFFFFFFFF)
+        p0, _ = comb.pad_rows(req0[lo:hi], scs, fill=0xFFFFFFFF)
+        ctx.stats["lut5_solved"] += hi - lo
+        v = np.asarray(
+            sweeps.lut5_solve(
+                ctx.place_chunk(p1, fill=0xFFFFFFFF),
+                ctx.place_chunk(p0, fill=0xFFFFFFFF),
+                jw,
+                jm,
+                ctx.next_seed(),
+            )
+        )
+        if not v[0]:
+            continue
+        t = lo + int(v[1])
+        sigma, func_outer = divmod(int(v[2]), 256)
+        return _decode_lut5(
+            ctx,
+            combos[t],
+            sigma,
+            func_outer,
+            _unpack32(req1[t]),
+            _unpack32(req0[t]),
+            splits,
+            w_tab,
+            m_tab,
+        )
+    return None
+
+
 def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
     """5-LUT search: find LUT(LUT(a,b,c), d, e) realizing the target
     (reference: search_5lut, lut.c:116-249).
 
-    Returns {func_outer, func_inner, gates: (a,b,c,d,e)} or None.  Two
-    execution modes: the default filters feasibility then solves the
-    compacted survivors (best when the filter is selective); with
-    ``Options.fused_lut5`` each chunk runs the fused single-dispatch
-    filter+solve step with no host compaction round-trip.
+    Returns {func_outer, func_inner, gates: (a,b,c,d,e)} or None.  The
+    device stream yields chunks containing feasible tuples; each is solved
+    in the packed cell domain, continuing the sweep past chunks whose
+    feasible tuples admit no LUT(LUT,·,·) decomposition.
     """
     g = st.num_gates
     if g < 5:
         return None
+    if not sweeps.device_rank_limit(g, 5):
+        return _lut5_search_host(ctx, st, target, mask, inbits)
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+    total = comb.n_choose_k(g, 5)
+
+    if ctx.mesh_plan is None:
+        # Fully-fused path: filter + compaction + decomposition solve inside
+        # one while_loop dispatch; one int32[8] verdict per call.
+        args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
+        start = 0
+        while start < total:
+            v = np.asarray(
+                sweeps.lut5_stream(
+                    *args, start, total, jw, jm, ctx.next_seed(), chunk=chunk
+                )
+            )
+            status, cstart = int(v[0]), int(v[6])
+            ctx.stats["lut5_candidates"] += int(v[7])
+            if status == 0:
+                return None
+            if status == 1:
+                combo = comb.unrank_combination(int(v[1]), g, 5)
+                return _decode_lut5(
+                    ctx,
+                    combo,
+                    int(v[2]),
+                    int(v[3]),
+                    _unpack32(int(v[4]) & 0xFFFFFFFF),
+                    _unpack32(int(v[5]) & 0xFFFFFFFF),
+                    splits,
+                    w_tab,
+                    m_tab,
+                )
+            # status 2: the chunk at cstart had more feasible tuples than the
+            # in-kernel solver examined — re-drive just that chunk through the
+            # two-phase path, then resume the fused stream after it.
+            res = _lut5_chunk_two_phase(
+                ctx, st, target, mask, inbits, cstart, jw, jm,
+                splits, w_tab, m_tab,
+            )
+            if res is not None:
+                return res
+            start = cstart + chunk
+        return None
+
+    start = 0
+    while start < total:
+        found, cstart, feas, r1, r0, examined, chunk = ctx.feasible_stream_driver(
+            st, target, mask, inbits, k=5, start=start
+        )
+        ctx.stats["lut5_candidates"] += examined
+        if not found:
+            return None
+        res = _lut5_solve_feasible_chunk(
+            ctx, st, target, mask, cstart, feas, r1, r0, jw, jm,
+            splits, w_tab, m_tab,
+        )
+        if res is not None:
+            return res
+        start = cstart + chunk
+    return None
+
+
+def _lut5_solve_feasible_chunk(
+    ctx, st, target, mask, cstart, feas, r1, r0, jw, jm, splits, w_tab, m_tab
+) -> Optional[dict]:
+    """Host side of one feasible chunk: unrank the flagged rows and solve."""
+    g = st.num_gates
+    feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+    rows = np.nonzero(feas)[0]
+    if ctx.opt.randomize:
+        rows = rows[ctx.rng.permutation(len(rows))]
+    combos = np.stack(
+        [comb.unrank_combination(cstart + int(r), g, 5) for r in rows]
+    )
+    return _solve_lut5_rows(
+        ctx, st, target, mask, combos, r1[rows], r0[rows],
+        jw, jm, splits, w_tab, m_tab,
+    )
+
+
+def _lut5_chunk_two_phase(
+    ctx, st, target, mask, inbits, cstart, jw, jm, splits, w_tab, m_tab
+) -> Optional[dict]:
+    """Overflow fallback: fetch one chunk's full feasibility data and solve
+    every feasible tuple (no in-kernel row cap)."""
+    found, fstart, feas, r1, r0, _, _ = ctx.feasible_stream_driver(
+        st, target, mask, inbits, k=5, start=cstart
+    )
+    if not found or fstart != cstart:
+        return None  # nothing feasible in this exact chunk (cannot happen)
+    return _lut5_solve_feasible_chunk(
+        ctx, st, target, mask, cstart, feas, r1, r0, jw, jm,
+        splits, w_tab, m_tab,
+    )
+
+
+def _lut5_search_host(
+    ctx: SearchContext, st: State, target, mask, inbits
+) -> Optional[dict]:
+    """Host-chunked fallback for spaces beyond int32 rank arithmetic."""
+    g = st.num_gates
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
     tables, _ = ctx.device_tables(st)
     jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
-    stream, excl = _combo_stream(g, 5, inbits)
+    excl = [b for b in inbits if b >= 0]
+    stream = comb.CombinationStream(g, 5)
     csize = pick_chunk(stream.total, LUT5_CHUNK)
     while True:
         chunk = stream.next_chunk(csize)
@@ -137,33 +320,6 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         padded, nvalid = comb.pad_rows(chunk, csize)
         ctx.stats["lut5_candidates"] += nvalid
         valid = ctx.place_chunk(np.arange(csize) < nvalid)
-
-        if ctx.opt.fused_lut5:
-            from ..parallel.mesh import lut5_fused_step
-
-            ctx.stats["lut5_solved"] += nvalid
-            found, best_t, sel = lut5_fused_step(
-                tables,
-                ctx.place_chunk(padded),
-                valid,
-                jtarget,
-                jmask,
-                jw,
-                jm,
-                ctx.next_seed(),
-            )
-            if not bool(found):
-                continue
-            combo = padded[int(best_t)]
-            sigma, func_outer = divmod(int(sel), 256)
-            req1_cells, req0_cells = sweeps.host_cell_constraints(
-                st.tables, combo, target, mask
-            )
-            return _decode_lut5(
-                ctx, combo, sigma, func_outer, req1_cells, req0_cells,
-                splits, w_tab, m_tab,
-            )
-
         feas, req1p, req0p = sweeps.lut_filter(
             tables, ctx.place_chunk(padded), valid, jtarget, jmask
         )
@@ -171,34 +327,18 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         if not feas.any():
             continue
         fidx = np.nonzero(feas)[0]
-        freq1 = np.asarray(req1p)[fidx]
-        freq0 = np.asarray(req0p)[fidx]
-        fcombos = padded[fidx]
-        # Solve feasible tuples in sub-chunks.
-        for lo in range(0, len(fidx), LUT5_SOLVE_CHUNK):
-            hi = min(lo + LUT5_SOLVE_CHUNK, len(fidx))
-            scs = pick_chunk(hi - lo, LUT5_SOLVE_CHUNK)
-            # pad both constraint vectors with all-ones so padded rows
-            # conflict in every cell and can never be selected
-            r1, _ = comb.pad_rows(freq1[lo:hi], scs, fill=0xFFFFFFFF)
-            r0, _ = comb.pad_rows(freq0[lo:hi], scs, fill=0xFFFFFFFF)
-            ctx.stats["lut5_solved"] += hi - lo
-            found, best_t, sel = sweeps.lut5_solve(
-                ctx.place_chunk(r1, fill=0xFFFFFFFF),
-                ctx.place_chunk(r0, fill=0xFFFFFFFF),
-                jw,
-                jm,
-                ctx.next_seed(),
-            )
-            if not bool(found):
-                continue
-            t = lo + int(best_t)
-            sigma, func_outer = divmod(int(sel), 256)
-            return _decode_lut5(
-                ctx, fcombos[t], sigma, func_outer,
-                _unpack32(freq1[t]), _unpack32(freq0[t]),
-                splits, w_tab, m_tab,
-            )
+        res = _solve_lut5_rows(
+            ctx, st, target, mask, padded[fidx],
+            np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
+            jw, jm, splits, w_tab, m_tab,
+        )
+        if res is not None:
+            return res
+
+
+# -------------------------------------------------------------------------
+# 7-LUT
+# -------------------------------------------------------------------------
 
 
 def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
@@ -209,34 +349,59 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     g = st.num_gates
     if g < 7:
         return None
-    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
-    tables, _ = ctx.device_tables(st)
-    jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
-    stream, excl = _combo_stream(g, 7, inbits)
+    use_device_stream = sweeps.device_rank_limit(g, 7)
 
     hit_combos: List[np.ndarray] = []
     hit_req1: List[np.ndarray] = []
     hit_req0: List[np.ndarray] = []
     nhits = 0
-    csize = pick_chunk(stream.total, LUT7_CHUNK)
-    while nhits < LUT7_CAP:
-        chunk = stream.next_chunk(csize)
-        if chunk is None:
-            break
-        chunk = comb.filter_exclude(chunk, excl)
-        padded, nvalid = comb.pad_rows(chunk, csize)
-        ctx.stats["lut7_candidates"] += nvalid
-        valid = ctx.place_chunk(np.arange(csize) < nvalid)
-        feas, req1p, req0p = sweeps.lut_filter(
-            tables, ctx.place_chunk(padded), valid, jtarget, jmask
-        )
-        feas = np.asarray(feas)[:csize]
-        if feas.any():
-            fidx = np.nonzero(feas)[0]
-            hit_combos.append(padded[fidx])
-            hit_req1.append(np.asarray(req1p)[fidx])
-            hit_req0.append(np.asarray(req0p)[fidx])
-            nhits += len(fidx)
+
+    if use_device_stream:
+        total = comb.n_choose_k(g, 7)
+        start = 0
+        while start < total and nhits < LUT7_CAP:
+            found, cstart, feas, r1, r0, examined, chunk = (
+                ctx.feasible_stream_driver(st, target, mask, inbits, k=7, start=start)
+            )
+            ctx.stats["lut7_candidates"] += examined
+            if not found:
+                break
+            feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+            rows = np.nonzero(feas)[0]
+            hit_combos.append(
+                np.stack(
+                    [comb.unrank_combination(cstart + int(r), g, 7) for r in rows]
+                )
+            )
+            hit_req1.append(r1[rows])
+            hit_req0.append(r0[rows])
+            nhits += len(rows)
+            start = cstart + chunk
+    else:
+        tables, _ = ctx.device_tables(st)
+        jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
+        excl = [b for b in inbits if b >= 0]
+        stream = comb.CombinationStream(g, 7)
+        csize = pick_chunk(stream.total, LUT7_CHUNK)
+        while nhits < LUT7_CAP:
+            chunk = stream.next_chunk(csize)
+            if chunk is None:
+                break
+            chunk = comb.filter_exclude(chunk, excl)
+            padded, nvalid = comb.pad_rows(chunk, csize)
+            ctx.stats["lut7_candidates"] += nvalid
+            valid = ctx.place_chunk(np.arange(csize) < nvalid)
+            feas, req1p, req0p = sweeps.lut_filter(
+                tables, ctx.place_chunk(padded), valid, jtarget, jmask
+            )
+            feas = np.asarray(feas)[:csize]
+            if feas.any():
+                fidx = np.nonzero(feas)[0]
+                hit_combos.append(padded[fidx])
+                hit_req1.append(np.asarray(req1p)[fidx])
+                hit_req0.append(np.asarray(req0p)[fidx])
+                nhits += len(fidx)
+
     if nhits == 0:
         return None
     combos = np.concatenate(hit_combos)[:LUT7_CAP]
@@ -246,6 +411,7 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         perm = ctx.rng.permutation(len(combos))
         combos, req1, req0 = combos[perm], req1[perm], req0[perm]
 
+    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
     jwo, jwm, jg = (
         ctx.place_replicated(wo_tab),
         ctx.place_replicated(wm_tab),
@@ -256,19 +422,21 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         r1, _ = comb.pad_rows(req1[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
         r0, _ = comb.pad_rows(req0[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
         ctx.stats["lut7_solved"] += hi - lo
-        found, best_t, sigma, flat = sweeps.lut7_solve(
-            ctx.place_chunk(r1, fill=0xFFFFFFFF),
-            ctx.place_chunk(r0, fill=0xFFFFFFFF),
-            jwo,
-            jwm,
-            jg,
-            ctx.next_seed(),
+        v = np.asarray(
+            sweeps.lut7_solve(
+                ctx.place_chunk(r1, fill=0xFFFFFFFF),
+                ctx.place_chunk(r0, fill=0xFFFFFFFF),
+                jwo,
+                jwm,
+                jg,
+                ctx.next_seed(),
+            )
         )
-        if not bool(found):
+        if not v[0]:
             continue
-        t = lo + int(best_t)
-        sigma = int(sigma)
-        func_outer, func_middle = divmod(int(flat), 256)
+        t = lo + int(v[1])
+        sigma = int(v[2])
+        func_outer, func_middle = divmod(int(v[3]), 256)
         combo = combos[t]
         order = orders[sigma]
         a, b, c, d, e, f = (int(combo[p]) for p in order[:6])
@@ -295,6 +463,11 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
             "gates": (a, b, c, d, e, f, gg),
         }
     return None
+
+
+# -------------------------------------------------------------------------
+# Combined driver
+# -------------------------------------------------------------------------
 
 
 def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:

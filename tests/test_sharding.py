@@ -136,20 +136,35 @@ def test_graft_entry():
         _os.chdir(cwd)
 
 
-def test_fused_lut5_mode_matches_default():
-    """Options.fused_lut5 must find an equivalent verified circuit."""
-    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
-    targets = make_targets(sbox)
-    mask = tt.mask_table(n)
-    for fused in (False, True):
-        st = State.init_inputs(n)
-        ctx = SearchContext(Options(seed=13, lut_graph=True, fused_lut5=fused))
-        r = generate_graph_one_output(
-            ctx, st, targets, 0, save_dir=None, log=lambda s: None
+def test_lut5_host_fallback_matches_device_stream():
+    """The host-chunked 5-LUT fallback (used beyond int32 rank space) finds
+    a verified decomposition equivalent to the device stream's."""
+    from sboxgates_tpu.search.lut import _lut5_search_host, lut5_search
+
+    rng = np.random.default_rng(5)
+    st = State.init_inputs(8)
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.graph.state import GATES
+
+    while st.num_gates < 14:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x2D, st.table(2), st.table(6), st.table(11))
+    target = tt.eval_lut(0xB4, outer, st.table(4), st.table(9))
+    mask = tt.mask_table(8)
+
+    for fn in (lut5_search, _lut5_search_host):
+        ctx = SearchContext(Options(seed=13, lut_graph=True))
+        res = fn(ctx, st, target, mask, [])
+        assert res is not None, fn.__name__
+        a, b, c, d, e = res["gates"]
+        got = tt.eval_lut(
+            res["func_inner"],
+            tt.eval_lut(res["func_outer"], st.table(a), st.table(b), st.table(c)),
+            st.table(d),
+            st.table(e),
         )
-        assert r, f"fused={fused} search failed"
-        gid = r[-1].outputs[0]
-        assert bool(tt.eq_mask(r[-1].table(gid), targets[0], mask))
+        assert bool(tt.eq_mask(got, target, mask)), fn.__name__
 
 
 def test_shard_chunk_pads_to_multiple():

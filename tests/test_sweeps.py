@@ -66,7 +66,8 @@ def test_cell_constraints_match_oracle(rng):
         req1, req0 = sweeps._cell_constraints(
             tabs, jnp.asarray(target), jnp.asarray(mask)
         )
-        req1, req0 = np.asarray(req1), np.asarray(req0)
+        # transposed contract: [cells, N]
+        req1, req0 = np.asarray(req1).T, np.asarray(req0).T
         for row, combo in enumerate(combos):
             feas_oracle = oracle_feasible(tables[combo], target, mask, k)
             feas_got = not (req1[row] & req0[row]).any()
@@ -85,7 +86,7 @@ def test_cell_constraints_lut_function(rng):
     )
     tabs = jnp.asarray(tables)[jnp.asarray(combos)]
     req1, req0 = sweeps._cell_constraints(tabs, jnp.asarray(target), jnp.asarray(mask))
-    req1, req0 = np.asarray(req1), np.asarray(req0)
+    req1, req0 = np.asarray(req1).T, np.asarray(req0).T
     feasible_rows = 0
     for row, combo in enumerate(combos):
         oracle = oracle_lut_function([tables[c] for c in combo], target, mask)
@@ -141,9 +142,10 @@ def test_tuple_match_sweep_finds_pair(rng):
         0,
         num_cells=4,
     )
-    assert bool(res.found)
-    pair = combos[int(res.index)]
-    entry = entries[int(res.slot)]
+    res = np.asarray(res)  # packed [found, index, slot, num_feasible]
+    assert bool(res[0])
+    pair = combos[int(res[1])]
+    entry = entries[int(res[2])]
     gids = [int(pair[p]) for p in entry.perm]
     got = tt.eval_gate2(entry.fun.fun, tables[gids[0]], tables[gids[1]])
     if entry.fun.not_out:
@@ -173,9 +175,10 @@ def test_tuple_match_sweep_noncommutative(rng):
         1,
         num_cells=4,
     )
-    assert bool(res.found)
-    pair = combos[int(res.index)]
-    entry = entries[int(res.slot)]
+    res = np.asarray(res)
+    assert bool(res[0])
+    pair = combos[int(res[1])]
+    entry = entries[int(res[2])]
     gids = [int(pair[p]) for p in entry.perm]
     got = tt.eval_gate2(bf.A_AND_NOT_B, tables[gids[0]], tables[gids[1]])
     assert bool(tt.eq_mask(got, target, mask))
@@ -184,47 +187,49 @@ def test_tuple_match_sweep_noncommutative(rng):
 def test_match_scan(rng):
     tables = random_tables(rng, 12)
     mask = tt.mask_table(8)
-    found, idx, inv = sweeps.match_scan(
-        jnp.asarray(tables),
-        jnp.ones(12, dtype=bool),
-        jnp.asarray(tables[5]),
-        jnp.asarray(mask),
-        7,
+    v = np.asarray(
+        sweeps.match_scan(
+            jnp.asarray(tables),
+            jnp.ones(12, dtype=bool),
+            jnp.asarray(tables[5]),
+            jnp.asarray(mask),
+            7,
+        )
     )
-    assert bool(found) and not bool(inv) and int(idx) == 5
-    found, idx, inv = sweeps.match_scan(
-        jnp.asarray(tables),
-        jnp.ones(12, dtype=bool),
-        jnp.asarray(~tables[3]),
-        jnp.asarray(mask),
-        7,
+    assert bool(v[0]) and not bool(v[2]) and int(v[1]) == 5
+    v = np.asarray(
+        sweeps.match_scan(
+            jnp.asarray(tables),
+            jnp.ones(12, dtype=bool),
+            jnp.asarray(~tables[3]),
+            jnp.asarray(mask),
+            7,
+        )
     )
-    assert bool(found) and bool(inv) and int(idx) == 3
+    assert bool(v[0]) and bool(v[2]) and int(v[1]) == 3
 
 
 # -- LUT kernels ---------------------------------------------------------
 
 
-def test_lut3_sweep_planted(rng):
+def test_lut3_stream_planted(rng):
+    from sboxgates_tpu.ops import combinatorics as comb
+
     tables = random_tables(rng, 8)
     target = tt.eval_lut(0x3A, tables[1], tables[4], tables[6])
     mask = tt.mask_table(8)
-    combos = np.asarray(
-        list(__import__("itertools").combinations(range(8), 3)), dtype=np.int32
+    binom = jnp.asarray(sweeps.binom_table())
+    excl = jnp.asarray(np.full(8, -1, np.int32))
+    total = comb.n_choose_k(8, 3)
+    v = np.asarray(
+        sweeps.lut3_stream(
+            jnp.asarray(tables), binom, 8, jnp.asarray(target),
+            jnp.asarray(mask), excl, 0, total, 3, chunk=64,
+        )
     )
-    res = sweeps.lut3_sweep(
-        jnp.asarray(tables),
-        jnp.asarray(combos),
-        jnp.ones(len(combos), dtype=bool),
-        jnp.asarray(target),
-        jnp.asarray(mask),
-        3,
-    )
-    assert bool(res.found)
-    row = combos[int(res.index)]
-    packed = int(res.slot)
-    req1, constrained = packed & 0xFF, (packed >> 8) & 0xFF
-    func = req1  # don't-cares zero
+    assert bool(v[0])
+    row = comb.unrank_combination(int(v[1]), 8, 3)
+    func = int(v[2]) & 0xFF  # don't-cares zero
     got = tt.eval_lut(
         func, tables[row[0]], tables[row[1]], tables[row[2]]
     )
@@ -260,16 +265,18 @@ def test_lut5_pipeline_planted(rng):
 
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     fidx = np.nonzero(feas)[0]
-    found, best_t, sel = sweeps.lut5_solve(
-        jnp.asarray(np.asarray(req1p)[fidx]),
-        jnp.asarray(np.asarray(req0p)[fidx]),
-        jnp.asarray(w_tab),
-        jnp.asarray(m_tab),
-        5,
+    v = np.asarray(
+        sweeps.lut5_solve(
+            jnp.asarray(np.asarray(req1p)[fidx]),
+            jnp.asarray(np.asarray(req0p)[fidx]),
+            jnp.asarray(w_tab),
+            jnp.asarray(m_tab),
+            5,
+        )
     )
-    assert bool(found)
-    t = int(best_t)
-    sigma, func_outer = divmod(int(sel), 256)
+    assert bool(v[0])
+    t = int(v[1])
+    sigma, func_outer = divmod(int(v[2]), 256)
     combo = combos[fidx[t]]
     ga, gb, gc, gd, ge = (int(combo[p]) for p in splits[sigma])
     req1_cells = ((int(np.asarray(req1p)[fidx][t]) >> np.arange(32)) & 1).astype(bool)
@@ -305,17 +312,19 @@ def test_lut7_pipeline_planted(rng):
     )
     assert bool(np.asarray(feas)[0])
     orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
-    found, best_t, sigma, flat = sweeps.lut7_solve(
-        jnp.asarray(req1p),
-        jnp.asarray(req0p),
-        jnp.asarray(wo_tab),
-        jnp.asarray(wm_tab),
-        jnp.asarray(g_tab),
-        11,
+    v = np.asarray(
+        sweeps.lut7_solve(
+            jnp.asarray(req1p),
+            jnp.asarray(req0p),
+            jnp.asarray(wo_tab),
+            jnp.asarray(wm_tab),
+            jnp.asarray(g_tab),
+            11,
+        )
     )
-    assert bool(found)
-    sigma = int(sigma)
-    func_outer, func_middle = divmod(int(flat), 256)
+    assert bool(v[0])
+    sigma = int(v[2])
+    func_outer, func_middle = divmod(int(v[3]), 256)
     order = orders[sigma]
     req1_cells = np.concatenate(
         [((int(w) >> np.arange(32)) & 1) for w in np.asarray(req1p)[0]]
@@ -379,3 +388,20 @@ def test_filter_exclude():
     combos = np.asarray([[0, 1, 2], [1, 2, 3], [2, 3, 4]], dtype=np.int32)
     out = comb.filter_exclude(combos, [0, 4])
     assert [tuple(r) for r in out] == [(1, 2, 3)]
+
+
+def test_host_cell_constraints_mirrors_device(rng):
+    """The numpy mirror used for host-side decode must agree with the
+    (transposed) device kernel."""
+    tables = random_tables(rng, 9)
+    target = tt.from_bits(rng.integers(0, 2, 256).astype(bool))
+    mask = tt.mask_table(8)
+    combos = np.asarray(
+        list(__import__("itertools").combinations(range(9), 5)), dtype=np.int32
+    )
+    tabs = jnp.asarray(tables)[jnp.asarray(combos)]
+    req1, req0 = sweeps._cell_constraints(tabs, jnp.asarray(target), jnp.asarray(mask))
+    req1, req0 = np.asarray(req1).T, np.asarray(req0).T
+    for row in (0, 17, len(combos) - 1):
+        h1, h0 = sweeps.host_cell_constraints(tables, combos[row], target, mask)
+        assert (h1 == req1[row]).all() and (h0 == req0[row]).all(), row
