@@ -1,0 +1,184 @@
+"""Command-line interface, flag-for-flag compatible with the reference
+(sboxgates.c:43-73, 895-986, 1044-1174).
+
+Same flags, same validation failures (exit non-zero on every case covered by
+the reference's CI contract, .travis.yml:27-39), same outputs: searches
+write ``O-GGG-MMMM-N-FFFFFFFF.xml`` state files to the working directory;
+``-c``/``-d`` convert a state file to C/CUDA or DOT on stdout.
+
+TPU-native additions (no reference counterpart, letters unused there):
+``--seed`` for reproducible randomized searches (the reference seeds from
+/dev/urandom, sboxgates.c:246-268) and ``--mesh`` to shard candidate sweeps
+over all visible devices instead of running single-chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import boolfunc as bf
+from .graph.state import GATES, SAT, State
+from .graph.xmlio import StateLoadError, load_state
+from .utils.sbox import SboxError, load_sbox, num_outputs
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sboxgates",
+        description=(
+            "Generates graphs of Boolean gates or 3-bit LUTs that realize a "
+            "target S-box. TPU-native reimplementation of dansarie/sboxgates."
+        ),
+    )
+    p.add_argument("input", nargs="?", help="S-box table file (or XML state for -c/-d)")
+    p.add_argument("-a", "--available-gates", type=int, default=None, metavar="NUM",
+                   help="bitfield of available 2-input gate types (default AND+OR+XOR = 194)")
+    p.add_argument("-c", "--convert-c", action="store_true",
+                   help="convert an XML state file to C/CUDA source")
+    p.add_argument("-d", "--convert-dot", action="store_true",
+                   help="convert an XML state file to Graphviz DOT")
+    p.add_argument("-g", "--graph", metavar="FILE", default=None,
+                   help="resume from a saved XML state")
+    p.add_argument("-i", "--iterations", type=int, default=1, metavar="NUM",
+                   help="number of search iterations (default 1)")
+    p.add_argument("-l", "--lut", action="store_true",
+                   help="generate LUT graphs (3-input LUTs)")
+    p.add_argument("-n", "--append-not", action="store_true",
+                   help="append NOT gates to available gate outputs/inputs")
+    p.add_argument("-o", "--single-output", type=int, default=-1, metavar="NUM",
+                   help="generate only output bit NUM (0-7)")
+    p.add_argument("-p", "--permute", type=int, default=0, metavar="NUM",
+                   help="XOR the S-box input with NUM before searching")
+    p.add_argument("-s", "--sat-metric", action="store_true",
+                   help="optimize for SAT/CNF metric instead of gate count")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="increase verbosity (repeatable)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="PRNG seed for reproducible randomized search")
+    p.add_argument("--mesh", action="store_true",
+                   help="shard candidate sweeps over all visible devices")
+    p.add_argument("--output-dir", default=".", metavar="DIR",
+                   help="directory for saved XML states (default: cwd)")
+    return p
+
+
+def _err(msg: str) -> int:
+    print(msg, file=sys.stderr)
+    return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Validation mirroring parse_opt (sboxgates.c:895-986).
+    if args.available_gates is not None and not (
+        0 < args.available_gates <= 65535
+    ):
+        return _err(f"Bad available gates value: {args.available_gates}")
+    if args.iterations < 1:
+        return _err(f"Bad iterations value: {args.iterations}")
+    if args.single_output != -1 and not (0 <= args.single_output <= 7):
+        return _err(f"Bad output value: {args.single_output}")
+    if not (0 <= args.permute <= 255):
+        return _err(f"Bad permutation value: {args.permute}")
+    if args.convert_c and args.convert_dot:
+        return _err("Cannot combine c and d options.")
+    if args.lut and args.sat_metric:
+        return _err("SAT metric can not be combined with LUT graph generation.")
+    if args.input is None:
+        return _err("Input file name argument missing.")
+
+    # Conversion mode: deserialize -> emit, no search (sboxgates.c:1097-1114).
+    if args.convert_c or args.convert_dot:
+        from .codegen import c_function_text, digraph_text
+
+        try:
+            st = load_state(args.input)
+        except (OSError, StateLoadError) as e:
+            return _err(f"Error when reading state file. ({e})")
+        if args.convert_c:
+            try:
+                sys.stdout.write(c_function_text(st))
+            except ValueError as e:
+                return _err(f"Error: {e}")
+        else:
+            sys.stdout.write(digraph_text(st))
+        return 0
+
+    # Deferred import: jax initialization is slow and unneeded for the
+    # validation/conversion paths above.
+    from .search import (
+        Options,
+        SearchContext,
+        generate_graph,
+        generate_graph_one_output,
+        make_targets,
+    )
+
+    try:
+        sbox, num_inputs = load_sbox(args.input, args.permute)
+    except OSError:
+        return _err("Error when opening target S-box file.")
+    except SboxError as e:
+        return _err(str(e))
+
+    targets = make_targets(sbox)
+    n_out = num_outputs(sbox, num_inputs)
+    if args.single_output >= n_out:
+        return _err(
+            f"Error: Can't generate output bit {args.single_output}. "
+            f"Target S-box only has {n_out} outputs."
+        )
+
+    opt = Options(
+        iterations=args.iterations,
+        oneoutput=args.single_output,
+        permute=args.permute,
+        metric=SAT if args.sat_metric else GATES,
+        lut_graph=args.lut,
+        try_nots=args.append_not,
+        avail_gates_bitfield=(
+            args.available_gates
+            if args.available_gates is not None
+            else bf.DEFAULT_AVAILABLE
+        ),
+        verbosity=args.verbose,
+        seed=args.seed,
+    )
+    mesh_plan = None
+    if args.mesh:
+        from .parallel import MeshPlan, make_mesh
+
+        mesh_plan = MeshPlan(make_mesh())
+    ctx = SearchContext(opt, mesh_plan=mesh_plan)
+
+    if args.verbose >= 1:
+        print("Available gates: NOT " + " ".join(
+            bf.GATE_NAMES[f.fun] for f in ctx.avail_gates))
+        print("Generated gates: " + " ".join(
+            bf.GATE_NAMES[f.fun] for f in ctx.avail_not))
+        print("Generated 3-input gates: " + " ".join(
+            "%02x" % f.fun for f in ctx.avail_3))
+
+    if args.graph is None:
+        st = State.init_inputs(num_inputs)
+    else:
+        try:
+            st = load_state(args.graph)
+        except (OSError, StateLoadError) as e:
+            return _err(f"Error when reading state file. ({e})")
+        print(f"Loaded {args.graph}.")
+
+    if args.single_output != -1:
+        generate_graph_one_output(
+            ctx, st, targets, args.single_output, save_dir=args.output_dir
+        )
+    else:
+        generate_graph(ctx, st, targets, save_dir=args.output_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

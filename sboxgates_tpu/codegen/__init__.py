@@ -1,0 +1,30 @@
+"""Codegen / conversion backends (reference: convert_graph.c).
+
+Emitters (text, format-compatible with the reference):
+- :func:`sboxgates_tpu.codegen.dot.digraph_text` — Graphviz DOT.
+- :func:`sboxgates_tpu.codegen.c_emit.c_function_text` — self-contained C
+  bitslice function, or CUDA with inline-PTX ``lop3.b32`` LUT macros when
+  the circuit contains LUT gates.
+
+Executors (TPU-native replacements for the reference's "compile the emitted
+CUDA" workflow — circuits run directly on-chip):
+- :func:`sboxgates_tpu.codegen.executor.compile_circuit` — jitted jax.numpy
+  bitslice evaluator.
+- :func:`sboxgates_tpu.codegen.pallas_kernel.compile_pallas` — a Pallas TPU
+  kernel evaluating the circuit over blocks of bitsliced words.
+- :func:`sboxgates_tpu.codegen.executor.execute_native` — the C++
+  interpreter from csrc/runtime.cpp (host validation path).
+"""
+
+from .c_emit import c_function_text
+from .dot import digraph_text
+from .executor import compile_circuit, eval_sbox, execute_native, gate_arrays
+
+__all__ = [
+    "c_function_text",
+    "digraph_text",
+    "compile_circuit",
+    "eval_sbox",
+    "execute_native",
+    "gate_arrays",
+]

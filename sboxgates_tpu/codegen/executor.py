@@ -1,0 +1,106 @@
+"""Circuit execution backends.
+
+The reference's only way to *run* a discovered circuit is to emit C/CUDA and
+compile it externally (convert_graph.c + the recompile tests in
+.travis.yml:44-51).  Here circuits execute directly:
+
+- :func:`compile_circuit` builds a jitted jax.numpy bitslice evaluator — the
+  circuit unrolls into a chain of elementwise uint32 ops that XLA fuses into
+  a handful of kernels (each lane bit is one evaluation; a [W]-word input
+  batch evaluates 32*W S-box inputs at once).
+- :func:`eval_sbox` runs the circuit over all 2^n inputs and returns the
+  S-box table it implements (the independent verifier used by tests).
+- :func:`execute_native` drives the C++ bitslice interpreter
+  (csrc/runtime.cpp) over the 256-position truth-table domain.
+
+See :mod:`sboxgates_tpu.codegen.pallas_kernel` for the Pallas TPU kernel
+variant (the reference's CUDA-LOP3 counterpart).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+from ..core import boolfunc as bf
+from ..core import ttable as tt
+from ..graph.state import NO_GATE, State
+
+
+def gate_arrays(st: State) -> Tuple[np.ndarray, ...]:
+    """(types, in1, in2, in3, funcs) int32/uint8 arrays describing the
+    circuit program (shared with the native interpreter's ABI)."""
+    types = np.array([g.type for g in st.gates], dtype=np.int32)
+
+    def arr(f):
+        return np.array(
+            [f(g) if f(g) != NO_GATE else -1 for g in st.gates], dtype=np.int32
+        )
+
+    in1 = arr(lambda g: g.in1)
+    in2 = arr(lambda g: g.in2)
+    in3 = arr(lambda g: g.in3)
+    funcs = np.array([g.function for g in st.gates], dtype=np.uint8)
+    return types, in1, in2, in3, funcs
+
+
+def output_bits(st: State) -> List[int]:
+    return [b for b in range(8) if st.outputs[b] != NO_GATE]
+
+
+def compile_circuit(st: State, jit: bool = True) -> Callable:
+    """Builds ``fn(inputs) -> outputs``: a bitslice evaluator.
+
+    ``inputs``: unsigned integer array ``[num_inputs, ...]`` — bit j of lane
+    word ``inputs[i]`` is input variable i of evaluation j.  Returns
+    ``[num_outputs, ...]`` in ``output_bits(st)`` order.  The circuit is
+    unrolled at trace time; XLA fuses the whole gate chain.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gates = [
+        (g.type, g.in1, g.in2, g.in3, g.function)
+        for g in st.gates
+    ]
+    n_in = st.num_inputs
+    outs = [st.outputs[b] for b in output_bits(st)]
+
+    def fn(inputs):
+        vals = [inputs[i] for i in range(n_in)]
+        for gtype, i1, i2, i3, func in gates[n_in:]:
+            if gtype == bf.NOT:
+                vals.append(~vals[i1])
+            elif gtype == bf.LUT:
+                vals.append(tt.eval_lut(func, vals[i1], vals[i2], vals[i3]))
+            else:
+                vals.append(tt.eval_gate2(gtype, vals[i1], vals[i2]))
+        return jnp.stack([vals[o] for o in outs])
+
+    return jax.jit(fn) if jit else fn
+
+
+def eval_sbox(st: State) -> np.ndarray:
+    """Evaluates the circuit over all 2^n inputs; returns the uint8 S-box
+    table it implements (bits assembled from the circuit's output map)."""
+    n = st.num_inputs
+    fn = compile_circuit(st)
+    inputs = np.stack([np.asarray(tt.input_table(i)) for i in range(n)])
+    out = np.asarray(fn(inputs))  # [n_out, 8] uint32 truth tables
+    bits = output_bits(st)
+    table = np.zeros(256, dtype=np.uint8)
+    for row, b in enumerate(bits):
+        table |= tt.to_bits(out[row]).astype(np.uint8) << b
+    return table[: 1 << n]
+
+
+def execute_native(st: State) -> np.ndarray:
+    """Runs the C++ interpreter; returns every gate's truth table as
+    uint32[G, 8] (must equal ``st.live_tables()``)."""
+    from .. import native
+
+    types, in1, in2, in3, funcs = gate_arrays(st)
+    itab = native.tables32_to_64(st.tables[: st.num_inputs])
+    out64 = native.execute_circuit(types, in1, in2, in3, funcs, itab)
+    return np.ascontiguousarray(out64).view(np.uint32).reshape(-1, 8)

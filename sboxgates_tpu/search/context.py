@@ -252,28 +252,21 @@ class SearchContext:
         )
 
     def feasible_stream_driver(
-        self, st: State, target, mask, inbits, k: int, start: int = 0
+        self, st: State, target, mask, inbits, k: int, start: int = 0,
+        prebuilt=None,
     ):
         """One device dispatch sweeping combination ranks [start, total):
         stops at the first chunk with a feasible k-tuple (whole-space
         while_loop; see sweeps.feasible_stream).
 
+        ``prebuilt`` (a stream_args result) lets resume loops reuse the
+        device operands instead of re-uploading them every iteration.
         Returns (found, chunk_start, feasible, req1, req0, examined, chunk).
         """
-        g = st.num_gates
-        total = comb.n_choose_k(g, k)
-        tables, _ = self.device_tables(st)
-        chunk = pick_chunk(total, STREAM_CHUNK[k])
-        args = (
-            tables,
-            self.binom,
-            g,
-            self.place_replicated(np.asarray(target)),
-            self.place_replicated(np.asarray(mask)),
-            self.place_replicated(self.excl_array(inbits)),
-            start,
-            total,
-        )
+        if prebuilt is None:
+            prebuilt = self.stream_args(st, target, mask, inbits, k)
+        base_args, total, chunk = prebuilt
+        args = (*base_args, start, total)
         if self.mesh_plan is not None:
             from ..parallel.mesh import sharded_feasible_stream
 

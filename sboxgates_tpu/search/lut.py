@@ -12,8 +12,9 @@ data ever crosses the host↔device link.  Multi-device meshes shard each
 chunk's rank block over the ``candidates`` axis with a psum'd found flag
 (:func:`sboxgates_tpu.parallel.mesh.sharded_feasible_stream`).
 
-For spaces whose rank exceeds int32 (C(G,k) >= 2^31; G>~84 for k=5) the
-drivers fall back to host-side chunk streaming through the same kernels.
+For spaces whose rank exceeds int32 (C(G,k) >= 2^31: G >= 194 for k=5,
+G >= 76 for k=7) the drivers fall back to host-side chunk streaming through
+the same kernels.
 """
 
 from __future__ import annotations
@@ -241,17 +242,18 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
             # two-phase path, then resume the fused stream after it.
             res = _lut5_chunk_two_phase(
                 ctx, st, target, mask, inbits, cstart, jw, jm,
-                splits, w_tab, m_tab,
+                splits, w_tab, m_tab, prebuilt=(args, total, chunk),
             )
             if res is not None:
                 return res
             start = cstart + chunk
         return None
 
+    prebuilt = ctx.stream_args(st, target, mask, inbits, 5)
     start = 0
     while start < total:
         found, cstart, feas, r1, r0, examined, chunk = ctx.feasible_stream_driver(
-            st, target, mask, inbits, k=5, start=start
+            st, target, mask, inbits, k=5, start=start, prebuilt=prebuilt
         )
         ctx.stats["lut5_candidates"] += examined
         if not found:
@@ -285,12 +287,13 @@ def _lut5_solve_feasible_chunk(
 
 
 def _lut5_chunk_two_phase(
-    ctx, st, target, mask, inbits, cstart, jw, jm, splits, w_tab, m_tab
+    ctx, st, target, mask, inbits, cstart, jw, jm, splits, w_tab, m_tab,
+    prebuilt=None,
 ) -> Optional[dict]:
     """Overflow fallback: fetch one chunk's full feasibility data and solve
     every feasible tuple (no in-kernel row cap)."""
     found, fstart, feas, r1, r0, _, _ = ctx.feasible_stream_driver(
-        st, target, mask, inbits, k=5, start=cstart
+        st, target, mask, inbits, k=5, start=cstart, prebuilt=prebuilt
     )
     if not found or fstart != cstart:
         return None  # nothing feasible in this exact chunk (cannot happen)
@@ -358,10 +361,13 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
 
     if use_device_stream:
         total = comb.n_choose_k(g, 7)
+        prebuilt = ctx.stream_args(st, target, mask, inbits, 7)
         start = 0
         while start < total and nhits < LUT7_CAP:
             found, cstart, feas, r1, r0, examined, chunk = (
-                ctx.feasible_stream_driver(st, target, mask, inbits, k=7, start=start)
+                ctx.feasible_stream_driver(
+                    st, target, mask, inbits, k=7, start=start, prebuilt=prebuilt
+                )
             )
             ctx.stats["lut7_candidates"] += examined
             if not found:

@@ -1,0 +1,141 @@
+"""CLI contract tests — the reference's CI matrix (.travis.yml:26-51)
+translated to in-process invocations of sboxgates_tpu.cli.main."""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.cli import main
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import NO_GATE
+from sboxgates_tpu.graph.xmlio import load_state
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+DES = os.path.join(DATA, "des_s1.txt")
+FA = os.path.join(DATA, "crypto1_fa.txt")
+
+
+# -- negative/validation contract (.travis.yml:27-39) ---------------------
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        [],                         # missing input
+        ["-a", "-123", DES],        # bad -a
+        ["-a", "65536", DES],
+        ["-i", "0", DES],
+        ["-i", "-123", DES],
+        ["-o", "-123", DES],
+        ["-o", "8", DES],
+        ["-p", "-123", DES],
+        ["-p", "256", DES],
+        ["-c", "-d", "test.xml"],   # exclusive
+        ["-l", "-s", DES],          # exclusive
+        ["nonexisting.txt"],
+        ["-o", "7", DES],           # DES S1 has only 4 outputs
+    ],
+)
+def test_invalid_invocations_fail(argv):
+    assert main(argv) != 0
+
+
+def test_help_exits_zero():
+    with pytest.raises(SystemExit) as e:
+        main(["--help"])
+    assert e.value.code == 0
+
+
+# -- functional runs (.travis.yml:40-50 analogues) ------------------------
+
+
+def _run_search(tmp, argv):
+    rc = main(argv + ["--output-dir", tmp])
+    assert rc == 0
+    return [f for f in sorted(os.listdir(tmp)) if f.endswith(".xml")]
+
+
+def test_single_output_sat_not_search():
+    """mpirun -N 4 ... -i 1 -o 0 -s -n des_s1 analogue."""
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _run_search(
+            tmp, ["-i", "1", "-o", "0", "-s", "-n", "--seed", "5", DES]
+        )
+        assert files
+        st = load_state(os.path.join(tmp, files[0]))
+        sbox, n = load_sbox(DES)
+        gid = st.outputs[0]
+        assert gid != NO_GATE
+        assert bool(
+            tt.eq_mask(st.table(gid), tt.target_table(sbox, 0), tt.mask_table(n))
+        )
+
+
+def test_resume_from_graph():
+    """Resume a saved single-output state (-g) and search another output."""
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _run_search(tmp, ["-i", "1", "-o", "0", "--seed", "5", FA])
+        resume = os.path.join(tmp, files[-1])
+        rc = main(
+            ["-i", "1", "-o", "0", "--seed", "6", "-g", resume, FA,
+             "--output-dir", tmp]
+        )
+        assert rc == 0
+
+
+def test_full_graph_restricted_gates_permute():
+    """-a 10694 -p 63 analogue on the small 4-input box (permute 15)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _run_search(
+            tmp, ["-a", "10694", "-i", "1", "-p", "15", "--seed", "4", FA]
+        )
+        assert files
+        st = load_state(os.path.join(tmp, files[-1]))
+        sbox, n = load_sbox(FA, permute=15)
+        for bit in range(8):
+            if st.outputs[bit] != NO_GATE:
+                assert bool(
+                    tt.eq_mask(
+                        st.table(st.outputs[bit]),
+                        tt.target_table(sbox, bit),
+                        tt.mask_table(n),
+                    )
+                )
+
+
+def test_lut_search_and_convert_roundtrip():
+    """-l -o 0 search, then -d (DOT) and -c (CUDA) conversion of the result."""
+    import io
+    from contextlib import redirect_stdout
+
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _run_search(tmp, ["-l", "-o", "0", "--seed", "7", FA])
+        xml = os.path.join(tmp, files[-1])
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert main(["-d", xml]) == 0
+        assert buf.getvalue().startswith("digraph sbox {")
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert main(["-c", xml]) == 0
+        out = buf.getvalue()
+        assert "lop3.b32" in out or "typedef unsigned long long int" in out
+
+
+def test_cli_subprocess_help():
+    """python -m sboxgates_tpu --help exits 0 (the smoke test)."""
+    r = subprocess.run(
+        ["python", "-m", "sboxgates_tpu", "--help"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0
+    assert "sboxgates" in r.stdout
