@@ -550,6 +550,223 @@ def lut5_stream(
     return jnp.stack([status, rank, sigma, fo, r1, r0, cstart, examined])
 
 
+# -------------------------------------------------------------------------
+# Pivot-structured 5-LUT sweep
+#
+# The rank-chunk stream above pays two per-candidate costs that dominate on
+# TPU: a 5-way per-lane gather of gate tables (pathological on the VPU) and
+# lexicographic unranking.  This sweep removes both by enumerating every
+# 5-set {a<b<m<d<e} as (low pair (a,b)) x (pivot m) x (high pair (d,e)):
+#
+# - pair Karnaugh-cell masks are precomputed ONCE per search call for all
+#   C(G,2) pairs (one small gather, amortized over the whole space);
+# - low pairs sorted by (max, min) put all pairs below a pivot in a
+#   contiguous prefix, high pairs sorted by (min, max) put all pairs above
+#   it in a contiguous suffix — so every tile of candidates is a pair of
+#   dynamic_slice calls, and a candidate's identity is (pivot, row, col),
+#   no rank arithmetic (works for any G <= 512, no int32 fallback).
+#
+# The kernel's candidate block is [TL, TH] (low x high) with the high axis
+# minormost on the VPU lanes.
+# -------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def pivot_pair_grids(g: int):
+    """(lowgrid [P2,2] sorted by (b,a), highgrid [P2,2] sorted by (d,e),
+    high_offsets [g+1]) with high_offsets[m] = index of the first high pair
+    whose min element is > m-1... i.e. pairs with d >= m start at
+    high_offsets[m]."""
+    lows = np.array(
+        [(a, b) for b in range(g) for a in range(b)], dtype=np.int32
+    ).reshape(-1, 2)
+    highs = np.array(
+        [(d, e) for d in range(g) for e in range(d + 1, g)], dtype=np.int32
+    ).reshape(-1, 2)
+    # pairs with d < m: sum_{d=0..m-1} (g-1-d)
+    offs = np.zeros(g + 1, dtype=np.int64)
+    for m in range(1, g + 1):
+        offs[m] = offs[m - 1] + (g - 1 - (m - 1))
+    return lows, highs, offs
+
+
+def pivot_tile_descs(g: int, tl: int, th: int, excl=()) -> np.ndarray:
+    """Tile descriptors [T, 5]: (pivot m, lo0, lo_end, hi0, hi_end) covering
+    every 5-set exactly once (lo/hi are absolute rows into the grids)."""
+    _, _, offs = pivot_pair_grids(g)
+    excl = set(int(b) for b in excl)
+    descs = []
+    for m in range(2, g - 2):
+        if m in excl:
+            continue
+        nlo = m * (m - 1) // 2
+        hi_base = int(offs[m + 1])
+        nhi = (g - 1 - m) * (g - 2 - m) // 2
+        for lo0 in range(0, nlo, tl):
+            lo_end = min(nlo, lo0 + tl)
+            for h0 in range(0, nhi, th):
+                descs.append(
+                    (m, lo0, lo_end, hi_base + h0, hi_base + min(nhi, h0 + th))
+                )
+    if not descs:
+        return np.zeros((0, 5), dtype=np.int32)
+    return np.asarray(descs, dtype=np.int32)
+
+
+@jax.jit
+def pivot_pair_cells(tables, lowgrid, highgrid, target, mask):
+    """Per-pair cell masks: (lc1, lc0) [4, P2, W] for low pairs (cells
+    pre-intersected with the required-1/required-0 position sets) and hc
+    [4, P2, W] for high pairs.  Cell j of a pair (x, y) is the positions
+    where (x, y) take the bit pattern (j>>1, j&1)."""
+    need1 = mask & target
+    need0 = mask & ~target
+
+    def cells(grid):
+        tx = tables[grid[:, 0]]          # [P2, W]
+        ty = tables[grid[:, 1]]
+        return jnp.stack(
+            [
+                ~tx & ~ty,
+                ~tx & ty,
+                tx & ~ty,
+                tx & ty,
+            ]
+        )                                # [4, P2, W]
+
+    lc = cells(lowgrid)
+    hc = cells(highgrid)
+    return lc & need1, lc & need0, hc
+
+
+def _extract_top_rows(prio, rows):
+    """Indices of up to ``rows`` highest-priority entries via iterative
+    argmax (lax.top_k over a 100k+ axis measures ~50ms on TPU; `rows`
+    argmax+clear passes are far cheaper for small `rows`)."""
+    idxs = []
+    p = prio
+    for _ in range(rows):
+        b = jnp.argmax(p).astype(jnp.int32)
+        idxs.append(b)
+        p = p.at[b].set(0)
+    return jnp.stack(idxs)
+
+
+def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
+    """Shared per-tile constraint computation.  d: descriptor int32[5].
+    Returns (valid [tl,th], req1, req0 packed uint32 [tl,th])."""
+    m, lo0, lo_end, hi0, hi_end = d[0], d[1], d[2], d[3], d[4]
+    pm = tables[m]
+    l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
+    l0 = jax.lax.dynamic_slice(lc0, (0, lo0, 0), (4, tl, lc0.shape[2]))
+    hcs = jax.lax.dynamic_slice(hc, (0, hi0, 0), (4, th, hc.shape[2]))
+    lv = ((lo0 + jnp.arange(tl, dtype=jnp.int32)) < lo_end) & (
+        jax.lax.dynamic_slice(lowvalid, (lo0,), (tl,))
+    )
+    hv = ((hi0 + jnp.arange(th, dtype=jnp.int32)) < hi_end) & (
+        jax.lax.dynamic_slice(highvalid, (hi0,), (th,))
+    )
+    valid = lv[:, None] & hv[None, :]
+    req1 = jnp.zeros((tl, th), jnp.uint32)
+    req0 = jnp.zeros((tl, th), jnp.uint32)
+    conflict = jnp.zeros((tl, th), bool)
+    for j in range(4):
+        for sbit in (0, 1):
+            pmask = pm if sbit else ~pm
+            low1 = l1[j] & pmask
+            low0 = l0[j] & pmask
+            for c2 in range(4):
+                h = hcs[c2]
+                r1 = ((low1[:, None, :] & h[None, :, :]) != 0).any(-1)
+                r0 = ((low0[:, None, :] & h[None, :, :]) != 0).any(-1)
+                cellbit = (j << 3) | (sbit << 2) | c2
+                req1 = req1 | (r1.astype(jnp.uint32) << cellbit)
+                req0 = req0 | (r0.astype(jnp.uint32) << cellbit)
+                conflict = conflict | (r1 & r0)
+    return valid, valid & ~conflict, req1, req0
+
+
+@functools.partial(jax.jit, static_argnames=("tl", "th"))
+def lut5_pivot_tile(tables, lc1, lc0, hc, lowvalid, highvalid, descs, t, *, tl, th):
+    """Feasibility + packed constraints for ONE tile (the host-side re-drive
+    path when the in-kernel solver overflows).  Returns (feasible [tl*th],
+    req1, req0)."""
+    _, feasible, req1, req0 = _pivot_tile_constraints(
+        tables, lc1, lc0, hc, lowvalid, highvalid, descs[t], tl, th
+    )
+    return feasible.reshape(-1), req1.reshape(-1), req0.reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("tl", "th", "solve_rows"))
+def lut5_pivot_stream(
+    tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
+    w_tab, m_tab, seed, *, tl, th, solve_rows=64
+):
+    """Whole-space 5-LUT search over pivot tiles [start_t, t_end) in one
+    dispatch.
+
+    Returns packed int32[9]: [status, m, lo_abs, hi_abs, sigma, func_outer,
+    req1, req0, next_tile] — status as in :func:`lut5_stream` (0 exhausted /
+    1 found / 2 solver-row overflow; the tile concerned is next_tile - 1).
+    ``descs`` may be padded past ``t_end`` for shape bucketing.  Candidate
+    counts are host-side arithmetic over the tile descriptors (an in-kernel
+    int32 counter would overflow for G beyond ~200).
+    """
+    start_t = jnp.asarray(start_t, jnp.int32)
+    t_end = jnp.asarray(t_end, jnp.int32)
+    z = jnp.int32(0)
+    init = (z, start_t, z, z, z, z, z, z, z)
+
+    def cond(s):
+        return (s[0] == 0) & (s[1] < t_end)
+
+    def body(s):
+        t = s[1]
+        d = descs[t]
+        _, feas2d, req1, req0 = _pivot_tile_constraints(
+            tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+        )
+        feasible = feas2d.reshape(-1)
+
+        def solve_tile(_):
+            nfeas = feasible.sum(dtype=jnp.int32)
+            prio = jnp.where(feasible, _priority(tl * th, seed ^ t), 0)
+            topi = _extract_top_rows(prio, solve_rows)
+            fsel = feasible[topi]
+            full = jnp.uint32(0xFFFFFFFF)
+            fr1 = jnp.where(fsel, req1.reshape(-1)[topi], full)
+            fr0 = jnp.where(fsel, req0.reshape(-1)[topi], full)
+            found, best_t, sel = _lut5_solve_core(
+                fr1, fr0, w_tab, m_tab, seed ^ t ^ 0x9E37
+            )
+            overflow = (nfeas > solve_rows) & ~found
+            status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
+            flat = topi[best_t]
+            return (
+                status.astype(jnp.int32),
+                d[0],
+                d[1] + flat // th,
+                d[3] + flat % th,
+                sel // 256,
+                sel % 256,
+                _bitcast_i32(fr1[best_t]),
+                _bitcast_i32(fr0[best_t]),
+            )
+
+        def skip_tile(_):
+            return (z, z, z, z, z, z, z, z)
+
+        status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = jax.lax.cond(
+            feasible.any(), solve_tile, skip_tile, None
+        )
+        return (status, t + 1, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b)
+
+    status, t, m, lo_abs, hi_abs, sigma, fo, r1b, r0b = jax.lax.while_loop(
+        cond, body, init
+    )
+    return jnp.stack([status, m, lo_abs, hi_abs, sigma, fo, r1b, r0b, t])
+
+
 @functools.partial(jax.jit, static_argnames=("k", "chunk", "num_cells"))
 def match_stream(
     tables, binom, g, target, mask, excl, start, total, match_table, seed,

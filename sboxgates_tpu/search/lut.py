@@ -191,6 +191,137 @@ def _solve_lut5_rows(
     return None
 
 
+# Pivot sweep tile shape: the high axis rides the VPU lanes.
+PIVOT_TL, PIVOT_TH = 256, 512
+# Below this space size the rank-chunk stream's per-candidate overhead is
+# irrelevant and its single compiled shape is cheaper than tiling.
+PIVOT_MIN_TOTAL = 1 << 21
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(10, (n - 1).bit_length())
+
+
+def _lut5_search_pivot(
+    ctx: SearchContext, st: State, target, mask, inbits
+) -> Optional[dict]:
+    """Pivot-structured whole-space sweep (sweeps.lut5_pivot_stream): no
+    per-candidate gathers, no rank arithmetic, no int32 space limit."""
+    g = st.num_gates
+    lows, highs, _ = sweeps.pivot_pair_grids(g)
+    excl = [b for b in inbits if b >= 0]
+    descs = sweeps.pivot_tile_descs(g, PIVOT_TL, PIVOT_TH, excl)
+    t_real = descs.shape[0]
+    if t_real == 0:
+        return None
+    tile_sizes = (
+        (descs[:, 2] - descs[:, 1]).astype(np.int64)
+        * (descs[:, 4] - descs[:, 3]).astype(np.int64)
+    )
+    size_cum = np.concatenate([[0], np.cumsum(tile_sizes)])
+
+    p2 = lows.shape[0]
+    p2pad = _next_pow2(p2 + max(PIVOT_TL, PIVOT_TH))
+    tpad = _next_pow2(t_real)
+    descs_p = np.zeros((tpad, 5), np.int32)
+    descs_p[:t_real] = descs
+    lowvalid = np.zeros(p2pad, bool)
+    highvalid = np.zeros(p2pad, bool)
+    lowvalid[:p2] = ~np.isin(lows, excl).any(1) if excl else True
+    highvalid[:p2] = ~np.isin(highs, excl).any(1) if excl else True
+    lows_p = np.zeros((p2pad, 2), np.int32)
+    lows_p[:p2] = lows
+    highs_p = np.zeros((p2pad, 2), np.int32)
+    highs_p[:p2] = highs
+
+    tables, _ = ctx.device_tables(st)
+    jt = ctx.place_replicated(np.asarray(target))
+    jmk = ctx.place_replicated(np.asarray(mask))
+    lc1, lc0, hc = sweeps.pivot_pair_cells(
+        tables,
+        ctx.place_replicated(lows_p),
+        ctx.place_replicated(highs_p),
+        jt,
+        jmk,
+    )
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+    jdescs = ctx.place_replicated(descs_p)
+    jlv = ctx.place_replicated(lowvalid)
+    jhv = ctx.place_replicated(highvalid)
+
+    def combo_at(m: int, lo_abs: int, hi_abs: int) -> np.ndarray:
+        return np.array(
+            [
+                lows[lo_abs, 0],
+                lows[lo_abs, 1],
+                m,
+                highs[hi_abs, 0],
+                highs[hi_abs, 1],
+            ],
+            dtype=np.int32,
+        )
+
+    start_t = 0
+    while start_t < t_real:
+        v = np.asarray(
+            sweeps.lut5_pivot_stream(
+                tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
+                jw, jm, ctx.next_seed(), tl=PIVOT_TL, th=PIVOT_TH,
+            )
+        )
+        status, next_t = int(v[0]), int(v[8])
+        ctx.stats["lut5_candidates"] += int(
+            size_cum[min(next_t, t_real)] - size_cum[start_t]
+        )
+        if status == 0:
+            return None
+        if status == 1:
+            combo = combo_at(int(v[1]), int(v[2]), int(v[3]))
+            return _decode_lut5(
+                ctx,
+                combo,
+                int(v[4]),
+                int(v[5]),
+                _unpack32(int(v[6]) & 0xFFFFFFFF),
+                _unpack32(int(v[7]) & 0xFFFFFFFF),
+                splits,
+                w_tab,
+                m_tab,
+            )
+        # status 2: more feasible tuples in tile next_t-1 than the in-kernel
+        # solver rows — fetch that tile's full constraints and solve them all.
+        t_over = next_t - 1
+        feas, r1, r0 = sweeps.lut5_pivot_tile(
+            tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over,
+            tl=PIVOT_TL, th=PIVOT_TH,
+        )
+        rows = np.nonzero(np.asarray(feas))[0]
+        if rows.size:
+            if ctx.opt.randomize:
+                rows = rows[ctx.rng.permutation(len(rows))]
+            d = descs[t_over]
+            combos = np.stack(
+                [
+                    combo_at(
+                        int(d[0]),
+                        int(d[1]) + int(r) // PIVOT_TH,
+                        int(d[3]) + int(r) % PIVOT_TH,
+                    )
+                    for r in rows
+                ]
+            )
+            res = _solve_lut5_rows(
+                ctx, st, target, mask, combos,
+                np.asarray(r1)[rows], np.asarray(r0)[rows],
+                jw, jm, splits, w_tab, m_tab,
+            )
+            if res is not None:
+                return res
+        start_t = t_over + 1
+    return None
+
+
 def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
     """5-LUT search: find LUT(LUT(a,b,c), d, e) realizing the target
     (reference: search_5lut, lut.c:116-249).
@@ -198,11 +329,14 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     Returns {func_outer, func_inner, gates: (a,b,c,d,e)} or None.  The
     device stream yields chunks containing feasible tuples; each is solved
     in the packed cell domain, continuing the sweep past chunks whose
-    feasible tuples admit no LUT(LUT,·,·) decomposition.
+    feasible tuples admit no LUT(LUT,·,·) decomposition.  Large spaces use
+    the pivot-structured sweep (no gathers / rank arithmetic).
     """
     g = st.num_gates
     if g < 5:
         return None
+    if ctx.mesh_plan is None and comb.n_choose_k(g, 5) >= PIVOT_MIN_TOTAL:
+        return _lut5_search_pivot(ctx, st, target, mask, inbits)
     if not sweeps.device_rank_limit(g, 5):
         return _lut5_search_host(ctx, st, target, mask, inbits)
     splits, w_tab, m_tab = sweeps.lut5_split_tables()

@@ -405,3 +405,73 @@ def test_host_cell_constraints_mirrors_device(rng):
     for row in (0, 17, len(combos) - 1):
         h1, h0 = sweeps.host_cell_constraints(tables, combos[row], target, mask)
         assert (h1 == req1[row]).all() and (h0 == req0[row]).all(), row
+
+
+# -- pivot-structured 5-LUT sweep ----------------------------------------
+
+
+def test_pivot_tiles_cover_space_exactly():
+    from sboxgates_tpu.ops import combinatorics as comb
+
+    for g in (6, 9, 22, 40):
+        descs = sweeps.pivot_tile_descs(g, 16, 32)
+        sizes = (descs[:, 2] - descs[:, 1]) * (descs[:, 4] - descs[:, 3])
+        assert sizes.sum() == comb.n_choose_k(g, 5), g
+        # every tile's rows land inside its pivot's valid ranges
+        lows, highs, offs = sweeps.pivot_pair_grids(g)
+        for m, lo0, lo_end, hi0, hi_end in descs:
+            assert lo_end <= m * (m - 1) // 2
+            assert (lows[lo0:lo_end] < m).all()
+            assert (highs[hi0:hi_end] > m).all()
+
+
+def test_pivot_search_finds_planted_decomposition(rng):
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.lut import _lut5_search_pivot
+
+    st = State.init_inputs(8)
+    nprng = np.random.default_rng(11)
+    while st.num_gates < 22:
+        a, b = nprng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x2D, st.table(3), st.table(8), st.table(14))
+    target = tt.eval_lut(0xB4, outer, st.table(5), st.table(19))
+    mask = tt.mask_table(8)
+    ctx = SearchContext(Options(seed=2, lut_graph=True))
+    res = _lut5_search_pivot(ctx, st, target, mask, [])
+    assert res is not None
+    a, b, c, d, e = res["gates"]
+    got = tt.eval_lut(
+        res["func_inner"],
+        tt.eval_lut(res["func_outer"], st.table(a), st.table(b), st.table(c)),
+        st.table(d),
+        st.table(e),
+    )
+    assert bool(tt.eq_mask(got, target, mask))
+    assert ctx.stats["lut5_candidates"] > 0
+
+
+def test_pivot_search_respects_exclusions(rng):
+    """With every planted gate excluded, the sweep must find nothing (the
+    target is otherwise unrealizable from XOR combinations)."""
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.lut import _lut5_search_pivot
+    from sboxgates_tpu.utils.sbox import load_sbox
+    import os
+
+    sbox, n = load_sbox(
+        os.path.join(os.path.dirname(__file__), "data", "rijndael.txt")
+    )
+    st = State.init_inputs(8)
+    nprng = np.random.default_rng(3)
+    while st.num_gates < 20:
+        a, b = nprng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    target = tt.target_table(sbox, 0)  # not 5-LUT realizable from XOR layers
+    mask = tt.mask_table(8)
+    ctx = SearchContext(Options(seed=2, lut_graph=True))
+    assert _lut5_search_pivot(ctx, st, target, mask, [1, 4]) is None
