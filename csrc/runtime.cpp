@@ -97,6 +97,7 @@ uint32_t sbg_fingerprint(const uint8_t* data, uint64_t len) {
   for (uint64_t i = 0; i + 1 < len; i += 2) {
     round_((uint16_t)(data[i] | (data[i + 1] << 8)));
   }
+  if (len & 1) round_((uint16_t)data[len - 1]);  // trailing odd byte, state.c:99-102
   for (int i = 0; i < 22; i++) round_(0);
   return ((uint32_t)p1 << 16) | p2;
 }
@@ -111,11 +112,13 @@ uint32_t sbg_fingerprint(const uint8_t* data, uint64_t len) {
 static uint64_t n_choose_k(uint64_t n, uint64_t k) {
   if (k > n) return 0;
   if (k > n - k) k = n - k;
-  uint64_t r = 1;
+  // 128-bit intermediate: r * (n - i) overflows uint64 for k >= 8 with
+  // n near 512 (peak product ~3e19 for C(512,8)).
+  unsigned __int128 r = 1;
   for (uint64_t i = 0; i < k; i++) {
     r = r * (n - i) / (i + 1);
   }
-  return r;
+  return (uint64_t)r;
 }
 
 uint64_t sbg_n_choose_k(uint64_t n, uint64_t k) { return n_choose_k(n, k); }

@@ -134,7 +134,6 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     opt = Options(
         iterations=args.iterations,
-        oneoutput=args.single_output,
         permute=args.permute,
         metric=SAT if args.sat_metric else GATES,
         lut_graph=args.lut,

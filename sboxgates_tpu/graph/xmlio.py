@@ -200,6 +200,9 @@ def state_from_xml(text: str) -> State:
             inputs[inp] = gid
             inp += 1
 
+        if st.num_gates >= MAX_GATES:
+            raise StateLoadError(f"more than MAX_GATES={MAX_GATES} gates")
+
         if gtype <= bf.TRUE_GATE:
             if inp != 2:
                 raise StateLoadError("2-input gate needs exactly 2 inputs")

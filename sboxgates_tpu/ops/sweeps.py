@@ -97,13 +97,29 @@ def _pack_bits_t(bits):
     return jnp.moveaxis(w, 0, -1)
 
 
-def _priority(n, seed):
-    """Hashed per-row random priority (never zero) for match tie-breaking."""
-    x = jnp.arange(n, dtype=jnp.uint32) + jnp.asarray(seed).astype(jnp.uint32)
+def _priority(n, seed, *, det_newest=False):
+    """Hashed per-row random priority (never zero) for match tie-breaking.
+
+    A negative seed requests deterministic selection — the counterpart of
+    the reference's unshuffled scan when ``--randomize`` is off: priorities
+    descend with the row index so argmax takes the first row in sweep order
+    (globally the lexicographically-first hit, since streams stop at the
+    first chunk containing one).  ``det_newest`` flips the deterministic
+    direction for the in-state gate scan, whose reference order is
+    newest-first (sboxgates.c:285-299).  Kernels xor chunk/tile counters
+    into the seed; those are < 2^31 so the sign bit survives.
+    """
+    s = jnp.asarray(seed, jnp.int32)
+    x = jnp.arange(n, dtype=jnp.uint32) + s.astype(jnp.uint32)
     x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
     x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
     x = x ^ (x >> 16)
-    return x | jnp.uint32(1)
+    hashed = x | jnp.uint32(1)
+    if det_newest:
+        det = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    else:
+        det = jnp.arange(n, 0, -1, dtype=jnp.uint32)
+    return jnp.where(s < 0, det, hashed)
 
 
 # -------------------------------------------------------------------------
@@ -188,7 +204,7 @@ def match_scan(tables, valid, target, mask, seed):
     matches."""
     eq = tt.eq_mask(tables, target, mask) & valid
     neq = tt.eq_mask(~tables, target, mask) & valid
-    prio = _priority(valid.shape[0], seed)
+    prio = _priority(valid.shape[0], seed, det_newest=True)
     direct = jnp.where(eq, prio, 0)
     inverted = jnp.where(neq, prio, 0)
     use_inv = ~eq.any()
@@ -391,7 +407,7 @@ def _unrank_combos(binom, g, k, ranks):
 def _stream_chunk_constraints(tables, binom, g, k, target, mask, excl, ranks, total):
     """Shared per-chunk work: unrank -> exclusion mask -> cell constraints.
 
-    Returns (feasible [N] bool, req1, req0 packed, combos [k, N]).
+    Returns (feasible [N] bool, req1 packed, req0 packed).
     """
     valid = ranks < total
     combos = _unrank_combos(binom, g, k, jnp.minimum(ranks, total - 1))
@@ -697,6 +713,55 @@ def lut5_pivot_tile(tables, lc1, lc0, hc, lowvalid, highvalid, descs, t, *, tl, 
     return feasible.reshape(-1), req1.reshape(-1), req0.reshape(-1)
 
 
+def _pivot_tile_step(
+    tables, lc1, lc0, hc, lowvalid, highvalid, d, w_tab, m_tab, seed_t,
+    active, tl, th, solve_rows
+):
+    """One pivot tile's filter + in-kernel decomposition solve (shared by the
+    single-device stream and the mesh-sharded SPMD stream).
+
+    d: descriptor int32[5]; seed_t: per-tile seed; active: bool scalar
+    masking the whole tile off (sharded lockstep rounds run past t_end on
+    some devices).  Returns (status, m, lo_abs, hi_abs, sigma, func_outer,
+    req1, req0) — status 0 none / 1 found / 2 solver-row overflow.
+    """
+    _, feas2d, req1, req0 = _pivot_tile_constraints(
+        tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+    )
+    feasible = feas2d.reshape(-1) & active
+
+    def solve_tile(_):
+        nfeas = feasible.sum(dtype=jnp.int32)
+        prio = jnp.where(feasible, _priority(tl * th, seed_t), 0)
+        topi = _extract_top_rows(prio, solve_rows)
+        fsel = feasible[topi]
+        full = jnp.uint32(0xFFFFFFFF)
+        fr1 = jnp.where(fsel, req1.reshape(-1)[topi], full)
+        fr0 = jnp.where(fsel, req0.reshape(-1)[topi], full)
+        found, best_t, sel = _lut5_solve_core(
+            fr1, fr0, w_tab, m_tab, seed_t ^ 0x9E37
+        )
+        overflow = (nfeas > solve_rows) & ~found
+        status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
+        flat = topi[best_t]
+        return (
+            status.astype(jnp.int32),
+            d[0],
+            d[1] + flat // th,
+            d[3] + flat % th,
+            sel // 256,
+            sel % 256,
+            _bitcast_i32(fr1[best_t]),
+            _bitcast_i32(fr0[best_t]),
+        )
+
+    def skip_tile(_):
+        z = jnp.int32(0)
+        return (z, z, z, z, z, z, z, z)
+
+    return jax.lax.cond(feasible.any(), solve_tile, skip_tile, None)
+
+
 @functools.partial(jax.jit, static_argnames=("tl", "th", "solve_rows"))
 def lut5_pivot_stream(
     tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
@@ -722,42 +787,9 @@ def lut5_pivot_stream(
 
     def body(s):
         t = s[1]
-        d = descs[t]
-        _, feas2d, req1, req0 = _pivot_tile_constraints(
-            tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
-        )
-        feasible = feas2d.reshape(-1)
-
-        def solve_tile(_):
-            nfeas = feasible.sum(dtype=jnp.int32)
-            prio = jnp.where(feasible, _priority(tl * th, seed ^ t), 0)
-            topi = _extract_top_rows(prio, solve_rows)
-            fsel = feasible[topi]
-            full = jnp.uint32(0xFFFFFFFF)
-            fr1 = jnp.where(fsel, req1.reshape(-1)[topi], full)
-            fr0 = jnp.where(fsel, req0.reshape(-1)[topi], full)
-            found, best_t, sel = _lut5_solve_core(
-                fr1, fr0, w_tab, m_tab, seed ^ t ^ 0x9E37
-            )
-            overflow = (nfeas > solve_rows) & ~found
-            status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
-            flat = topi[best_t]
-            return (
-                status.astype(jnp.int32),
-                d[0],
-                d[1] + flat // th,
-                d[3] + flat % th,
-                sel // 256,
-                sel % 256,
-                _bitcast_i32(fr1[best_t]),
-                _bitcast_i32(fr0[best_t]),
-            )
-
-        def skip_tile(_):
-            return (z, z, z, z, z, z, z, z)
-
-        status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = jax.lax.cond(
-            feasible.any(), solve_tile, skip_tile, None
+        status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = _pivot_tile_step(
+            tables, lc1, lc0, hc, lowvalid, highvalid, descs[t],
+            w_tab, m_tab, seed ^ t, jnp.bool_(True), tl, th, solve_rows,
         )
         return (status, t + 1, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b)
 

@@ -95,6 +95,16 @@ class MeshPlan:
         return jax.device_put(arr, self._replicated)
 
 
+def _jit_shard_map(local, **specs):
+    """shard_map + jit with the replication check disabled (kwarg renamed
+    check_rep -> check_vma in jax 0.8)."""
+    try:
+        smapped = shard_map(local, check_vma=False, **specs)
+    except TypeError:
+        smapped = shard_map(local, check_rep=False, **specs)
+    return jax.jit(smapped)
+
+
 @jax.jit
 def lut5_fused_step(tables, combos, valid, target, mask, w_tab, m_tab, seed):
     """One fused, shardable 5-LUT search step: feasibility filter + split /
@@ -158,7 +168,8 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
         verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
         return verdict, feasible, r1, r0
 
-    specs = dict(
+    return _jit_shard_map(
+        local,
         mesh=mesh,
         in_specs=(P(),) * 8,
         out_specs=(
@@ -168,11 +179,6 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
             P(CANDIDATES_AXIS),
         ),
     )
-    try:  # jax >= 0.8 names the replication check check_vma
-        smapped = shard_map(local, check_vma=False, **specs)
-    except TypeError:
-        smapped = shard_map(local, check_rep=False, **specs)
-    return jax.jit(smapped)
 
 
 def sharded_feasible_stream(
@@ -182,6 +188,84 @@ def sharded_feasible_stream(
     """Mesh-sharded counterpart of sweeps.feasible_stream (same contract)."""
     fn = _sharded_stream_fn(plan.mesh, k, chunk)
     return fn(tables, binom, g, target, mask, excl, start, total)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_pivot_fn(mesh: Mesh, tl: int, th: int, solve_rows: int):
+    """Compiled SPMD pivot-tile stream for one (mesh, tile-shape).
+
+    Lockstep rounds: in round r, device d sweeps tile ``start_t + r*n + d``
+    (static interleaved partitioning — the mesh analog of the reference's
+    per-rank combination ranges, lut.c:138-149); the psum'd found flag stops
+    every device at the end of the first round containing a hit or an
+    overflow.  Each device returns its own packed verdict row; the host
+    resolves them in tile order, so the selected circuit is identical to the
+    single-device stream's when not randomizing.
+    """
+    n = mesh.shape[CANDIDATES_AXIS]
+
+    def local(
+        tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
+        w_tab, m_tab, seed,
+    ):
+        d = jax.lax.axis_index(CANDIDATES_AXIS).astype(jnp.int32)
+        start_t = jnp.asarray(start_t, jnp.int32)
+        t_end = jnp.asarray(t_end, jnp.int32)
+        z = jnp.int32(0)
+        init = (jnp.bool_(False), start_t, z, jnp.int32(-1), z, z, z, z, z, z, z)
+
+        def cond(s):
+            return (~s[0]) & (s[1] < t_end)
+
+        def body(s):
+            base = s[1]
+            t = base + d
+            active = t < t_end
+            tc = jnp.minimum(t, jnp.int32(descs.shape[0] - 1))
+            status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = (
+                sweeps._pivot_tile_step(
+                    tables, lc1, lc0, hc, lowvalid, highvalid, descs[tc],
+                    w_tab, m_tab, seed ^ t, active, tl, th, solve_rows,
+                )
+            )
+            found = (
+                jax.lax.psum((status != 0).astype(jnp.int32), CANDIDATES_AXIS)
+                > 0
+            )
+            return (
+                found, base + n, status, t, mm, lo_abs, hi_abs, sigma, fo,
+                r1b, r0b,
+            )
+
+        (_, base, status, t, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b) = (
+            jax.lax.while_loop(cond, body, init)
+        )
+        # Per-device verdict row; host concatenation yields [n_devices, 10].
+        return jnp.stack(
+            [status, t, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b, base]
+        )[None, :]
+
+    return _jit_shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(),) * 12,
+        out_specs=P(CANDIDATES_AXIS),
+    )
+
+
+def sharded_pivot_stream(
+    plan: "MeshPlan", tables, lc1, lc0, hc, lowvalid, highvalid, descs,
+    start_t, t_end, w_tab, m_tab, seed, *, tl: int, th: int,
+    solve_rows: int = 64,
+):
+    """Mesh-sharded counterpart of sweeps.lut5_pivot_stream.  Returns
+    verdict rows [n_devices, 10]: (status, tile, m, lo_abs, hi_abs, sigma,
+    func_outer, req1, req0, next_base)."""
+    fn = _sharded_pivot_fn(plan.mesh, tl, th, solve_rows)
+    return fn(
+        tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
+        w_tab, m_tab, seed,
+    )
 
 
 def restart_batched_filter():

@@ -42,7 +42,6 @@ class Options:
     sboxgates.c:1060-1078)."""
 
     iterations: int = 1
-    oneoutput: int = -1
     permute: int = 0
     metric: int = GATES
     lut_graph: bool = False
@@ -181,9 +180,12 @@ class SearchContext:
     # -- helpers ----------------------------------------------------------
 
     def next_seed(self) -> int:
+        """Per-dispatch kernel seed.  Negative when not randomizing: the
+        kernels then select deterministically in scan order instead of by
+        hashed priority (the reference's unshuffled scan)."""
         if self.opt.randomize:
             return int(self.rng.integers(0, 2**31))
-        return 12345
+        return -1
 
     def device_tables(self, st: State):
         """Zero-padded [bucket, 8] live tables (replicated across the mesh)."""

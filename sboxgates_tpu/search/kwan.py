@@ -175,7 +175,7 @@ def create_circuit(
                     next_inbits,
                 )
                 nst_or.max_gates += 2
-                nst_or.max_sat_metric += get_sat_metric(bf.AND) + get_sat_metric(
+                nst_or.max_sat_metric += get_sat_metric(bf.OR) + get_sat_metric(
                     bf.XOR
                 )
                 org = nst_or.add_or_gate(fe, bit, metric)

@@ -262,8 +262,81 @@ def _lut5_search_pivot(
             dtype=np.int32,
         )
 
+    def redrive_tile(t_over: int) -> Optional[dict]:
+        """Overflow fallback: fetch one tile's full feasibility data and
+        solve every feasible tuple (no in-kernel row cap)."""
+        feas, r1, r0 = sweeps.lut5_pivot_tile(
+            tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over,
+            tl=PIVOT_TL, th=PIVOT_TH,
+        )
+        rows = np.nonzero(np.asarray(feas))[0]
+        if not rows.size:
+            return None
+        if ctx.opt.randomize:
+            rows = rows[ctx.rng.permutation(len(rows))]
+        d = descs[t_over]
+        combos = np.stack(
+            [
+                combo_at(
+                    int(d[0]),
+                    int(d[1]) + int(r) // PIVOT_TH,
+                    int(d[3]) + int(r) % PIVOT_TH,
+                )
+                for r in rows
+            ]
+        )
+        return _solve_lut5_rows(
+            ctx, st, target, mask, combos,
+            np.asarray(r1)[rows], np.asarray(r0)[rows],
+            jw, jm, splits, w_tab, m_tab,
+        )
+
+    def decode_hit(m, lo_abs, hi_abs, sigma, fo, r1, r0) -> dict:
+        return _decode_lut5(
+            ctx,
+            combo_at(m, lo_abs, hi_abs),
+            sigma,
+            fo,
+            _unpack32(r1 & 0xFFFFFFFF),
+            _unpack32(r0 & 0xFFFFFFFF),
+            splits,
+            w_tab,
+            m_tab,
+        )
+
+    if ctx.mesh_plan is not None:
+        from ..parallel.mesh import sharded_pivot_stream
+
     start_t = 0
     while start_t < t_real:
+        if ctx.mesh_plan is not None:
+            # SPMD lockstep rounds of one tile per device; per-device
+            # verdicts resolved in tile order, so the chosen circuit matches
+            # the single-device stream's when not randomizing.
+            verdicts = np.asarray(
+                sharded_pivot_stream(
+                    ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
+                    start_t, t_real, jw, jm, ctx.next_seed(),
+                    tl=PIVOT_TL, th=PIVOT_TH,
+                )
+            )
+            next_t = int(verdicts[0, 9])
+            ctx.stats["lut5_candidates"] += int(
+                size_cum[min(next_t, t_real)] - size_cum[start_t]
+            )
+            hits = verdicts[verdicts[:, 0] != 0]
+            for hv in hits[np.argsort(hits[:, 1])]:
+                if int(hv[0]) == 1:
+                    return decode_hit(
+                        int(hv[2]), int(hv[3]), int(hv[4]),
+                        int(hv[5]), int(hv[6]), int(hv[7]), int(hv[8]),
+                    )
+                res = redrive_tile(int(hv[1]))
+                if res is not None:
+                    return res
+            start_t = next_t
+            continue
+
         v = np.asarray(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
@@ -277,48 +350,16 @@ def _lut5_search_pivot(
         if status == 0:
             return None
         if status == 1:
-            combo = combo_at(int(v[1]), int(v[2]), int(v[3]))
-            return _decode_lut5(
-                ctx,
-                combo,
-                int(v[4]),
-                int(v[5]),
-                _unpack32(int(v[6]) & 0xFFFFFFFF),
-                _unpack32(int(v[7]) & 0xFFFFFFFF),
-                splits,
-                w_tab,
-                m_tab,
+            return decode_hit(
+                int(v[1]), int(v[2]), int(v[3]), int(v[4]), int(v[5]),
+                int(v[6]), int(v[7]),
             )
         # status 2: more feasible tuples in tile next_t-1 than the in-kernel
         # solver rows — fetch that tile's full constraints and solve them all.
-        t_over = next_t - 1
-        feas, r1, r0 = sweeps.lut5_pivot_tile(
-            tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over,
-            tl=PIVOT_TL, th=PIVOT_TH,
-        )
-        rows = np.nonzero(np.asarray(feas))[0]
-        if rows.size:
-            if ctx.opt.randomize:
-                rows = rows[ctx.rng.permutation(len(rows))]
-            d = descs[t_over]
-            combos = np.stack(
-                [
-                    combo_at(
-                        int(d[0]),
-                        int(d[1]) + int(r) // PIVOT_TH,
-                        int(d[3]) + int(r) % PIVOT_TH,
-                    )
-                    for r in rows
-                ]
-            )
-            res = _solve_lut5_rows(
-                ctx, st, target, mask, combos,
-                np.asarray(r1)[rows], np.asarray(r0)[rows],
-                jw, jm, splits, w_tab, m_tab,
-            )
-            if res is not None:
-                return res
-        start_t = t_over + 1
+        res = redrive_tile(next_t - 1)
+        if res is not None:
+            return res
+        start_t = next_t
     return None
 
 
@@ -335,7 +376,7 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     g = st.num_gates
     if g < 5:
         return None
-    if ctx.mesh_plan is None and comb.n_choose_k(g, 5) >= PIVOT_MIN_TOTAL:
+    if comb.n_choose_k(g, 5) >= PIVOT_MIN_TOTAL:
         return _lut5_search_pivot(ctx, st, target, mask, inbits)
     if not sweeps.device_rank_limit(g, 5):
         return _lut5_search_host(ctx, st, target, mask, inbits)

@@ -110,6 +110,57 @@ def test_budget_ratchet():
         assert b <= a
 
 
+def test_budget_rejects_worse_circuit():
+    """The gate budget must actually *reject*: a tighter max_gates either
+    fails or yields a circuit within the budget, and an impossible budget
+    always returns NO_GATE (reference: add_gate / check_num_gates_possible,
+    sboxgates.c:97-128, 270-278)."""
+    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
+    targets = make_targets(sbox)
+    mask = tt.mask_table(n)
+    ctx = SearchContext(Options(seed=7))
+    st = State.init_inputs(n)
+    results = generate_graph_one_output(
+        ctx, st, targets, 0, save_dir=None, log=lambda s: None
+    )
+    assert results
+    best = results[-1].num_gates
+
+    # Budget one below the found size: any success must fit the budget.
+    st2 = State.init_inputs(n)
+    st2.max_gates = best - 1
+    out = create_circuit(SearchContext(Options(seed=7)), st2, targets[0], mask, [])
+    assert out == NO_GATE or st2.num_gates <= best - 1
+
+    # Budget that admits no new gates at all: must be rejected outright.
+    st3 = State.init_inputs(n)
+    st3.max_gates = st3.num_gates
+    assert (
+        create_circuit(SearchContext(Options(seed=7)), st3, targets[0], mask, [])
+        == NO_GATE
+    )
+    assert st3.num_gates == n  # nothing was appended
+
+
+def test_non_randomized_runs_are_identical():
+    """randomize=False must be deterministic end to end: two runs produce
+    byte-identical circuits (the reference's unshuffled scan order; kernels
+    select first-in-order via the negative-seed deterministic priority)."""
+    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
+    targets = make_targets(sbox)
+
+    def run():
+        ctx = SearchContext(Options(randomize=False))
+        st = State.init_inputs(n)
+        res = generate_graph_one_output(
+            ctx, st, targets, 0, save_dir=None, log=lambda s: None
+        )
+        assert res
+        return [(g.type, g.in1, g.in2, g.in3, g.function) for g in res[-1].gates]
+
+    assert run() == run()
+
+
 @pytest.mark.slow
 def test_full_graph_linear_sbox():
     """Full multi-output beam search on the 8x8 linear sanity box."""
