@@ -15,6 +15,7 @@ over all visible devices instead of running single-chip.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -61,6 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard candidate sweeps over all visible devices")
     p.add_argument("--output-dir", default=".", metavar="DIR",
                    help="directory for saved XML states (default: cwd)")
+    p.add_argument("--coordinator", metavar="HOST:PORT", default=None,
+                   help="multi-host: coordinator address for "
+                        "jax.distributed.initialize (or set "
+                        "JAX_COORDINATOR_ADDRESS); implies --mesh")
+    p.add_argument("--num-processes", type=int, default=None, metavar="N",
+                   help="multi-host: total number of processes")
+    p.add_argument("--process-id", type=int, default=None, metavar="I",
+                   help="multi-host: this process's id (0-based)")
     return p
 
 
@@ -117,6 +126,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         make_targets,
     )
 
+    # Multi-host: connect processes into one global runtime BEFORE any
+    # backend use; the mesh then spans every process's devices (the analog
+    # of the reference's MPI_Init + worker topology, sboxgates.c:1045-1057).
+    multiprocess = (
+        args.coordinator is not None
+        or args.num_processes is not None
+        or "JAX_COORDINATOR_ADDRESS" in os.environ
+    )
+    log = print
+    if multiprocess:
+        from .parallel import distributed as dist
+
+        dist.initialize(args.coordinator, args.num_processes, args.process_id)
+        args.mesh = True
+        args.seed = dist.shared_seed(args.seed)
+        if not dist.is_primary():
+            # Side effects belong to process 0 (reference: rank-0-gated
+            # printing and save_state).
+            args.output_dir = None
+            log = lambda s: None  # noqa: E731
+
     try:
         sbox, num_inputs = load_sbox(args.input, args.permute)
     except OSError:
@@ -154,11 +184,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ctx = SearchContext(opt, mesh_plan=mesh_plan)
 
     if args.verbose >= 1:
-        print("Available gates: NOT " + " ".join(
+        log("Available gates: NOT " + " ".join(
             bf.GATE_NAMES[f.fun] for f in ctx.avail_gates))
-        print("Generated gates: " + " ".join(
+        log("Generated gates: " + " ".join(
             bf.GATE_NAMES[f.fun] for f in ctx.avail_not))
-        print("Generated 3-input gates: " + " ".join(
+        log("Generated 3-input gates: " + " ".join(
             "%02x" % f.fun for f in ctx.avail_3))
 
     if args.graph is None:
@@ -168,14 +198,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             st = load_state(args.graph)
         except (OSError, StateLoadError) as e:
             return _err(f"Error when reading state file. ({e})")
-        print(f"Loaded {args.graph}.")
+        log(f"Loaded {args.graph}.")
 
     if args.single_output != -1:
         generate_graph_one_output(
-            ctx, st, targets, args.single_output, save_dir=args.output_dir
+            ctx, st, targets, args.single_output, save_dir=args.output_dir,
+            log=log,
         )
     else:
-        generate_graph(ctx, st, targets, save_dir=args.output_dir)
+        generate_graph(ctx, st, targets, save_dir=args.output_dir, log=log)
     return 0
 
 

@@ -2,4 +2,7 @@ from .mesh import (  # noqa: F401
     MeshPlan,
     lut5_fused_step,
     make_mesh,
+    sharded_feasible_stream,
+    sharded_pivot_stream,
 )
+from . import distributed  # noqa: F401

@@ -1,0 +1,85 @@
+"""Multi-host (multi-process) distributed backend.
+
+The reference scales across nodes with MPI: rank 0 drives, ranks >= 1 loop
+in ``mpi_worker`` (sboxgates.c:619-642), and every rank sweeps a static
+slice of the combination space in a lockstep-collective protocol
+(lut.c:138-149).  The TPU-native equivalent is JAX's multi-controller SPMD
+model:
+
+- ``jax.distributed.initialize`` connects N processes (each owning its
+  local chips) into one global runtime; the search mesh is then built over
+  ``jax.devices()`` (all processes' devices), so candidate sharding spans
+  hosts with collectives riding ICI within a host and DCN across hosts.
+- Every process runs the *same* host driver (there is no worker loop to
+  write): the sharded sweep kernels all-gather their verdicts, so each
+  process fetches identical, fully-replicated results — the analog of the
+  reference's result broadcast (lut.c:731-739).
+- Host-side control decisions stay in lockstep because (a) every fetched
+  array is replicated and (b) the PRNG is identically seeded everywhere:
+  :func:`shared_seed` broadcasts process 0's seed when the user gave none
+  (the analog of the reference's rank-0-owned work description,
+  ``MPI_Bcast(mpi_work)``, lut.c:532-540).
+- Only process 0 performs side effects (checkpoint writes, logging) — see
+  :func:`is_primary`; the reference identically keys printing and
+  ``save_state`` off rank 0.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Connects this process into the global JAX runtime.
+
+    Arguments default to the standard cluster-environment autodetection
+    (``jax.distributed.initialize`` reads SLURM/GKE/etc. or the
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``
+    environment variables).  Must be called before any backend use.
+    """
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_primary() -> bool:
+    """True on the process that owns side effects (checkpoints, logs) —
+    the analog of the reference's rank 0."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def shared_seed(seed: Optional[int]) -> Optional[int]:
+    """A seed every process agrees on.
+
+    With one process (or an explicit seed, which is identical everywhere by
+    construction) this is a no-op.  Otherwise process 0 draws a fresh seed
+    and broadcasts it — without this, differently-seeded host PRNGs would
+    make divergent control decisions and deadlock the collective sweeps.
+    """
+    import jax
+
+    if seed is not None or jax.process_count() == 1:
+        return seed
+    from jax.experimental import multihost_utils
+
+    local = np.uint32(np.random.SeedSequence().generate_state(1)[0])
+    return int(multihost_utils.broadcast_one_to_all(local))

@@ -166,18 +166,23 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
         )
         examined = jnp.minimum(nxt, total) - start
         verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
+        if multihost:
+            # Gather the per-device blocks so every output is fully
+            # replicated: ranks concatenate to cstart + arange(chunk) in
+            # device order, and every process can fetch the whole array
+            # (sharded outputs are not fully addressable across hosts).
+            feasible = jax.lax.all_gather(feasible, CANDIDATES_AXIS, tiled=True)
+            r1 = jax.lax.all_gather(r1, CANDIDATES_AXIS, tiled=True)
+            r0 = jax.lax.all_gather(r0, CANDIDATES_AXIS, tiled=True)
         return verdict, feasible, r1, r0
 
+    multihost = jax.process_count() > 1
+    big = P() if multihost else P(CANDIDATES_AXIS)
     return _jit_shard_map(
         local,
         mesh=mesh,
         in_specs=(P(),) * 8,
-        out_specs=(
-            P(),
-            P(CANDIDATES_AXIS),
-            P(CANDIDATES_AXIS),
-            P(CANDIDATES_AXIS),
-        ),
+        out_specs=(P(), big, big, big),
     )
 
 
@@ -240,16 +245,20 @@ def _sharded_pivot_fn(mesh: Mesh, tl: int, th: int, solve_rows: int):
         (_, base, status, t, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b) = (
             jax.lax.while_loop(cond, body, init)
         )
-        # Per-device verdict row; host concatenation yields [n_devices, 10].
-        return jnp.stack(
+        # All-gather the per-device verdict rows so the [n_devices, 10]
+        # result is fully replicated (multi-host processes each fetch it
+        # whole — the analog of the reference's result broadcast,
+        # lut.c:731-739).
+        vec = jnp.stack(
             [status, t, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b, base]
-        )[None, :]
+        )
+        return jax.lax.all_gather(vec, CANDIDATES_AXIS)
 
     return _jit_shard_map(
         local,
         mesh=mesh,
         in_specs=(P(),) * 12,
-        out_specs=P(CANDIDATES_AXIS),
+        out_specs=P(),
     )
 
 

@@ -1,0 +1,58 @@
+"""One process of the 2-process distributed smoke test.
+
+Spawned by test_distributed.py: connects into a 2-process CPU runtime (4
+virtual devices per process -> 8 global), builds the global search mesh,
+and runs the sharded pivot 5-LUT search on a planted decomposition.  Both
+processes must print the identical RESULT line.
+
+Usage: distributed_worker.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from sboxgates_tpu.parallel import distributed as dist  # noqa: E402
+
+dist.initialize(f"127.0.0.1:{port}", 2, pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+from planted import build_planted_lut5  # noqa: E402
+
+from sboxgates_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+from sboxgates_tpu.search import Options, SearchContext  # noqa: E402
+from sboxgates_tpu.search.lut import lut5_search  # noqa: E402
+
+# Same planted state as test_lut5_pivot_sharded_equals_single.
+st, target, mask = build_planted_lut5()
+
+plan = MeshPlan(make_mesh())  # global mesh spanning both processes
+ctx = SearchContext(Options(lut_graph=True, randomize=False), mesh_plan=plan)
+res = lut5_search(ctx, st, target, mask, [])
+assert res is not None, "distributed pivot search found nothing"
+print(
+    "RESULT %d %d %d %s"
+    % (
+        pid,
+        res["func_outer"],
+        res["func_inner"],
+        " ".join(str(g) for g in res["gates"]),
+    ),
+    flush=True,
+)

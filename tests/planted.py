@@ -1,0 +1,45 @@
+"""Shared fixture: a 50-gate state with a planted 5-LUT decomposition.
+
+Used by the sharded-pivot equivalence test, the 2-process distributed test,
+and its worker subprocess — one construction so the cross-process
+verification can never drift out of sync with what the worker searched.
+"""
+
+import numpy as np
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import GATES, State
+
+PLANT_OUTER = 0x2D
+PLANT_INNER = 0xB4
+PLANT_OUTER_GATES = (12, 26, 41)
+PLANT_INNER_GATES = (19, 33)
+
+
+def build_planted_lut5():
+    """(state, target, mask): 8 inputs + XOR gates up to 50 total, with a
+    target realizable as LUT(LUT(g12,g26,g41), g19, g33) — large enough that
+    C(50,5) crosses the pivot-path threshold."""
+    rng = np.random.default_rng(5)
+    st = State.init_inputs(8)
+    while st.num_gates < 50:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    a, b, c = PLANT_OUTER_GATES
+    d, e = PLANT_INNER_GATES
+    outer = tt.eval_lut(PLANT_OUTER, st.table(a), st.table(b), st.table(c))
+    target = tt.eval_lut(PLANT_INNER, outer, st.table(d), st.table(e))
+    return st, target, tt.mask_table(8)
+
+
+def verify_lut5_result(st, target, mask, res) -> bool:
+    """True iff res = {func_outer, func_inner, gates} realizes the target."""
+    a, b, c, d, e = (int(g) for g in res["gates"])
+    got = tt.eval_lut(
+        int(res["func_inner"]),
+        tt.eval_lut(int(res["func_outer"]), st.table(a), st.table(b), st.table(c)),
+        st.table(d),
+        st.table(e),
+    )
+    return bool(tt.eq_mask(got, target, mask))
