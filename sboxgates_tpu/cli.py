@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PRNG seed for reproducible randomized search")
     p.add_argument("--mesh", action="store_true",
                    help="shard candidate sweeps over all visible devices")
+    p.add_argument("--batch-iterations", action="store_true",
+                   help="run the -i restarts as one device batch "
+                        "(independent restarts, vmapped sweeps) instead of "
+                        "a serial loop")
     p.add_argument("--output-dir", default=".", metavar="DIR",
                    help="directory for saved XML states (default: cwd)")
     p.add_argument("--coordinator", metavar="HOST:PORT", default=None,
@@ -175,6 +179,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         ),
         verbosity=args.verbose,
         seed=args.seed,
+        batch_restarts=args.batch_iterations,
     )
     mesh_plan = None
     if args.mesh:

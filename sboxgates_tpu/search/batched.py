@@ -1,0 +1,226 @@
+"""Batched independent restarts: ``--iterations`` as a device batch axis.
+
+The reference runs its iterations serially (sboxgates.c:661-688) and gets
+parallel restarts only by launching more MPI processes.  Here R randomized
+restarts of the same search run concurrently as host threads, and their
+device sweeps *rendezvous*: when every live restart is blocked on a sweep,
+all same-kind requests are stacked on a leading axis and executed as ONE
+vmapped dispatch (SURVEY.md §2.10's missing batch-parallelism axis;
+BASELINE configs 4-5).  With R restarts in a batch, a search round costs
+one device round trip instead of R — on hardware behind a network tunnel
+the dispatch latency dominates small sweeps, so this is nearly an R-fold
+speedup for the gate-mode search.
+
+Semantics: restarts are *independent* (each has its own PRNG stream and the
+full initial budget); unlike the serial loop, a restart's budget is not
+ratcheted by another's success — the same semantics as the reference run
+R times in parallel processes.  Kinds that rendezvous are the fixed-shape
+gate-mode kernels (existing-gate scan, pair sweep, triple stream); LUT
+sweeps execute per-thread without waiting (their shapes vary per state).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import ttable as tt
+from ..graph.state import NO_GATE, State
+from ..graph.xmlio import save_state
+from .context import SearchContext
+from .kwan import create_circuit
+
+
+class Rendezvous:
+    """Collects sweep requests from R restart threads; when every live
+    thread is blocked on one, same-key requests execute as one vmapped
+    dispatch (the batch analog of the reference's per-rank lockstep
+    collectives)."""
+
+    def __init__(self, n_threads: int):
+        self.cv = threading.Condition()
+        self.live = n_threads
+        self.waiting: List[dict] = []
+        self._vmapped = {}
+        self.stats = {"submits": 0, "dispatches": 0, "batched_rows": 0}
+
+    def submit(self, key, kernel: Callable, args, shared=()) -> np.ndarray:
+        """``shared``: indices of args that are identical across restarts
+        for this key (match tables, combo grids, ...) — mapped with
+        in_axes=None instead of being stacked R-way."""
+        entry = {
+            "key": key, "kernel": kernel, "args": args,
+            "shared": tuple(shared), "done": False,
+        }
+        with self.cv:
+            self.stats["submits"] += 1
+            self.waiting.append(entry)
+            if len(self.waiting) == self.live:
+                self._flush()
+            else:
+                while not entry["done"]:
+                    self.cv.wait()
+        if "error" in entry:
+            raise entry["error"]
+        return entry["result"]
+
+    def finish(self) -> None:
+        """Marks the calling restart thread as done (it will submit no
+        further requests)."""
+        with self.cv:
+            self.live -= 1
+            if self.live > 0 and len(self.waiting) == self.live:
+                self._flush()
+            self.cv.notify_all()
+
+    def _flush(self) -> None:
+        """Dispatches every pending group (caller holds the lock; every
+        other live thread is blocked waiting).  A kernel failure is
+        recorded on every entry of its group — never left undelivered, or
+        the blocked threads would sleep forever."""
+        groups: dict = {}
+        for e in self.waiting:
+            groups.setdefault(e["key"], []).append(e)
+        self.waiting = []
+        for key, entries in groups.items():
+            try:
+                self._run_group(key, entries)
+            except BaseException as exc:
+                for e in entries:
+                    e["error"] = exc
+            self.stats["dispatches"] += 1
+            for e in entries:
+                e["done"] = True
+        self.cv.notify_all()
+
+    def _run_group(self, key, entries) -> None:
+        if len(entries) == 1:
+            e = entries[0]
+            e["result"] = np.asarray(e["kernel"](*e["args"]))
+            return
+        shared = entries[0]["shared"]
+        nargs = len(entries[0]["args"])
+        vkey = (key, len(entries), shared)
+        fn = self._vmapped.get(vkey)
+        if fn is None:
+            in_axes = [None if i in shared else 0 for i in range(nargs)]
+            fn = jax.jit(jax.vmap(entries[0]["kernel"], in_axes=in_axes))
+            self._vmapped[vkey] = fn
+        stacked = [
+            entries[0]["args"][i]
+            if i in shared
+            else jnp.stack([jnp.asarray(e["args"][i]) for e in entries])
+            for i in range(nargs)
+        ]
+        out = np.asarray(fn(*stacked))
+        for r, e in enumerate(entries):
+            e["result"] = out[r]
+        self.stats["batched_rows"] += len(entries)
+
+
+class RestartContext(SearchContext):
+    """Per-restart view of a shared SearchContext: same derived tables and
+    options, its own PRNG stream and stats, sweeps routed through the
+    rendezvous."""
+
+    def __init__(self, base: SearchContext, seed: int, rdv: Rendezvous):
+        # Share every derived structure (match tables, combo caches, binom);
+        # only the PRNG and counters are per-restart.
+        self.__dict__.update(base.__dict__)
+        self.rng = np.random.default_rng(seed)
+        self.stats = dict.fromkeys(base.stats, 0)
+        self._rdv = rdv
+
+    def _dispatch(self, key, kernel, args, shared=()) -> np.ndarray:
+        return self._rdv.submit(key, kernel, args, shared)
+
+
+def run_batched_circuits(
+    ctx: SearchContext, jobs: List[tuple]
+) -> List[tuple]:
+    """Runs independent ``create_circuit`` jobs concurrently with
+    rendezvous-batched sweeps.
+
+    jobs: list of (state, target, mask) — each state is owned by its job
+    (mutated in place).  Returns [(state, out_gid)] in job order.
+    """
+    n = len(jobs)
+    rdv = Rendezvous(n)
+    seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
+    results: List[Optional[tuple]] = [None] * n
+    errors: List[BaseException] = []
+
+    def worker(i: int) -> None:
+        try:
+            rctx = RestartContext(ctx, seeds[i], rdv)
+            nst, target, mask = jobs[i]
+            out = create_circuit(rctx, nst, target, mask, [])
+            results[i] = (nst, out)
+            with rdv.cv:
+                for k, v in rctx.stats.items():
+                    ctx.stats[k] += v
+        except BaseException as e:  # surfaced after join
+            errors.append(e)
+        finally:
+            rdv.finish()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"restart-{i}")
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    ctx.stats["restart_batch_dispatches"] = (
+        ctx.stats.get("restart_batch_dispatches", 0) + rdv.stats["dispatches"]
+    )
+    ctx.stats["restart_batch_submits"] = (
+        ctx.stats.get("restart_batch_submits", 0) + rdv.stats["submits"]
+    )
+    return results
+
+
+def generate_graph_one_output_batched(
+    ctx: SearchContext,
+    st: State,
+    targets,
+    output: int,
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+) -> List[State]:
+    """Batched counterpart of
+    :func:`sboxgates_tpu.search.orchestrator.generate_graph_one_output`:
+    all ``iterations`` restarts run concurrently with rendezvous-batched
+    sweeps.  Returns successful states, best (fewest gates / lowest SAT
+    metric) last."""
+    opt = ctx.opt
+    r = opt.iterations
+    mask = tt.mask_table(st.num_inputs)
+    jobs = [(st.copy(), targets[output], mask) for _ in range(r)]
+    raw = run_batched_circuits(ctx, jobs)
+
+    ok: List[State] = []
+    for i, (nst, out) in enumerate(raw):
+        if out == NO_GATE:
+            log(f"({i + 1}/{r}): Not found.")
+            continue
+        nst.outputs[output] = out
+        log(
+            f"({i + 1}/{r}): {nst.num_gates - nst.num_inputs} gates. "
+            f"SAT metric: {nst.sat_metric}"
+        )
+        if save_dir is not None:
+            save_state(nst, save_dir)
+        ok.append(nst)
+    if opt.metric == 0:  # GATES
+        ok.sort(key=lambda s: -s.num_gates)
+    else:
+        ok.sort(key=lambda s: -s.sat_metric)
+    return ok

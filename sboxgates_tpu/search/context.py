@@ -8,6 +8,7 @@ candidate spaces through the jitted kernels in :mod:`sboxgates_tpu.ops.sweeps`.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
@@ -30,10 +31,17 @@ LUT5_CHUNK = 1 << 17
 LUT5_SOLVE_CHUNK = 4096
 LUT7_CHUNK = 1 << 17
 LUT7_CAP = 100_000       # reference: 100k-hit buffer, lut.c:291,316
-LUT7_SOLVE_CHUNK = 16
+# Stage-B decomposition solve rows per dispatch: measured on a v5 chip,
+# T=256 triples per lut7_solve call is ~3x the tuples/s of T=16 and within
+# 2% of T=1024 (the 70-ordering scan amortizes); under a mesh the rows are
+# sharded (place_chunk), the analog of the reference's stage-B rebalance
+# (lut.c:351-360).
+LUT7_SOLVE_CHUNK = 256
 
-# Per-arity chunk sizes for the device-resident streaming sweeps.
-STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 17}
+# Per-arity chunk sizes for the device-resident streaming sweeps.  k=7
+# uses a smaller chunk: its [128-cell, W, N] constraint intermediates are
+# HBM-bound and measure fastest at 2^15 rows.
+STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 15}
 
 
 @dataclass
@@ -50,6 +58,9 @@ class Options:
     avail_gates_bitfield: int = bf.DEFAULT_AVAILABLE
     verbosity: int = 0
     seed: Optional[int] = None
+    # Run the --iterations restarts as a device batch axis (vmapped
+    # rendezvous dispatches) instead of the reference's serial loop.
+    batch_restarts: bool = False
 
 
 @dataclass(frozen=True)
@@ -292,21 +303,78 @@ class SearchContext:
 
     # -- sweep drivers ----------------------------------------------------
 
-    def scan_matches(self, st: State, target, mask):
-        """Steps 1-2: existing gate / complement match.  Returns
-        (found, gid, inverted)."""
+    def _dispatch(self, key, kernel, args, shared=()) -> np.ndarray:
+        """Executes one fixed-shape sweep kernel, returning its packed
+        verdict.  The batched-restart driver
+        (:mod:`sboxgates_tpu.search.batched`) overrides this to rendezvous
+        same-``key`` dispatches from concurrent restarts into one vmapped
+        call; ``shared`` marks arg indices identical across restarts
+        (mapped in_axes=None instead of stacked)."""
+        del key, shared
+        return np.asarray(kernel(*args))
+
+    def gate_step(self, st: State, target, mask):
+        """Steps 1-4 of one gate-mode search node as ONE fused dispatch
+        (sweeps.gate_step_stream).  Returns (step, x0, x1) — see the kernel
+        docstring for the step encoding; use :meth:`decode_pair_hit` /
+        :meth:`decode_triple_hit` on the payload."""
         tables, g = self.device_tables(st)
-        valid = jnp.arange(tables.shape[0]) < g
-        v = np.asarray(
-            sweeps.match_scan(
+        b = tables.shape[0]
+        valid_g = jnp.arange(b) < g
+        combos = self._pair_combos(b)
+        pair_valid = (combos < g).all(axis=1)
+        lut_mode = self.opt.lut_graph
+        has_not = bool(self.not_entries) and not lut_mode
+        has_triple = not lut_mode and g >= 3
+        total3 = comb.n_choose_k(g, 3) if has_triple else 0
+        chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
+        v = self._dispatch(
+            ("gstep", b, chunk3, has_not, has_triple),
+            functools.partial(
+                sweeps.gate_step_stream,
+                chunk3=chunk3, has_not=has_not, has_triple=has_triple,
+            ),
+            (
                 tables,
-                valid,
-                self.place_replicated(target),
-                self.place_replicated(mask),
+                valid_g,
+                combos,
+                pair_valid,
+                self.binom,
+                g,
+                self.place_replicated(np.asarray(target)),
+                self.place_replicated(np.asarray(mask)),
+                self.place_replicated(self.excl_array([])),
+                total3,
+                self.pair_table,
+                self.not_table if has_not else self.pair_table,
+                self.triple_table,
                 self.next_seed(),
-            )
+            ),
+            # identical across restarts under one key: combo grid, binomial
+            # table, (empty) exclusion list, and the three match tables
+            shared=(2, 4, 8, 10, 11, 12),
         )
-        return bool(v[0]), int(v[1]), bool(v[2])
+        step = int(v[0])
+        if step == 0 or step >= 3:
+            self.stats["pair_candidates"] += g * (g - 1) // 2
+        if has_triple and step in (0, 5):
+            self.stats["triple_candidates"] += int(v[3])
+        return step, int(v[1]), int(v[2])
+
+    def decode_pair_hit(self, st: State, index: int, slot: int, use_not: bool):
+        """(gid1, gid2, entry) for a fused-kernel pair hit."""
+        entries = self.not_entries if use_not else self.pair_entries
+        combos = np.asarray(self._pair_combos(bucket_size(st.num_gates)))
+        pair = combos[index]
+        entry = entries[slot]
+        gids = [int(pair[p]) for p in entry.perm]
+        return gids[0], gids[1], entry
+
+    def decode_triple_hit(self, st: State, rank: int, slot: int):
+        """(gids, entry) for a fused-kernel triple hit."""
+        row = comb.unrank_combination(rank, st.num_gates, 3)
+        entry = self.triple_entries[slot]
+        return [int(row[p]) for p in entry.perm], entry
 
     def pair_search(self, st: State, target, mask, use_not_table: bool):
         """Step 3 / step 4a: one function over all gate pairs.  Returns
@@ -319,8 +387,10 @@ class SearchContext:
         combos = self._pair_combos(tables.shape[0])
         valid = (combos < g).all(axis=1)
         self.stats["pair_candidates"] += g * (g - 1) // 2
-        v = np.asarray(
-            sweeps.tuple_match_sweep(
+        v = self._dispatch(
+            ("pair", tables.shape[0], use_not_table),
+            functools.partial(sweeps.tuple_match_sweep, num_cells=4),
+            (
                 tables,
                 combos,
                 valid,
@@ -328,8 +398,7 @@ class SearchContext:
                 self.place_replicated(mask),
                 table,
                 self.next_seed(),
-                num_cells=4,
-            )
+            ),
         )
         if not bool(v[0]):
             return False, 0, 0, None
@@ -348,8 +417,12 @@ class SearchContext:
             return False, None, None
         tables, _ = self.device_tables(st)
         chunk = pick_chunk(total, STREAM_CHUNK[3])
-        v = np.asarray(
-            sweeps.match_stream(
+        v = self._dispatch(
+            ("triple", tables.shape[0], chunk),
+            functools.partial(
+                sweeps.match_stream, k=3, chunk=chunk, num_cells=8
+            ),
+            (
                 tables,
                 self.binom,
                 g,
@@ -360,10 +433,7 @@ class SearchContext:
                 total,
                 self.triple_table,
                 self.next_seed(),
-                k=3,
-                chunk=chunk,
-                num_cells=8,
-            )
+            ),
         )
         self.stats["triple_candidates"] += int(v[3])
         if not bool(v[0]):

@@ -33,27 +33,30 @@ def create_circuit(
     opt = ctx.opt
     metric = opt.metric
 
-    # Steps 1-2: an existing gate, or the complement of one (sboxgates.c:301-321).
-    found, gid, inverted = ctx.scan_matches(st, target, mask)
-    if found and not inverted:
-        st.verify_gate(gid, target, mask)
-        return gid
+    # Steps 1-4 in ONE fused device dispatch (sweeps.gate_step_stream);
+    # budget gates are applied host-side in the reference's order
+    # (sboxgates.c:301-435).
+    step, x0, x1 = ctx.gate_step(st, target, mask)
+
+    # Steps 1-2: an existing gate, or the complement of one.
+    if step == 1:
+        st.verify_gate(x0, target, mask)
+        return x0
     if not check_num_gates_possible(st, 1, get_sat_metric(bf.NOT), metric):
         return NO_GATE
-    if found and inverted:
-        ret = st.add_not_gate(gid, metric)
+    if step == 2:
+        ret = st.add_not_gate(x0, metric)
         st.verify_gate(ret, target, mask)
         return ret
 
-    # Step 3: one available gate over all pairs (sboxgates.c:323-350).
+    # Step 3: one available gate over all pairs.
     if not check_num_gates_possible(st, 1, get_sat_metric(bf.AND), metric):
         return NO_GATE
-    if st.num_gates >= 2:
-        found, g1, g2, entry = ctx.pair_search(st, target, mask, use_not_table=False)
-        if found:
-            ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
-            st.verify_gate(ret, target, mask)
-            return ret
+    if step == 3:
+        g1, g2, entry = ctx.decode_pair_hit(st, x0, x1, use_not=False)
+        ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
+        st.verify_gate(ret, target, mask)
+        return ret
 
     if opt.lut_graph:
         ret = lut_search(ctx, st, target, mask, inbits)
@@ -61,31 +64,27 @@ def create_circuit(
             st.verify_gate(ret, target, mask)
             return ret
     else:
-        # Step 4a: pairs with NOT-augmented functions (sboxgates.c:366-386).
+        # Step 4a: pairs with NOT-augmented functions.
         if not check_num_gates_possible(
             st, 2, get_sat_metric(bf.AND) + get_sat_metric(bf.NOT), metric
         ):
             return NO_GATE
-        if ctx.not_entries and st.num_gates >= 2:
-            found, g1, g2, entry = ctx.pair_search(
-                st, target, mask, use_not_table=True
-            )
-            if found:
-                ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
-                st.verify_gate(ret, target, mask)
-                return ret
+        if step == 4:
+            g1, g2, entry = ctx.decode_pair_hit(st, x0, x1, use_not=True)
+            ret = st.add_boolfunc_2(entry.fun, g1, g2, metric)
+            st.verify_gate(ret, target, mask)
+            return ret
 
-        # Step 4b: gate triples x 3-input functions (sboxgates.c:392-435).
+        # Step 4b: gate triples x 3-input functions.
         if not check_num_gates_possible(
             st, 3, 2 * get_sat_metric(bf.AND) + get_sat_metric(bf.NOT), metric
         ):
             return NO_GATE
-        if st.num_gates >= 3:
-            found, gids, entry = ctx.triple_search(st, target, mask)
-            if found:
-                ret = st.add_boolfunc_3(entry.fun, gids[0], gids[1], gids[2], metric)
-                st.verify_gate(ret, target, mask)
-                return ret
+        if step == 5:
+            gids, entry = ctx.decode_triple_hit(st, x0, x1)
+            ret = st.add_boolfunc_3(entry.fun, gids[0], gids[1], gids[2], metric)
+            st.verify_gate(ret, target, mask)
+            return ret
 
     # Step 5: multiplex over an unused input bit and recurse on the two
     # Karnaugh-map halves (sboxgates.c:438-607).  Only the first six used

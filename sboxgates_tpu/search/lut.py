@@ -191,8 +191,17 @@ def _solve_lut5_rows(
     return None
 
 
-# Pivot sweep tile shape: the high axis rides the VPU lanes.
-PIVOT_TL, PIVOT_TH = 256, 512
+# Pivot sweep tile shapes (low x high pair block).  Bigger tiles feed the
+# MXU better (larger matmuls, fewer dispatch rounds) but waste more padding
+# on boundary tiles; padding waste shrinks as G grows, so the shape steps up
+# with the state size (measured on a v5 chip: (512, 1024) sweeps C(500,5)
+# at ~93% tile occupancy, while at G<~130 it would be mostly padding).
+def pivot_tile_shape(g: int) -> Tuple[int, int]:
+    if g <= 128:
+        return 256, 512
+    return 512, 1024
+
+
 # Below this space size the rank-chunk stream's per-candidate overhead is
 # irrelevant and its single compiled shape is cheaper than tiling.
 PIVOT_MIN_TOTAL = 1 << 21
@@ -202,53 +211,84 @@ def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
 
+class PivotOperands:
+    """Host + device operands for the pivot 5-LUT sweep: padded pair
+    grids, tile descriptors, validity masks, and per-pair cell masks.
+
+    Shared by the search driver (:func:`_lut5_search_pivot`) and bench.py
+    so both always exercise the identical kernel configuration.  ``put``
+    places numpy arrays on device (``jnp.asarray`` or a mesh-replicating
+    placement).
+    """
+
+    def __init__(self, g, tl, th, excl, tables, target, mask, put):
+        self.g, self.tl, self.th = g, tl, th
+        lows, highs, _ = sweeps.pivot_pair_grids(g)
+        self.lows, self.highs = lows, highs
+        descs = sweeps.pivot_tile_descs(g, tl, th, excl)
+        self.descs = descs
+        self.t_real = descs.shape[0]
+        if self.t_real == 0:
+            return
+        tile_sizes = (
+            (descs[:, 2] - descs[:, 1]).astype(np.int64)
+            * (descs[:, 4] - descs[:, 3]).astype(np.int64)
+        )
+        self.size_cum = np.concatenate([[0], np.cumsum(tile_sizes)])
+
+        p2 = lows.shape[0]
+        p2pad = _next_pow2(p2 + max(tl, th))
+        tpad = _next_pow2(self.t_real)
+        descs_p = np.zeros((tpad, 5), np.int32)
+        descs_p[: self.t_real] = descs
+        lowvalid = np.zeros(p2pad, bool)
+        highvalid = np.zeros(p2pad, bool)
+        lowvalid[:p2] = ~np.isin(lows, excl).any(1) if excl else True
+        highvalid[:p2] = ~np.isin(highs, excl).any(1) if excl else True
+        lows_p = np.zeros((p2pad, 2), np.int32)
+        lows_p[:p2] = lows
+        highs_p = np.zeros((p2pad, 2), np.int32)
+        highs_p[:p2] = highs
+
+        self.tables = tables
+        jt = put(np.asarray(target))
+        jmk = put(np.asarray(mask))
+        self.lc1, self.lc0, self.hc = sweeps.pivot_pair_cells(
+            tables, put(lows_p), put(highs_p), jt, jmk
+        )
+        self.jdescs = put(descs_p)
+        self.jlv = put(lowvalid)
+        self.jhv = put(highvalid)
+
+    def stream_args(self):
+        """Positional device operands shared by lut5_pivot_stream /
+        lut5_pivot_tile / sharded_pivot_stream."""
+        return (
+            self.tables, self.lc1, self.lc0, self.hc, self.jlv, self.jhv,
+            self.jdescs,
+        )
+
+
 def _lut5_search_pivot(
     ctx: SearchContext, st: State, target, mask, inbits
 ) -> Optional[dict]:
     """Pivot-structured whole-space sweep (sweeps.lut5_pivot_stream): no
     per-candidate gathers, no rank arithmetic, no int32 space limit."""
     g = st.num_gates
-    lows, highs, _ = sweeps.pivot_pair_grids(g)
+    tl, th = pivot_tile_shape(g)
     excl = [b for b in inbits if b >= 0]
-    descs = sweeps.pivot_tile_descs(g, PIVOT_TL, PIVOT_TH, excl)
-    t_real = descs.shape[0]
+    dev_tables, _ = ctx.device_tables(st)
+    ops = PivotOperands(
+        g, tl, th, excl, dev_tables, target, mask, ctx.place_replicated
+    )
+    t_real = ops.t_real
     if t_real == 0:
         return None
-    tile_sizes = (
-        (descs[:, 2] - descs[:, 1]).astype(np.int64)
-        * (descs[:, 4] - descs[:, 3]).astype(np.int64)
-    )
-    size_cum = np.concatenate([[0], np.cumsum(tile_sizes)])
-
-    p2 = lows.shape[0]
-    p2pad = _next_pow2(p2 + max(PIVOT_TL, PIVOT_TH))
-    tpad = _next_pow2(t_real)
-    descs_p = np.zeros((tpad, 5), np.int32)
-    descs_p[:t_real] = descs
-    lowvalid = np.zeros(p2pad, bool)
-    highvalid = np.zeros(p2pad, bool)
-    lowvalid[:p2] = ~np.isin(lows, excl).any(1) if excl else True
-    highvalid[:p2] = ~np.isin(highs, excl).any(1) if excl else True
-    lows_p = np.zeros((p2pad, 2), np.int32)
-    lows_p[:p2] = lows
-    highs_p = np.zeros((p2pad, 2), np.int32)
-    highs_p[:p2] = highs
-
-    tables, _ = ctx.device_tables(st)
-    jt = ctx.place_replicated(np.asarray(target))
-    jmk = ctx.place_replicated(np.asarray(mask))
-    lc1, lc0, hc = sweeps.pivot_pair_cells(
-        tables,
-        ctx.place_replicated(lows_p),
-        ctx.place_replicated(highs_p),
-        jt,
-        jmk,
-    )
+    lows, highs = ops.lows, ops.highs
+    descs, size_cum = ops.descs, ops.size_cum
+    tables, lc1, lc0, hc, jlv, jhv, jdescs = ops.stream_args()
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
-    jdescs = ctx.place_replicated(descs_p)
-    jlv = ctx.place_replicated(lowvalid)
-    jhv = ctx.place_replicated(highvalid)
 
     def combo_at(m: int, lo_abs: int, hi_abs: int) -> np.ndarray:
         return np.array(
@@ -267,7 +307,7 @@ def _lut5_search_pivot(
         solve every feasible tuple (no in-kernel row cap)."""
         feas, r1, r0 = sweeps.lut5_pivot_tile(
             tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over,
-            tl=PIVOT_TL, th=PIVOT_TH,
+            tl=tl, th=th,
         )
         rows = np.nonzero(np.asarray(feas))[0]
         if not rows.size:
@@ -279,8 +319,8 @@ def _lut5_search_pivot(
             [
                 combo_at(
                     int(d[0]),
-                    int(d[1]) + int(r) // PIVOT_TH,
-                    int(d[3]) + int(r) % PIVOT_TH,
+                    int(d[1]) + int(r) // th,
+                    int(d[3]) + int(r) % th,
                 )
                 for r in rows
             ]
@@ -317,7 +357,7 @@ def _lut5_search_pivot(
                 sharded_pivot_stream(
                     ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
                     start_t, t_real, jw, jm, ctx.next_seed(),
-                    tl=PIVOT_TL, th=PIVOT_TH,
+                    tl=tl, th=th,
                 )
             )
             next_t = int(verdicts[0, 9])
@@ -340,7 +380,7 @@ def _lut5_search_pivot(
         v = np.asarray(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
-                jw, jm, ctx.next_seed(), tl=PIVOT_TL, th=PIVOT_TH,
+                jw, jm, ctx.next_seed(), tl=tl, th=th,
             )
         )
         status, next_t = int(v[0]), int(v[8])

@@ -38,8 +38,19 @@ def generate_graph_one_output(
 ) -> List[State]:
     """``iterations`` independent attempts at one output bit, ratcheting the
     budget down after each success (sboxgates.c:661-688).  Returns all
-    successful states, best last."""
+    successful states, best last.
+
+    With ``Options.batch_restarts`` the serial loop is replaced by the
+    rendezvous-batched concurrent driver (one vmapped device dispatch per
+    sweep round across all restarts; restarts are then independent — no
+    cross-iteration budget ratchet, as if run in parallel processes)."""
     opt = ctx.opt
+    if opt.batch_restarts and opt.iterations > 1:
+        from .batched import generate_graph_one_output_batched
+
+        return generate_graph_one_output_batched(
+            ctx, st, targets, output, save_dir=save_dir, log=log
+        )
     mask = tt.mask_table(st.num_inputs)
     results = []
     for it in range(opt.iterations):
@@ -84,46 +95,80 @@ def generate_graph(
         max_sat_metric = INT_MAX
         out_states: List[State] = []
 
-        for it in range(opt.iterations):
+        def consider(nst: State, output: int) -> None:
+            """Beam insertion with the metric ratchet (sboxgates.c:748-771)."""
+            nonlocal max_gates, max_sat_metric, out_states
+            if nst.outputs[output] == NO_GATE:
+                log(f"No solution for output {output}.")
+                return
+            if save_dir is not None:
+                save_state(nst, save_dir)
+            if opt.metric == GATES:
+                if max_gates > nst.num_gates:
+                    max_gates = nst.num_gates
+                    out_states = []
+                if nst.num_gates <= max_gates:
+                    if len(out_states) < BEAM_WIDTH:
+                        out_states.append(nst)
+                    else:
+                        log("Output state buffer full! Throwing away valid state.")
+            else:
+                if max_sat_metric > nst.sat_metric:
+                    max_sat_metric = nst.sat_metric
+                    out_states = []
+                if nst.sat_metric <= max_sat_metric:
+                    if len(out_states) < BEAM_WIDTH:
+                        out_states.append(nst)
+                    else:
+                        log("Output state buffer full! Throwing away valid state.")
+
+        if opt.batch_restarts:
+            # One rendezvous-batched round: every (iteration x start x
+            # missing output) job runs concurrently with round-start
+            # budgets (parallel-restart semantics — the mid-round budget
+            # tightening of the serial loop does not apply), then results
+            # fold through the identical beam logic in serial order.
+            from .batched import run_batched_circuits
+
+            jobs, meta = [], []
+            for it in range(opt.iterations):
+                for start in start_states:
+                    for output in range(num_outputs):
+                        if start.outputs[output] != NO_GATE:
+                            continue
+                        nst = start.copy()
+                        if opt.metric == GATES:
+                            nst.max_gates = max_gates
+                        else:
+                            nst.max_sat_metric = max_sat_metric
+                        jobs.append((nst, targets[output], mask))
+                        meta.append(output)
             log(
                 f"Generating circuits with {done + 1} output"
-                f"{'' if done == 0 else 's'}. ({it + 1}/{opt.iterations})"
+                f"{'' if done == 0 else 's'}. ({len(jobs)} batched jobs)"
             )
-            for start in start_states:
-                for output in range(num_outputs):
-                    if start.outputs[output] != NO_GATE:
-                        continue
-                    nst = start.copy()
-                    if opt.metric == GATES:
-                        nst.max_gates = max_gates
-                    else:
-                        nst.max_sat_metric = max_sat_metric
-                    nst.outputs[output] = create_circuit(
-                        ctx, nst, targets[output], mask, []
-                    )
-                    if nst.outputs[output] == NO_GATE:
-                        log(f"No solution for output {output}.")
-                        continue
-                    if save_dir is not None:
-                        save_state(nst, save_dir)
-                    if opt.metric == GATES:
-                        if max_gates > nst.num_gates:
-                            max_gates = nst.num_gates
-                            out_states = []
-                        if nst.num_gates <= max_gates:
-                            if len(out_states) < BEAM_WIDTH:
-                                out_states.append(nst)
-                            else:
-                                log("Output state buffer full! Throwing away valid state.")
-                    else:
-                        if max_sat_metric > nst.sat_metric:
-                            max_sat_metric = nst.sat_metric
-                            out_states = []
-                        if nst.sat_metric <= max_sat_metric:
-                            if len(out_states) < BEAM_WIDTH:
-                                out_states.append(nst)
-                            else:
-                                log("Output state buffer full! Throwing away valid state.")
+            for output, (nst, out) in zip(meta, run_batched_circuits(ctx, jobs)):
+                nst.outputs[output] = out
+                consider(nst, output)
+        else:
+            for it in range(opt.iterations):
+                log(
+                    f"Generating circuits with {done + 1} output"
+                    f"{'' if done == 0 else 's'}. ({it + 1}/{opt.iterations})"
+                )
+                for start in start_states:
+                    for output in range(num_outputs):
+                        if start.outputs[output] != NO_GATE:
+                            continue
+                        nst = start.copy()
+                        if opt.metric == GATES:
+                            nst.max_gates = max_gates
+                        else:
+                            nst.max_sat_metric = max_sat_metric
+                        nst.outputs[output] = create_circuit(
+                            ctx, nst, targets[output], mask, []
+                        )
+                        consider(nst, output)
         if not out_states:
             return []
         if opt.metric == GATES:

@@ -1,0 +1,30 @@
+/* Test-only golden-interop shim.
+ *
+ * Compiles the REFERENCE implementation's state serialization (the
+ * fingerprint, save-file naming, and XML writer from
+ * /root/reference/state.c, truncated above its libxml-based loader by the
+ * test fixture — the truncated source is generated into the build temp
+ * dir at test time and never enters this repository) and exports plain-C
+ * wrappers so tests can assert byte-exact fingerprint/filename/XML parity
+ * against sboxgates_tpu.graph.xmlio.  See tests/test_golden_interop.py.
+ */
+
+#define NO_MPI_HEADER 1
+
+#include <stdint.h>
+
+/* Referenced by the truncated TU's generate_target (unused by the
+ * functions under test). */
+uint8_t g_sbox_enc[256];
+
+#include "state_trunc.c"
+
+uint32_t golden_fingerprint(const state *st) { return state_fingerprint(*st); }
+
+void golden_save(const state *st) { save_state(*st); }
+
+int golden_sat_metric(int gate_type) { return get_sat_metric(gate_type); }
+
+uint64_t golden_sizeof_state(void) { return sizeof(state); }
+
+uint64_t golden_sizeof_gate(void) { return sizeof(gate); }

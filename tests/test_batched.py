@@ -1,0 +1,106 @@
+"""Batched-restart driver tests: the --iterations axis as a device batch
+(SURVEY.md §2.10; BASELINE configs 4-5)."""
+
+import os
+
+import numpy as np
+
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import NO_GATE, SAT, State
+from sboxgates_tpu.search import (
+    Options,
+    SearchContext,
+    generate_graph_one_output,
+    make_targets,
+)
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _run(path, r, **kw):
+    sbox, n = load_sbox(path)
+    targets = make_targets(sbox)
+    ctx = SearchContext(Options(seed=9, iterations=r, batch_restarts=True, **kw))
+    st = State.init_inputs(n)
+    results = generate_graph_one_output(
+        ctx, st, targets, 0, save_dir=None, log=lambda s: None
+    )
+    return ctx, results, sbox, n, targets
+
+
+def test_batched_restarts_gate_mode():
+    """R=4 gate-mode restarts: every returned circuit is valid, the batch
+    actually batched (fewer dispatches than submits), and the best-last
+    ordering holds."""
+    ctx, results, sbox, n, targets = _run(
+        os.path.join(DATA, "crypto1_fa.txt"), 4
+    )
+    assert results, "no restart found a circuit"
+    mask = tt.mask_table(n)
+    for res in results:
+        gid = res.outputs[0]
+        assert gid != NO_GATE
+        assert bool(tt.eq_mask(res.table(gid), targets[0], mask))
+    sizes = [r.num_gates for r in results]
+    assert sizes == sorted(sizes, reverse=True), "best-last ordering"
+    # the rendezvous must have batched: one vmapped dispatch serves many
+    # same-kind submits
+    assert ctx.stats["restart_batch_submits"] > 0
+    assert (
+        ctx.stats["restart_batch_dispatches"]
+        < ctx.stats["restart_batch_submits"]
+    )
+
+
+def test_batched_restarts_diverse():
+    """Different restarts use different PRNG streams, so a batch usually
+    returns more than one distinct circuit size/shape; at minimum all are
+    valid and stats accumulate."""
+    ctx, results, sbox, n, targets = _run(
+        os.path.join(DATA, "des_s1.txt"), 3
+    )
+    assert results
+    assert ctx.stats["pair_candidates"] > 0
+
+
+def test_batched_restarts_sat_metric():
+    ctx, results, sbox, n, targets = _run(
+        os.path.join(DATA, "crypto1_fa.txt"), 3, metric=SAT, try_nots=True
+    )
+    assert results
+    sats = [r.sat_metric for r in results]
+    assert sats == sorted(sats, reverse=True)
+
+
+def test_batched_full_graph_beam():
+    """--batch-iterations applies to the multi-output beam search: each
+    round's (iteration x start x output) jobs run as one rendezvous batch."""
+    from sboxgates_tpu.search import generate_graph, sbox_num_outputs
+
+    sbox, n = load_sbox(os.path.join(DATA, "identity.txt"))
+    targets = make_targets(sbox)
+    ctx = SearchContext(Options(seed=4, iterations=2, batch_restarts=True))
+    st = State.init_inputs(n)
+    beam = generate_graph(ctx, st, targets, save_dir=None, log=lambda s: None)
+    assert beam
+    final = beam[0]
+    assert all(
+        o != NO_GATE for o in final.outputs[: sbox_num_outputs(targets)]
+    )
+    assert ctx.stats["restart_batch_submits"] > 0
+
+
+def test_batched_error_propagates(monkeypatch):
+    """A kernel failure inside a rendezvous flush must raise in the caller,
+    not deadlock the other restart threads."""
+    import pytest
+
+    from sboxgates_tpu.ops import sweeps as sw
+
+    def boom(*a, **k):
+        raise RuntimeError("kernel boom")
+
+    monkeypatch.setattr(sw, "gate_step_stream", boom)
+    with pytest.raises(RuntimeError, match="kernel boom"):
+        _run(os.path.join(DATA, "crypto1_fa.txt"), 3)
