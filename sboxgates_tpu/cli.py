@@ -212,6 +212,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     else:
         generate_graph(ctx, st, targets, save_dir=args.output_dir, log=log)
+
+    if args.verbose >= 2:
+        # Per-phase wall-clock + candidate-throughput summary (a TPU-build
+        # addition; the reference has no tracing, SURVEY §5).
+        log("")
+        log(ctx.prof.report(ctx.stats))
     return 0
 
 

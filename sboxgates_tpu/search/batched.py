@@ -17,6 +17,14 @@ ratcheted by another's success — the same semantics as the reference run
 R times in parallel processes.  Kinds that rendezvous are the fixed-shape
 gate-mode kernels (existing-gate scan, pair sweep, triple stream); LUT
 sweeps execute per-thread without waiting (their shapes vary per state).
+
+Cost model caveat: under ``jax.vmap`` the fused gate-step kernel's
+``lax.cond`` early-exit chain executes BOTH branches and selects, so a
+batched dispatch always pays the full pair + NOT-pair + triple-stream
+work even when every restart hits step 1/2.  The mode wins when dispatch
+latency dominates (small states, network-attached chips — the measured
+regime it was built for); at large g on co-located hardware the serial
+loop's early exits can be cheaper.
 """
 
 from __future__ import annotations
@@ -41,11 +49,14 @@ class Rendezvous:
     dispatch (the batch analog of the reference's per-rank lockstep
     collectives)."""
 
-    def __init__(self, n_threads: int):
+    def __init__(self, n_threads: int, vmap_cache: Optional[dict] = None):
         self.cv = threading.Condition()
         self.live = n_threads
         self.waiting: List[dict] = []
-        self._vmapped = {}
+        # jit(vmap(kernel)) wrappers keyed by (key, R, shared).  Callers
+        # pass a long-lived dict (SearchContext's) so repeated rendezvous
+        # rounds reuse traces instead of re-tracing per Rendezvous.
+        self._vmapped = vmap_cache if vmap_cache is not None else {}
         self.stats = {"submits": 0, "dispatches": 0, "batched_rows": 0}
 
     def submit(self, key, kernel: Callable, args, shared=()) -> np.ndarray:
@@ -149,7 +160,7 @@ def run_batched_circuits(
     (mutated in place).  Returns [(state, out_gid)] in job order.
     """
     n = len(jobs)
-    rdv = Rendezvous(n)
+    rdv = Rendezvous(n, vmap_cache=ctx.vmap_cache)
     seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
     results: List[Optional[tuple]] = [None] * n
     errors: List[BaseException] = []

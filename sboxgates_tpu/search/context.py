@@ -19,6 +19,7 @@ from ..core import boolfunc as bf
 from ..graph.state import GATES, State
 from ..ops import combinatorics as comb
 from ..ops import sweeps
+from ..utils.profile import PhaseProfiler
 
 # Gate-count buckets: live tables are zero-padded up to the next bucket so
 # jitted sweeps see a small, fixed set of shapes.  Two buckets only — gather
@@ -177,6 +178,12 @@ class SearchContext:
         self.triple_table, self.triple_entries = _build_triple_table(self.avail_3)
         self._pair_combo_cache = {}
         self._binom = None
+        # jit(vmap(...)) wrappers for the batched-restart rendezvous; lives
+        # here so traces survive across rendezvous rounds.
+        self.vmap_cache = {}
+        # Per-phase wall-clock timers (SURVEY §5: the reference has none;
+        # report via ``prof.report(stats)`` or the CLI's -vv summary).
+        self.prof = PhaseProfiler()
         # Sweep statistics (candidates examined), for benchmarking.
         self.stats = {
             "pair_candidates": 0,
@@ -328,32 +335,34 @@ class SearchContext:
         has_triple = not lut_mode and g >= 3
         total3 = comb.n_choose_k(g, 3) if has_triple else 0
         chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
-        v = self._dispatch(
-            ("gstep", b, chunk3, has_not, has_triple),
-            functools.partial(
-                sweeps.gate_step_stream,
-                chunk3=chunk3, has_not=has_not, has_triple=has_triple,
-            ),
-            (
-                tables,
-                valid_g,
-                combos,
-                pair_valid,
-                self.binom,
-                g,
-                self.place_replicated(np.asarray(target)),
-                self.place_replicated(np.asarray(mask)),
-                self.place_replicated(self.excl_array([])),
-                total3,
-                self.pair_table,
-                self.not_table if has_not else self.pair_table,
-                self.triple_table,
-                self.next_seed(),
-            ),
-            # identical across restarts under one key: combo grid, binomial
-            # table, (empty) exclusion list, and the three match tables
-            shared=(2, 4, 8, 10, 11, 12),
-        )
+        with self.prof.phase("gate_step"):
+            v = self._dispatch(
+                ("gstep", b, chunk3, has_not, has_triple),
+                functools.partial(
+                    sweeps.gate_step_stream,
+                    chunk3=chunk3, has_not=has_not, has_triple=has_triple,
+                ),
+                (
+                    tables,
+                    valid_g,
+                    combos,
+                    pair_valid,
+                    self.binom,
+                    g,
+                    self.place_replicated(np.asarray(target)),
+                    self.place_replicated(np.asarray(mask)),
+                    self.place_replicated(self.excl_array([])),
+                    total3,
+                    self.pair_table,
+                    self.not_table if has_not else self.pair_table,
+                    self.triple_table,
+                    self.next_seed(),
+                ),
+                # identical across restarts under one key: combo grid,
+                # binomial table, (empty) exclusion list, and the three
+                # match tables
+                shared=(2, 4, 8, 10, 11, 12),
+            )
         step = int(v[0])
         if step == 0 or step >= 3:
             self.stats["pair_candidates"] += g * (g - 1) // 2
@@ -387,19 +396,20 @@ class SearchContext:
         combos = self._pair_combos(tables.shape[0])
         valid = (combos < g).all(axis=1)
         self.stats["pair_candidates"] += g * (g - 1) // 2
-        v = self._dispatch(
-            ("pair", tables.shape[0], use_not_table),
-            functools.partial(sweeps.tuple_match_sweep, num_cells=4),
-            (
-                tables,
-                combos,
-                valid,
-                self.place_replicated(target),
-                self.place_replicated(mask),
-                table,
-                self.next_seed(),
-            ),
-        )
+        with self.prof.phase("pair_sweep"):
+            v = self._dispatch(
+                ("pair", tables.shape[0], use_not_table),
+                functools.partial(sweeps.tuple_match_sweep, num_cells=4),
+                (
+                    tables,
+                    combos,
+                    valid,
+                    self.place_replicated(target),
+                    self.place_replicated(mask),
+                    table,
+                    self.next_seed(),
+                ),
+            )
         if not bool(v[0]):
             return False, 0, 0, None
         pair = np.asarray(combos[int(v[1])])
@@ -417,24 +427,25 @@ class SearchContext:
             return False, None, None
         tables, _ = self.device_tables(st)
         chunk = pick_chunk(total, STREAM_CHUNK[3])
-        v = self._dispatch(
-            ("triple", tables.shape[0], chunk),
-            functools.partial(
-                sweeps.match_stream, k=3, chunk=chunk, num_cells=8
-            ),
-            (
-                tables,
-                self.binom,
-                g,
-                self.place_replicated(np.asarray(target)),
-                self.place_replicated(np.asarray(mask)),
-                self.place_replicated(self.excl_array([])),
-                0,
-                total,
-                self.triple_table,
-                self.next_seed(),
-            ),
-        )
+        with self.prof.phase("triple_sweep"):
+            v = self._dispatch(
+                ("triple", tables.shape[0], chunk),
+                functools.partial(
+                    sweeps.match_stream, k=3, chunk=chunk, num_cells=8
+                ),
+                (
+                    tables,
+                    self.binom,
+                    g,
+                    self.place_replicated(np.asarray(target)),
+                    self.place_replicated(np.asarray(mask)),
+                    self.place_replicated(self.excl_array([])),
+                    0,
+                    total,
+                    self.triple_table,
+                    self.next_seed(),
+                ),
+            )
         self.stats["triple_candidates"] += int(v[3])
         if not bool(v[0]):
             return False, None, None

@@ -30,6 +30,15 @@ def create_circuit(
     """Returns the id of a gate realizing ``target`` under ``mask``, adding
     gates to ``st`` as needed; NO_GATE on failure.  Step numbers reference
     Kwan's paper, as in the reference implementation."""
+    # Re-entrant phase: self-time = host control flow (state copies, mux
+    # bookkeeping, verification) exclusive of the nested device sweeps.
+    with ctx.prof.phase("kwan_host"):
+        return _create_circuit(ctx, st, target, mask, inbits)
+
+
+def _create_circuit(
+    ctx: SearchContext, st: State, target, mask, inbits: List[int]
+) -> int:
     opt = ctx.opt
     metric = opt.metric
 

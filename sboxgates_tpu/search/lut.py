@@ -559,14 +559,11 @@ def _lut5_search_host(
 # -------------------------------------------------------------------------
 
 
-def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
-    """7-LUT search: LUT(LUT(a,b,c), LUT(d,e,f), g) (reference: search_7lut,
-    lut.c:256-487).  Two stages, mirroring the reference: (A) stream the full
-    C(G,7) space through the feasibility filter, capped at LUT7_CAP hits; (B)
-    sweep (ordering x outer x middle) function space over the hits."""
+def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
+    """Stage A: stream the C(G,7) space through the feasibility filter,
+    collecting up to LUT7_CAP feasible tuples (reference: lut.c:290-327).
+    Returns (combos, req1, req0) arrays, possibly empty."""
     g = st.num_gates
-    if g < 7:
-        return None
     use_device_stream = sweeps.device_rank_limit(g, 7)
 
     hit_combos: List[np.ndarray] = []
@@ -624,14 +621,39 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
                 nhits += len(fidx)
 
     if nhits == 0:
-        return None
+        empty = np.zeros((0,), np.uint32)
+        return np.zeros((0, 7), np.int32), empty, empty
     combos = np.concatenate(hit_combos)[:LUT7_CAP]
     req1 = np.concatenate(hit_req1)[:LUT7_CAP]
     req0 = np.concatenate(hit_req0)[:LUT7_CAP]
     if ctx.opt.randomize:
         perm = ctx.rng.permutation(len(combos))
         combos, req1, req0 = combos[perm], req1[perm], req0[perm]
+    return combos, req1, req0
 
+
+def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
+    """7-LUT search: LUT(LUT(a,b,c), LUT(d,e,f), g) (reference: search_7lut,
+    lut.c:256-487).  Two stages, mirroring the reference: (A) stream the full
+    C(G,7) space through the feasibility filter, capped at LUT7_CAP hits; (B)
+    sweep (ordering x outer x middle) function space over the hits."""
+    if st.num_gates < 7:
+        return None
+    with ctx.prof.phase("lut7.stageA"):
+        combos, req1, req0 = _lut7_collect_hits(
+            ctx, st, target, mask, inbits
+        )
+    if len(combos) == 0:
+        return None
+    with ctx.prof.phase("lut7.stageB"):
+        return _lut7_solve_hits(ctx, combos, req1, req0)
+
+
+def _lut7_solve_hits(
+    ctx: SearchContext, combos: np.ndarray, req1: np.ndarray, req0: np.ndarray
+) -> Optional[dict]:
+    """Stage B: sweep (ordering x outer x middle) function space over the
+    collected hit list (reference: lut.c:416-475)."""
     orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
     jwo, jwm, jg = (
         ctx.place_replicated(wo_tab),
@@ -695,14 +717,16 @@ def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     """Full LUT search: 3-LUT, then 5-LUT (2 new gates), then 7-LUT (3 new
     gates), with budget gating between phases (reference: lut_search,
     lut.c:489-631)."""
-    gid = lut3_search(ctx, st, target, mask, inbits)
+    with ctx.prof.phase("lut3"):
+        gid = lut3_search(ctx, st, target, mask, inbits)
     if gid != NO_GATE:
         return gid
 
     if not check_num_gates_possible(st, 2, 0, ctx.opt.metric):
         return NO_GATE
 
-    res = lut5_search(ctx, st, target, mask, inbits)
+    with ctx.prof.phase("lut5"):
+        res = lut5_search(ctx, st, target, mask, inbits)
     if res is not None:
         a, b, c, d, e = res["gates"]
         outer = st.add_lut(res["func_outer"], a, b, c)
@@ -718,7 +742,8 @@ def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
         return NO_GATE
 
-    res = lut7_search(ctx, st, target, mask, inbits)
+    with ctx.prof.phase("lut7"):
+        res = lut7_search(ctx, st, target, mask, inbits)
     if res is not None:
         a, b, c, d, e, f, gg = res["gates"]
         outer = st.add_lut(res["func_outer"], a, b, c)

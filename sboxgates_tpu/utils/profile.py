@@ -1,0 +1,126 @@
+"""Lightweight per-phase wall-clock profiling.
+
+The reference has no tracing at all — only verbosity-gated printf progress
+lines (SURVEY §5; sboxgates.c:664,675,718,730).  The TPU build adds what the
+reference lacks: per-phase timers around every sweep family plus the
+candidate counters in ``SearchContext.stats``, so a run can report where its
+wall time went (device sweeps vs. host control flow) and candidates/sec per
+phase without external tooling.
+
+Self-time accounting: a phase's recorded seconds exclude time spent inside
+nested (child) phases, so the numbers are additive even though e.g. the
+5-LUT sweep runs inside a mux-recursion phase.  Re-entrant phases (the
+Kwan recursion) are safe for the same reason — each frame only accumulates
+its own self time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class PhaseProfiler:
+    """Accumulates self-time seconds and call counts per named phase.
+
+    Thread-safe: the frame stack is thread-local (the batched-restart
+    driver shares one profiler across its restart threads), and the
+    accumulators are lock-protected.
+
+    Usage::
+
+        prof = PhaseProfiler()
+        with prof.phase("lut5"):
+            ...
+        print(prof.report())
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.seconds: Dict[str, float] = {}
+        self.calls: Dict[str, int] = {}
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @property
+    def _stack(self) -> List[List]:
+        """Per-thread stack of [name, start_time, child_seconds] frames."""
+        try:
+            return self._tls.stack
+        except AttributeError:
+            self._tls.stack = []
+            return self._tls.stack
+
+    def phase(self, name: str) -> "_Phase":
+        return _Phase(self, name)
+
+    def add(self, name: str, seconds: float, calls: int = 1) -> None:
+        with self._lock:
+            self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+            self.calls[name] = self.calls.get(name, 0) + calls
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        """{phase: (self_seconds, calls)} for programmatic consumers."""
+        return {
+            k: (self.seconds[k], self.calls.get(k, 0))
+            for k in self.seconds
+        }
+
+    def report(self, stats: Optional[Dict[str, int]] = None) -> str:
+        """Formatted table, hottest phase first.  ``stats`` (candidate
+        counters named ``<phase>_candidates``, with any ``_sweep`` phase
+        suffix stripped: ``pair_sweep`` -> ``pair_candidates``) adds a
+        candidates/sec column where a counter matches a phase name."""
+        wall = time.perf_counter() - self._t0
+        lines = [
+            "phase                     calls     self_s      %",
+        ]
+        total = sum(self.seconds.values())
+        for name in sorted(self.seconds, key=self.seconds.get, reverse=True):
+            sec = self.seconds[name]
+            pct = 100.0 * sec / total if total > 0 else 0.0
+            line = "%-24s %6d %10.3f %6.1f" % (
+                name, self.calls.get(name, 0), sec, pct,
+            )
+            if stats:
+                key = name.split(".")[0]
+                if key.endswith("_sweep"):
+                    key = key[: -len("_sweep")]
+                cand = stats.get(f"{key}_candidates")
+                # A parent phase whose time lives in child phases (e.g.
+                # "lut7" over stageA/B) has ~no self time; a rate against
+                # it would be meaningless noise.
+                if cand and sec >= 0.01:
+                    line += "   %.3g cand/s" % (cand / sec)
+            lines.append(line)
+        lines.append(
+            "%-24s %6s %10.3f %6.1f   (wall %.3f s)"
+            % ("total", "", total, 100.0 if total else 0.0, wall)
+        )
+        return "\n".join(lines)
+
+
+class _Phase:
+    __slots__ = ("_prof", "_name")
+
+    def __init__(self, prof: PhaseProfiler, name: str):
+        self._prof = prof
+        self._name = name
+
+    def __enter__(self):
+        if self._prof.enabled:
+            self._prof._stack.append([self._name, time.perf_counter(), 0.0])
+        return self
+
+    def __exit__(self, *exc):
+        prof = self._prof
+        if not prof.enabled:
+            return False
+        name, t0, child = prof._stack.pop()
+        dt = time.perf_counter() - t0
+        prof.add(name, dt - child)
+        if prof._stack:
+            prof._stack[-1][2] += dt
+        return False

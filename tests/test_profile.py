@@ -1,0 +1,109 @@
+"""Per-phase profiler: unit behavior and search integration.
+
+The reference has no tracing subsystem (SURVEY §5); the TPU build adds
+self-time phase timers threaded through every sweep driver.
+"""
+
+import os
+import time
+
+from sboxgates_tpu.graph.state import State
+from sboxgates_tpu.search import (
+    Options,
+    SearchContext,
+    generate_graph_one_output,
+    make_targets,
+)
+from sboxgates_tpu.utils.profile import PhaseProfiler
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def test_self_time_excludes_children():
+    prof = PhaseProfiler()
+    with prof.phase("outer"):
+        time.sleep(0.02)
+        with prof.phase("inner"):
+            time.sleep(0.05)
+    assert prof.calls["outer"] == 1
+    assert prof.calls["inner"] == 1
+    assert prof.seconds["inner"] >= 0.05
+    # outer self-time excludes the inner 0.05 s
+    assert prof.seconds["outer"] < 0.05
+
+
+def test_reentrant_phase_is_additive():
+    prof = PhaseProfiler()
+
+    def recurse(depth):
+        with prof.phase("rec"):
+            time.sleep(0.01)
+            if depth:
+                recurse(depth - 1)
+
+    recurse(3)
+    assert prof.calls["rec"] == 4
+    # Self-times sum to total wall spent inside, not 4x it.
+    assert 0.04 <= prof.seconds["rec"] < 0.12
+
+
+def test_threaded_phases_stay_sane():
+    """Restart threads share one profiler: per-thread stacks must keep
+    self-times non-negative and additive."""
+    import threading
+
+    prof = PhaseProfiler()
+
+    def worker():
+        for _ in range(20):
+            with prof.phase("outer"):
+                with prof.phase("inner"):
+                    time.sleep(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert prof.calls["outer"] == 80
+    assert prof.calls["inner"] == 80
+    assert prof.seconds["outer"] >= 0
+    assert prof.seconds["inner"] >= 0.08
+
+
+def test_disabled_profiler_records_nothing():
+    prof = PhaseProfiler(enabled=False)
+    with prof.phase("x"):
+        pass
+    assert prof.seconds == {}
+
+
+def test_report_formats_and_ranks():
+    prof = PhaseProfiler()
+    prof.add("slow", 2.0, calls=3)
+    prof.add("fast", 0.5)
+    text = prof.report({"slow_candidates": 1000})
+    lines = text.splitlines()
+    assert lines[1].startswith("slow")
+    assert "cand/s" in lines[1]
+    assert lines[2].startswith("fast")
+    assert lines[-1].startswith("total")
+
+
+def test_search_populates_phases():
+    """A real LUT search must record the sweep phases with nonzero time."""
+    sbox, n = load_sbox(os.path.join(DATA, "des_s1.txt"))
+    targets = make_targets(sbox)
+    ctx = SearchContext(Options(seed=3, lut_graph=True))
+    st = State.init_inputs(n)
+    results = generate_graph_one_output(
+        ctx, st, targets, 1, save_dir=None, log=lambda s: None
+    )
+    assert results
+    snap = ctx.prof.snapshot()
+    assert snap["gate_step"][0] > 0 and snap["gate_step"][1] >= 1
+    assert snap["kwan_host"][0] > 0
+    assert "lut3" in snap
+    # Phases appear in the report with the candidate-rate column.
+    assert "gate_step" in ctx.prof.report(ctx.stats)
