@@ -204,9 +204,9 @@ def bench_lut7() -> dict:
     rng = np.random.default_rng(0)
     r1 = rng.integers(0, 2**32, size=(t, 4), dtype=np.uint32)
     r0 = (~r1).astype(np.uint32)
-    _, wo, wm, gt = sweeps.lut7_split_tables()
-    args = (jnp.asarray(r1), jnp.asarray(r0), jnp.asarray(wo),
-            jnp.asarray(wm), jnp.asarray(gt))
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    args = (jnp.asarray(r1), jnp.asarray(r0), jnp.asarray(idx_tab),
+            jnp.asarray(pp_tab))
     np.asarray(sweeps.lut7_solve(*args, 1))
     t0 = time.perf_counter()
     v = sweeps.lut7_solve(*args, 2)

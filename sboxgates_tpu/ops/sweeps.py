@@ -277,46 +277,76 @@ def lut5_solve(req1p, req0p, w_tab, m_tab, seed):
     return jnp.stack([found.astype(jnp.int32), best_t, sel])
 
 
-@jax.jit
-def lut7_solve(req1p, req0p, wo_tab, wm_tab, g_tab, seed):
-    """7-LUT stage B: find (ordering, outer, middle) function triples.
+def _unpack_words_to_bits(words):
+    """[..., W] uint32 -> [..., W*32] 0/1 uint32; bit b of word w lands at
+    position w*32 + b (the pack order of lut7_split_tables/_pack_bits_t)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    b = (words[..., None] >> shifts) & jnp.uint32(1)
+    return b.reshape(*words.shape[:-1], words.shape[-1] * 32)
 
-    req1p/req0p: [T, 4] uint32 (128 cells packed).
-    wo_tab/wm_tab: [S, 256, 4] uint32 — cells where the outer / middle
-    function outputs 1, per ordering.  g_tab: [S, 4] — cells where the
-    seventh input is 1.  Scans orderings to bound memory; each step tests
-    all 256 x 256 function pairs for every tuple at once (reference inner
-    loops: lut.c:416-475).
+
+@jax.jit
+def lut7_solve(req1p, req0p, idx_tab, pp_tab, seed):
+    """7-LUT stage B as pair-agreement matmuls (the MXU path).
+
+    A decomposition (ordering σ, outer fo, middle fm) fails iff some
+    required-1 cell and some required-0 cell land in the same inner-LUT
+    input group — i.e. fo agrees on their outer patterns, fm agrees on
+    their middle patterns, and their free bits are equal.  Counting such
+    conflicting pairs is a bilinear form
+
+        C[t, fo, fm] = PP[fo] · B[t] · PP[fm]ᵀ
+
+    where B[t, (p1,p0), (q1,q0)] counts same-free-bit (R1-cell, R0-cell)
+    pairs by outer-pattern pair and middle-pattern pair, and
+    PP[f, p1*8+p0] = 1 iff bits p1,p0 of f agree.  This replaces an
+    8-way polarity loop over [T,256,256,4] mask intermediates (HBM-bound)
+    with three small matmuls per ordering (reference inner loops:
+    lut.c:416-475).  All products are exact: B ≤ 2 and PP·B ≤ 128 fit
+    bfloat16 integers; C ≤ 8192 accumulates in float32.
+
+    req1p/req0p: [T, 4] uint32 (128 cells packed); idx_tab/pp_tab from
+    :func:`lut7_pair_tables`.  Returns packed int32[4]
+    [found, best_t, sigma, fo*256+fm].
     """
     num_t = req1p.shape[0]
+    bits1 = _unpack_words_to_bits(req1p)  # [T, 128]
+    bits0 = _unpack_words_to_bits(req0p)
+    pp = pp_tab.astype(jnp.bfloat16)
 
     def step(carry, sigma):
         found, sel_sigma, sel_flat = carry
-        wo = wo_tab[sigma]        # [256, 4]
-        wm = wm_tab[sigma]        # [256, 4]
-        gm = g_tab[sigma]         # [4]
-        r1 = req1p[:, None, None, :]  # [T, 1, 1, 4]
-        r0 = req0p[:, None, None, :]
-        conflict = jnp.zeros((num_t, 256, 256), dtype=bool)
-        for xg in (0, 1):
-            gmask = gm if xg else ~gm
-            for o in (0, 1):
-                a1 = r1 & (wo if o else ~wo)[None, :, None, :] & gmask
-                a0 = r0 & (wo if o else ~wo)[None, :, None, :] & gmask
-                for mi in (0, 1):
-                    wmm = (wm if mi else ~wm)[None, None, :, :]
-                    conflict = conflict | (
-                        ((a1 & wmm) != 0).any(-1) & ((a0 & wmm) != 0).any(-1)
-                    )
-        ok = ~conflict  # [T, 256, 256]
+        idx = idx_tab[sigma]  # [128] permutation: pos = x*64 + p*8 + q
+        a1 = bits1[:, idx].reshape(num_t, 2, 8, 8).astype(jnp.bfloat16)
+        a0 = bits0[:, idx].reshape(num_t, 2, 8, 8).astype(jnp.bfloat16)
+        b = jnp.einsum(
+            "txpq,txrs->tprqs", a1, a0, preferred_element_type=jnp.float32
+        ).reshape(num_t, 64, 64)
+        ppb = jnp.einsum(
+            "fi,tij->tfj", pp, b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        c = jnp.einsum(
+            "tfj,gj->tfg", ppb.astype(jnp.bfloat16), pp,
+            preferred_element_type=jnp.float32,
+        )
+        ok = c == 0  # [T, 256 outer, 256 middle]: no conflicting pair
         any_t = ok.any(axis=(1, 2))
         newly = any_t & ~found
+
         # Random choice among matching (outer, middle) function pairs —
-        # counterpart of the reference's shuffled func orders (lut.c:362-378).
-        fprio = _priority(256 * 256, seed ^ (sigma * 2 + 1))[None, :]
-        flat = jnp.argmax(
-            jnp.where(ok.reshape(num_t, -1), fprio, 0), axis=-1
-        ).astype(jnp.int32)
+        # counterpart of the reference's shuffled func orders
+        # (lut.c:362-378).  Gated: the argmax pass over [T, 65536] costs
+        # ~30% of the step, and most steps find nothing.
+        def select(_):
+            fprio = _priority(256 * 256, seed ^ (sigma * 2 + 1))[None, :]
+            return jnp.argmax(
+                jnp.where(ok.reshape(num_t, -1), fprio, 0), axis=-1
+            ).astype(jnp.int32)
+
+        flat = jax.lax.cond(
+            newly.any(), select, lambda _: jnp.zeros(num_t, jnp.int32), None
+        )
         sel_sigma = jnp.where(newly, sigma, sel_sigma)
         sel_flat = jnp.where(newly, flat, sel_flat)
         return (found | any_t, sel_sigma, sel_flat), None
@@ -327,7 +357,7 @@ def lut7_solve(req1p, req0p, wo_tab, wm_tab, g_tab, seed):
         jnp.zeros(num_t, dtype=jnp.int32),
     )
     (found, sel_sigma, sel_flat), _ = jax.lax.scan(
-        step, init, jnp.arange(wo_tab.shape[0], dtype=jnp.int32)
+        step, init, jnp.arange(idx_tab.shape[0], dtype=jnp.int32)
     )
     prio = jnp.where(found, _priority(num_t, seed), 0)
     best_t = jnp.argmax(prio).astype(jnp.int32)
@@ -456,15 +486,9 @@ def feasible_stream(tables, binom, g, target, mask, excl, start, total, *, k, ch
     return verdict, feasible, r1, r0
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
-def lut3_stream(tables, binom, g, target, mask, excl, start, total, seed, *, chunk):
-    """Whole-space 3-LUT search in one dispatch (reference: lut_search
-    phase 1, lut.c:501-523): while_loop over rank chunks, stopping at the
-    first chunk with a feasible triple and selecting one by hashed priority
-    (the counterpart of the reference's shuffled scan order).
-
-    Returns packed int32[5]: [found, rank, req1, req0, examined].
-    """
+def _lut3_stream_core(tables, binom, g, target, mask, excl, start, total, seed, chunk):
+    """Core of the whole-space 3-LUT stream.  Returns
+    (found bool, rank, req1 i32, req0 i32, examined)."""
     start = jnp.asarray(start, jnp.int32)
     total = jnp.asarray(total, jnp.int32)
     z = jnp.int32(0)
@@ -491,27 +515,31 @@ def lut3_stream(tables, binom, g, target, mask, excl, start, total, seed, *, chu
 
     found, nxt, rank, r1, r0 = jax.lax.while_loop(cond, body, init)
     examined = jnp.minimum(nxt, total) - start
+    return found, rank, r1, r0, examined
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def lut3_stream(tables, binom, g, target, mask, excl, start, total, seed, *, chunk):
+    """Whole-space 3-LUT search in one dispatch (reference: lut_search
+    phase 1, lut.c:501-523): while_loop over rank chunks, stopping at the
+    first chunk with a feasible triple and selecting one by hashed priority
+    (the counterpart of the reference's shuffled scan order).
+
+    Returns packed int32[5]: [found, rank, req1, req0, examined].
+    """
+    found, rank, r1, r0, examined = _lut3_stream_core(
+        tables, binom, g, target, mask, excl, start, total, seed, chunk
+    )
     return jnp.stack([found.astype(jnp.int32), rank, r1, r0, examined])
 
 
-@functools.partial(jax.jit, static_argnames=("chunk", "solve_rows"))
-def lut5_stream(
+def _lut5_stream_core(
     tables, binom, g, target, mask, excl, start, total, w_tab, m_tab, seed,
-    *, chunk, solve_rows=1024
+    chunk, solve_rows
 ):
-    """Whole-space 5-LUT search in one dispatch (reference: search_5lut,
-    lut.c:116-249): each chunk runs the feasibility filter, compacts the
-    top-`solve_rows` feasible tuples by hashed priority, and solves for a
-    LUT(LUT(a,b,c),d,e) decomposition in the packed cell domain.  The loop
-    continues past chunks whose feasible tuples admit no decomposition.
-
-    Returns packed int32[8]:
-    [status, rank, sigma, func_outer, req1, req0, cstart, examined] with
-    status 0 = exhausted, 1 = found, 2 = a chunk had more than `solve_rows`
-    feasible tuples and none of the solved subset decomposed (the host must
-    re-drive that chunk via feasible_stream before resuming at
-    cstart + chunk).
-    """
+    """Core of the whole-space 5-LUT stream.  Returns the tuple
+    (status, rank, sigma, func_outer, req1 i32, req0 i32, cstart,
+    examined) — see :func:`lut5_stream` for the status encoding."""
     start = jnp.asarray(start, jnp.int32)
     total = jnp.asarray(total, jnp.int32)
     z = jnp.int32(0)
@@ -565,7 +593,36 @@ def lut5_stream(
         cond, body, init
     )
     examined = jnp.minimum(nxt, total) - start
-    return jnp.stack([status, rank, sigma, fo, r1, r0, cstart, examined])
+    return status, rank, sigma, fo, r1, r0, cstart, examined
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "solve_rows"))
+def lut5_stream(
+    tables, binom, g, target, mask, excl, start, total, w_tab, m_tab, seed,
+    *, chunk, solve_rows=1024
+):
+    """Whole-space 5-LUT search in one dispatch (reference: search_5lut,
+    lut.c:116-249): each chunk runs the feasibility filter, compacts the
+    top-`solve_rows` feasible tuples by hashed priority, and solves for a
+    LUT(LUT(a,b,c),d,e) decomposition in the packed cell domain.  The loop
+    continues past chunks whose feasible tuples admit no decomposition.
+
+    Returns packed int32[8]:
+    [status, rank, sigma, func_outer, req1, req0, cstart, examined] with
+    status 0 = exhausted, 1 = found, 2 = a chunk had more than `solve_rows`
+    feasible tuples and none of the solved subset decomposed (the host must
+    re-drive that chunk via feasible_stream before resuming at
+    cstart + chunk).
+    """
+    return jnp.stack(
+        [
+            jnp.asarray(x, jnp.int32)
+            for x in _lut5_stream_core(
+                tables, binom, g, target, mask, excl, start, total,
+                w_tab, m_tab, seed, chunk, solve_rows
+            )
+        ]
+    )
 
 
 # -------------------------------------------------------------------------
@@ -965,6 +1022,104 @@ def gate_step_stream(
     return jax.lax.cond(direct | neq.any(), scan_hit, try_pair, None)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("chunk3", "chunk5", "has5", "solve_rows")
+)
+def lut_step_stream(
+    tables, valid_g, pair_combos, pair_valid, binom, g, target, mask, excl,
+    total3, total5, pair_table, w_tab, m_tab, seed,
+    *, chunk3, chunk5, has5, solve_rows=1024
+):
+    """ALL of one LUT-mode search node's head sweeps in ONE dispatch:
+    steps 1-3 (existing gate / complement / pair x function), then the
+    whole-space 3-LUT stream, then the whole-space 5-LUT stream.
+
+    The reference's LUT-mode create_circuit runs these as successive scans
+    (sboxgates.c:301-356 into lut.c:501-580); dispatching them separately
+    costs up to four device round trips per recursion node — the dominant
+    cost on hardware behind a network link (measured ~73 ms RTT vs. <5 ms
+    of kernel time at DES-S1 state sizes).  Later sweeps execute under
+    lax.cond only when earlier ones miss.
+
+    ``excl`` (mux-used input bits) applies only to the 5-LUT stream — the
+    reference's 3-LUT phase scans all triples (lut.c:501-523) while
+    search_5lut rejects inbits (lut.c:176-186).  ``has5`` statically
+    disables the 5-LUT chain when the space is pivot-sized or g < 5 (the
+    host runs the pivot sweep separately).
+
+    Returns packed int32[8]: [step, x0, x1, x2, x3, x4, ex3, ex5]
+      step 0: nothing found (host proceeds to 7-LUT / mux recursion)
+      1: existing gate matches      (x0 = gate id)
+      2: complement of existing     (x0 = gate id)
+      3: pair x available function  (x0 = pair index, x1 = slot)
+      4: 3-LUT                      (x0 = rank, x1 = req1, x2 = req0)
+      5: 5-LUT                      (x0 = rank, x1 = sigma, x2 = func_outer,
+                                     x3 = req1, x4 = req0)
+      6: 5-LUT solver overflow at chunk start x0 — the host re-drives that
+         chunk via feasible_stream, then resumes the sweep at x0 + chunk5.
+    ex3/ex5: candidate ranks examined by the 3/5-LUT streams (stats).
+
+    Budget gating stays host-side, as in gate_step_stream: the kernel may
+    compute a step the budget later rejects — wasted compute only, never a
+    wrong result.
+    """
+    z = jnp.int32(0)
+    eq = tt.eq_mask(tables, target, mask) & valid_g
+    neq = tt.eq_mask(~tables, target, mask) & valid_g
+    sprio = _priority(valid_g.shape[0], seed, det_newest=True)
+    direct = eq.any()
+    dbest = jnp.argmax(jnp.where(eq, sprio, 0)).astype(jnp.int32)
+    ibest = jnp.argmax(jnp.where(neq, sprio, 0)).astype(jnp.int32)
+    no_excl = jnp.full(excl.shape, -1, jnp.int32)
+
+    def pack(step, x0=z, x1=z, x2=z, x3=z, x4=z, ex3=z, ex5=z):
+        return jnp.stack(
+            [jnp.asarray(step, jnp.int32), x0, x1, x2, x3, x4, ex3, ex5]
+        )
+
+    def scan_hit(_):
+        return pack(
+            jnp.where(direct, 1, 2), jnp.where(direct, dbest, ibest)
+        )
+
+    def try_pair(_):
+        pf, pi, ps, _n = _tuple_match_core(
+            tables, pair_combos, pair_valid, target, mask, pair_table,
+            seed ^ 0x3D4A, 4
+        )
+
+        def pair_hit(_):
+            return pack(3, pi, ps)
+
+        def try_lut3(_):
+            f3, rank3, r1c, r0c, ex3 = _lut3_stream_core(
+                tables, binom, g, target, mask, no_excl, z, total3,
+                seed ^ 0x55D3, chunk3
+            )
+
+            def lut3_hit(_):
+                return pack(4, rank3, r1c, r0c, ex3=ex3)
+
+            def try_lut5(_):
+                if not has5:
+                    return pack(0, ex3=ex3)
+                status, rank, sigma, fo, sr1, sr0, cstart, ex5 = (
+                    _lut5_stream_core(
+                        tables, binom, g, target, mask, excl, z, total5,
+                        w_tab, m_tab, seed ^ 0x1BF5, chunk5, solve_rows
+                    )
+                )
+                step = jnp.where(status == 1, 5, jnp.where(status == 2, 6, 0))
+                x0 = jnp.where(status == 2, cstart, rank)
+                return pack(step, x0, sigma, fo, sr1, sr0, ex3, ex5)
+
+            return jax.lax.cond(f3, lut3_hit, try_lut5, None)
+
+        return jax.lax.cond(pf, pair_hit, try_lut3, None)
+
+    return jax.lax.cond(direct | neq.any(), scan_hit, try_pair, None)
+
+
 # -------------------------------------------------------------------------
 # Host-side split tables for the 5/7-LUT solvers
 # -------------------------------------------------------------------------
@@ -1050,6 +1205,35 @@ def lut7_split_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
         np.stack(wm_rows),
         np.stack(g_rows),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def lut7_pair_tables() -> Tuple[np.ndarray, np.ndarray]:
+    """(idx_tab[70, 128] int32, pp_tab[256, 64] float32) for the
+    pair-matmul 7-LUT stage-B solver (:func:`lut7_solve`).
+
+    idx_tab[s, x*64 + p*8 + q] = the cell whose σ-ordered outer pattern is
+    p, middle pattern q, free-input bit x (cell input encoding as in
+    :func:`lut7_split_tables`) — a permutation of 0..127 per ordering.
+    pp_tab[f, p1*8 + p0] = 1.0 iff bits p1 and p0 of the 8-bit function f
+    agree, i.e. a 3-input LUT with function f maps patterns p1 and p0 to
+    the same output.
+    """
+    orders, _, _, _ = lut7_split_tables()
+    cells = np.arange(128)
+    x = [(cells >> (6 - i)) & 1 for i in range(7)]
+    idx_rows = []
+    for o in orders:
+        p = x[o[0]] * 4 + x[o[1]] * 2 + x[o[2]]
+        q = x[o[3]] * 4 + x[o[4]] * 2 + x[o[5]]
+        pos = x[o[6]] * 64 + p * 8 + q
+        row = np.zeros(128, np.int32)
+        row[pos] = cells
+        idx_rows.append(row)
+    f = np.arange(256)
+    fb = (f[:, None] >> np.arange(8)[None, :]) & 1
+    pp = (fb[:, :, None] == fb[:, None, :]).reshape(256, 64)
+    return np.stack(idx_rows), pp.astype(np.float32)
 
 
 def host_cell_constraints(

@@ -15,16 +15,20 @@ Semantics: restarts are *independent* (each has its own PRNG stream and the
 full initial budget); unlike the serial loop, a restart's budget is not
 ratcheted by another's success — the same semantics as the reference run
 R times in parallel processes.  Kinds that rendezvous are the fixed-shape
-gate-mode kernels (existing-gate scan, pair sweep, triple stream); LUT
-sweeps execute per-thread without waiting (their shapes vary per state).
+per-node head kernels — gate mode's gate_step_stream and LUT mode's
+lut_step_stream — grouped by their full shape key (bucket, chunk sizes,
+has5), so only same-shaped nodes stack; the remaining variable-shape LUT
+paths (pivot sweeps, 7-LUT stages, overflow re-drives) execute per-thread
+without waiting.
 
-Cost model caveat: under ``jax.vmap`` the fused gate-step kernel's
-``lax.cond`` early-exit chain executes BOTH branches and selects, so a
-batched dispatch always pays the full pair + NOT-pair + triple-stream
-work even when every restart hits step 1/2.  The mode wins when dispatch
-latency dominates (small states, network-attached chips — the measured
-regime it was built for); at large g on co-located hardware the serial
-loop's early exits can be cheaper.
+Cost model caveat: under ``jax.vmap`` the fused head kernels'
+``lax.cond`` early-exit chains execute BOTH branches and select, so a
+batched dispatch always pays the full chain — gate mode's pair + NOT-pair
++ triple stream, LUT mode's pair + whole-space 3-LUT + small-space 5-LUT
+streams — even when every restart hits step 1/2.  The mode wins when
+dispatch latency dominates (small states, network-attached chips — the
+measured regime it was built for); at large g on co-located hardware the
+serial loop's early exits can be cheaper.
 """
 
 from __future__ import annotations

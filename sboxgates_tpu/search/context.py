@@ -32,17 +32,32 @@ LUT5_CHUNK = 1 << 17
 LUT5_SOLVE_CHUNK = 4096
 LUT7_CHUNK = 1 << 17
 LUT7_CAP = 100_000       # reference: 100k-hit buffer, lut.c:291,316
-# Stage-B decomposition solve rows per dispatch: measured on a v5 chip,
-# T=256 triples per lut7_solve call is ~3x the tuples/s of T=16 and within
-# 2% of T=1024 (the 70-ordering scan amortizes); under a mesh the rows are
-# sharded (place_chunk), the analog of the reference's stage-B rebalance
+# Stage-B decomposition solve rows per dispatch.  The pair-matmul solver
+# (sweeps.lut7_solve) measures 11k/14k/18k tuples/s at T=256/1024/4096 on
+# a v5 chip, so big chunks win; the solve loop pads to the smallest
+# LUT7_SOLVE_SIZES step covering the hit list to bound padding waste for
+# small lists (3 compiled shapes).  Under a mesh the rows are sharded
+# (place_chunk), the analog of the reference's stage-B rebalance
 # (lut.c:351-360).
-LUT7_SOLVE_CHUNK = 256
+LUT7_SOLVE_SIZES = (256, 1024, 4096)
+LUT7_SOLVE_CHUNK = LUT7_SOLVE_SIZES[-1]
 
 # Per-arity chunk sizes for the device-resident streaming sweeps.  k=7
 # uses a smaller chunk: its [128-cell, W, N] constraint intermediates are
 # HBM-bound and measure fastest at 2^15 rows.
 STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 15}
+
+# Below this 5-LUT space size the rank-chunk stream's per-candidate overhead
+# is irrelevant and its single compiled shape is cheaper than pivot tiling;
+# it is also the regime where the fused LUT head (lut_step) inlines the
+# 5-LUT sweep.
+PIVOT_MIN_TOTAL = 1 << 21
+
+
+def lut_head_has5(g: int) -> bool:
+    """True when the fused LUT head dispatch includes the 5-LUT stream
+    (small spaces; pivot-sized ones run separately)."""
+    return 5 <= g and comb.n_choose_k(g, 5) < PIVOT_MIN_TOTAL
 
 
 @dataclass
@@ -178,6 +193,7 @@ class SearchContext:
         self.triple_table, self.triple_entries = _build_triple_table(self.avail_3)
         self._pair_combo_cache = {}
         self._binom = None
+        self._lut5_tabs = None
         # jit(vmap(...)) wrappers for the batched-restart rendezvous; lives
         # here so traces survive across rendezvous rounds.
         self.vmap_cache = {}
@@ -320,16 +336,29 @@ class SearchContext:
         del key, shared
         return np.asarray(kernel(*args))
 
-    def gate_step(self, st: State, target, mask):
-        """Steps 1-4 of one gate-mode search node as ONE fused dispatch
-        (sweeps.gate_step_stream).  Returns (step, x0, x1) — see the kernel
-        docstring for the step encoding; use :meth:`decode_pair_hit` /
-        :meth:`decode_triple_hit` on the payload."""
+    def _node_operands(self, st: State, target, mask):
+        """Operand preamble shared by the fused per-node head dispatches
+        (gate_step / lut_step): padded tables, validity masks, the pair
+        combo grid, and placed target/mask.  Kept in one place so the
+        rendezvous ``shared`` index lists stay consistent with a single
+        argument layout."""
         tables, g = self.device_tables(st)
         b = tables.shape[0]
         valid_g = jnp.arange(b) < g
         combos = self._pair_combos(b)
         pair_valid = (combos < g).all(axis=1)
+        jtarget = self.place_replicated(np.asarray(target))
+        jmask = self.place_replicated(np.asarray(mask))
+        return tables, g, b, valid_g, combos, pair_valid, jtarget, jmask
+
+    def gate_step(self, st: State, target, mask):
+        """Steps 1-4 of one gate-mode search node as ONE fused dispatch
+        (sweeps.gate_step_stream).  Returns (step, x0, x1) — see the kernel
+        docstring for the step encoding; use :meth:`decode_pair_hit` /
+        :meth:`decode_triple_hit` on the payload."""
+        tables, g, b, valid_g, combos, pair_valid, jtarget, jmask = (
+            self._node_operands(st, target, mask)
+        )
         lut_mode = self.opt.lut_graph
         has_not = bool(self.not_entries) and not lut_mode
         has_triple = not lut_mode and g >= 3
@@ -349,8 +378,8 @@ class SearchContext:
                     pair_valid,
                     self.binom,
                     g,
-                    self.place_replicated(np.asarray(target)),
-                    self.place_replicated(np.asarray(mask)),
+                    jtarget,
+                    jmask,
                     self.place_replicated(self.excl_array([])),
                     total3,
                     self.pair_table,
@@ -369,6 +398,63 @@ class SearchContext:
         if has_triple and step in (0, 5):
             self.stats["triple_candidates"] += int(v[3])
         return step, int(v[1]), int(v[2])
+
+    def lut_step(self, st: State, target, mask, inbits) -> np.ndarray:
+        """Steps 1-3 plus the whole 3-LUT and (small-space) 5-LUT sweeps of
+        one LUT-mode search node as ONE fused dispatch
+        (sweeps.lut_step_stream).  Returns the packed int32[8] verdict —
+        see the kernel docstring for the step encoding; steps 1-3 decode
+        exactly as gate_step's, the LUT payloads via
+        :func:`sboxgates_tpu.search.lut.lut_search_from_head`."""
+        tables, g, b, valid_g, combos, pair_valid, jtarget, jmask = (
+            self._node_operands(st, target, mask)
+        )
+        total3 = comb.n_choose_k(g, 3)
+        total5 = comb.n_choose_k(g, 5)
+        has5 = lut_head_has5(g)
+        chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
+        chunk5 = pick_chunk(max(total5, 1), STREAM_CHUNK[5]) if has5 else 1024
+        if self._lut5_tabs is None:
+            _, w_tab, m_tab = sweeps.lut5_split_tables()
+            self._lut5_tabs = (
+                self.place_replicated(w_tab),
+                self.place_replicated(m_tab),
+            )
+        jw, jm = self._lut5_tabs
+        with self.prof.phase("lut_step"):
+            v = self._dispatch(
+                ("lstep", b, chunk3, chunk5, has5),
+                functools.partial(
+                    sweeps.lut_step_stream,
+                    chunk3=chunk3, chunk5=chunk5, has5=has5,
+                ),
+                (
+                    tables,
+                    valid_g,
+                    combos,
+                    pair_valid,
+                    self.binom,
+                    g,
+                    jtarget,
+                    jmask,
+                    self.place_replicated(self.excl_array(inbits)),
+                    total3,
+                    total5,
+                    self.pair_table,
+                    jw,
+                    jm,
+                    self.next_seed(),
+                ),
+                # identical across restarts under one key: combo grid,
+                # binomial table, pair match table, 5-LUT split tables
+                shared=(2, 4, 11, 12, 13),
+            )
+        step = int(v[0])
+        if step == 0 or step >= 3:
+            self.stats["pair_candidates"] += g * (g - 1) // 2
+        self.stats["lut3_candidates"] += int(v[6])
+        self.stats["lut5_candidates"] += int(v[7])
+        return v
 
     def decode_pair_hit(self, st: State, index: int, slot: int, use_not: bool):
         """(gid1, gid2, entry) for a fused-kernel pair hit."""

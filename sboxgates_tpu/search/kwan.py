@@ -21,7 +21,7 @@ from ..graph.state import (
     get_sat_metric,
 )
 from .context import SearchContext
-from .lut import lut_search
+from .lut import lut_search, lut_search_from_head
 
 
 def create_circuit(
@@ -42,10 +42,19 @@ def _create_circuit(
     opt = ctx.opt
     metric = opt.metric
 
-    # Steps 1-4 in ONE fused device dispatch (sweeps.gate_step_stream);
-    # budget gates are applied host-side in the reference's order
-    # (sboxgates.c:301-435).
-    step, x0, x1 = ctx.gate_step(st, target, mask)
+    # Steps 1-4 in ONE fused device dispatch; budget gates are applied
+    # host-side in the reference's order (sboxgates.c:301-435).  LUT mode
+    # single-device additionally inlines the whole 3-LUT and small-space
+    # 5-LUT sweeps into the same dispatch (sweeps.lut_step_stream) — one
+    # device round trip per search node instead of up to four.
+    head = None
+    if opt.lut_graph and ctx.mesh_plan is None:
+        head = ctx.lut_step(st, target, mask, inbits)
+        step, x0, x1 = int(head[0]), int(head[1]), int(head[2])
+        if step >= 4:
+            step = 0  # LUT payloads are consumed after the step 1-3 gates
+    else:
+        step, x0, x1 = ctx.gate_step(st, target, mask)
 
     # Steps 1-2: an existing gate, or the complement of one.
     if step == 1:
@@ -68,7 +77,10 @@ def _create_circuit(
         return ret
 
     if opt.lut_graph:
-        ret = lut_search(ctx, st, target, mask, inbits)
+        if head is not None:
+            ret = lut_search_from_head(ctx, st, target, mask, inbits, head)
+        else:
+            ret = lut_search(ctx, st, target, mask, inbits)
         if ret != NO_GATE:
             st.verify_gate(ret, target, mask)
             return ret

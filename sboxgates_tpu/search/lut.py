@@ -33,7 +33,11 @@ from .context import (
     LUT7_CAP,
     LUT7_CHUNK,
     LUT7_SOLVE_CHUNK,
+    LUT7_SOLVE_SIZES,
+    PIVOT_MIN_TOTAL,
+    STREAM_CHUNK,
     SearchContext,
+    lut_head_has5,
     pick_chunk,
 )
 
@@ -62,6 +66,21 @@ def _pick_row(ctx: SearchContext, rows: np.ndarray) -> int:
 # -------------------------------------------------------------------------
 
 
+def _add_lut3_result(
+    ctx: SearchContext, st: State, rank: int, pr1: int, pr0: int, target, mask
+) -> int:
+    """Materializes a feasible 3-LUT: unrank the triple, fill don't-care
+    function bits (randomized as in the reference, lut.c:102-108), add and
+    verify.  Shared by the standalone and fused-head decode paths."""
+    a, b, c = (int(x) for x in comb.unrank_combination(rank, st.num_gates, 3))
+    func = pr1
+    if ctx.opt.randomize:
+        func |= int(ctx.rng.integers(0, 256)) & ~(pr1 | pr0) & 0xFF
+    gid = st.add_lut(func, a, b, c)
+    st.verify_gate(gid, target, mask)
+    return gid
+
+
 def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     """All gate triples x any 3-input function (reference: lut_search phase 1,
     lut.c:501-523).  Returns the new LUT's gate id or NO_GATE."""
@@ -82,26 +101,23 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
         ctx.stats["lut3_candidates"] += int(v[4])
         if not v[0]:
             return NO_GATE
-        rank, pr1, pr0 = int(v[1]), int(v[2]) & 0xFF, int(v[3]) & 0xFF
-        a, b, c = (int(x) for x in comb.unrank_combination(rank, g, 3))
-    else:
-        found, cstart, feas, r1, r0, examined, _ = ctx.feasible_stream_driver(
-            st, target, mask, [], k=3
+        return _add_lut3_result(
+            ctx, st, int(v[1]), int(v[2]) & 0xFF, int(v[3]) & 0xFF,
+            target, mask,
         )
-        ctx.stats["lut3_candidates"] += examined
-        if not found:
-            return NO_GATE
-        feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
-        rows = np.nonzero(feas)[0]
-        row = _pick_row(ctx, rows)
-        a, b, c = (int(x) for x in comb.unrank_combination(cstart + row, g, 3))
-        pr1, pr0 = int(r1[row]) & 0xFF, int(r0[row]) & 0xFF
-    func = pr1
-    if ctx.opt.randomize:
-        func |= int(ctx.rng.integers(0, 256)) & ~(pr1 | pr0) & 0xFF
-    gid = st.add_lut(func, a, b, c)
-    st.verify_gate(gid, target, mask)
-    return gid
+    found, cstart, feas, r1, r0, examined, _ = ctx.feasible_stream_driver(
+        st, target, mask, [], k=3
+    )
+    ctx.stats["lut3_candidates"] += examined
+    if not found:
+        return NO_GATE
+    feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+    rows = np.nonzero(feas)[0]
+    row = _pick_row(ctx, rows)
+    return _add_lut3_result(
+        ctx, st, cstart + row, int(r1[row]) & 0xFF, int(r0[row]) & 0xFF,
+        target, mask,
+    )
 
 
 # -------------------------------------------------------------------------
@@ -200,11 +216,6 @@ def pivot_tile_shape(g: int) -> Tuple[int, int]:
     if g <= 128:
         return 256, 512
     return 512, 1024
-
-
-# Below this space size the rank-chunk stream's per-candidate overhead is
-# irrelevant and its single compiled shape is cheaper than tiling.
-PIVOT_MIN_TOTAL = 1 << 21
 
 
 def _next_pow2(n: int) -> int:
@@ -425,44 +436,9 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     total = comb.n_choose_k(g, 5)
 
     if ctx.mesh_plan is None:
-        # Fully-fused path: filter + compaction + decomposition solve inside
-        # one while_loop dispatch; one int32[8] verdict per call.
-        args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
-        start = 0
-        while start < total:
-            v = np.asarray(
-                sweeps.lut5_stream(
-                    *args, start, total, jw, jm, ctx.next_seed(), chunk=chunk
-                )
-            )
-            status, cstart = int(v[0]), int(v[6])
-            ctx.stats["lut5_candidates"] += int(v[7])
-            if status == 0:
-                return None
-            if status == 1:
-                combo = comb.unrank_combination(int(v[1]), g, 5)
-                return _decode_lut5(
-                    ctx,
-                    combo,
-                    int(v[2]),
-                    int(v[3]),
-                    _unpack32(int(v[4]) & 0xFFFFFFFF),
-                    _unpack32(int(v[5]) & 0xFFFFFFFF),
-                    splits,
-                    w_tab,
-                    m_tab,
-                )
-            # status 2: the chunk at cstart had more feasible tuples than the
-            # in-kernel solver examined — re-drive just that chunk through the
-            # two-phase path, then resume the fused stream after it.
-            res = _lut5_chunk_two_phase(
-                ctx, st, target, mask, inbits, cstart, jw, jm,
-                splits, w_tab, m_tab, prebuilt=(args, total, chunk),
-            )
-            if res is not None:
-                return res
-            start = cstart + chunk
-        return None
+        return _lut5_stream_loop(
+            ctx, st, target, mask, inbits, 0, jw, jm, splits, w_tab, m_tab
+        )
 
     prebuilt = ctx.stream_args(st, target, mask, inbits, 5)
     start = 0
@@ -476,6 +452,51 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         res = _lut5_solve_feasible_chunk(
             ctx, st, target, mask, cstart, feas, r1, r0, jw, jm,
             splits, w_tab, m_tab,
+        )
+        if res is not None:
+            return res
+        start = cstart + chunk
+    return None
+
+
+def _lut5_stream_loop(
+    ctx, st, target, mask, inbits, start, jw, jm, splits, w_tab, m_tab
+) -> Optional[dict]:
+    """Fully-fused single-device 5-LUT sweep from rank ``start``: filter +
+    compaction + decomposition solve inside one while_loop dispatch; one
+    int32[8] verdict per call.  Also the resume path after a fused-head
+    solver overflow (lut_search_from_head)."""
+    g = st.num_gates
+    args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
+    while start < total:
+        v = np.asarray(
+            sweeps.lut5_stream(
+                *args, start, total, jw, jm, ctx.next_seed(), chunk=chunk
+            )
+        )
+        status, cstart = int(v[0]), int(v[6])
+        ctx.stats["lut5_candidates"] += int(v[7])
+        if status == 0:
+            return None
+        if status == 1:
+            combo = comb.unrank_combination(int(v[1]), g, 5)
+            return _decode_lut5(
+                ctx,
+                combo,
+                int(v[2]),
+                int(v[3]),
+                _unpack32(int(v[4]) & 0xFFFFFFFF),
+                _unpack32(int(v[5]) & 0xFFFFFFFF),
+                splits,
+                w_tab,
+                m_tab,
+            )
+        # status 2: the chunk at cstart had more feasible tuples than the
+        # in-kernel solver examined — re-drive just that chunk through the
+        # two-phase path, then resume the fused stream after it.
+        res = _lut5_chunk_two_phase(
+            ctx, st, target, mask, inbits, cstart, jw, jm,
+            splits, w_tab, m_tab, prebuilt=(args, total, chunk),
         )
         if res is not None:
             return res
@@ -655,23 +676,22 @@ def _lut7_solve_hits(
     """Stage B: sweep (ordering x outer x middle) function space over the
     collected hit list (reference: lut.c:416-475)."""
     orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
-    jwo, jwm, jg = (
-        ctx.place_replicated(wo_tab),
-        ctx.place_replicated(wm_tab),
-        ctx.place_replicated(g_tab),
-    )
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    jidx = ctx.place_replicated(idx_tab)
+    jpp = ctx.place_replicated(pp_tab)
     for lo in range(0, len(combos), LUT7_SOLVE_CHUNK):
         hi = min(lo + LUT7_SOLVE_CHUNK, len(combos))
-        r1, _ = comb.pad_rows(req1[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
-        r0, _ = comb.pad_rows(req0[lo:hi], LUT7_SOLVE_CHUNK, fill=0xFFFFFFFF)
+        # Pad to the smallest compiled size covering this block.
+        size = next(s for s in LUT7_SOLVE_SIZES if s >= hi - lo)
+        r1, _ = comb.pad_rows(req1[lo:hi], size, fill=0xFFFFFFFF)
+        r0, _ = comb.pad_rows(req0[lo:hi], size, fill=0xFFFFFFFF)
         ctx.stats["lut7_solved"] += hi - lo
         v = np.asarray(
             sweeps.lut7_solve(
                 ctx.place_chunk(r1, fill=0xFFFFFFFF),
                 ctx.place_chunk(r0, fill=0xFFFFFFFF),
-                jwo,
-                jwm,
-                jg,
+                jidx,
+                jpp,
                 ctx.next_seed(),
             )
         )
@@ -713,6 +733,49 @@ def _lut7_solve_hits(
 # -------------------------------------------------------------------------
 
 
+def _add_lut5_result(ctx: SearchContext, st: State, res: dict, target, mask) -> int:
+    """Materializes a 5-LUT decomposition as two LUT gates (reference:
+    lut.c:553-580)."""
+    a, b, c, d, e = res["gates"]
+    outer = st.add_lut(res["func_outer"], a, b, c)
+    gid = st.add_lut(res["func_inner"], outer, d, e)
+    st.verify_gate(gid, target, mask)
+    if ctx.opt.verbosity >= 1:
+        print(
+            "Found 5LUT: %02x %02x    %3d %3d %3d %3d %3d"
+            % (res["func_outer"], res["func_inner"], a, b, c, d, e)
+        )
+    return gid
+
+
+def _lut7_phase(ctx: SearchContext, st: State, target, mask, inbits) -> int:
+    """Budget-gated 7-LUT phase: three new gates on success (reference:
+    lut.c:582-625)."""
+    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
+        return NO_GATE
+
+    with ctx.prof.phase("lut7"):
+        res = lut7_search(ctx, st, target, mask, inbits)
+    if res is None:
+        return NO_GATE
+    a, b, c, d, e, f, gg = res["gates"]
+    outer = st.add_lut(res["func_outer"], a, b, c)
+    middle = st.add_lut(res["func_middle"], d, e, f)
+    gid = st.add_lut(res["func_inner"], outer, middle, gg)
+    st.verify_gate(gid, target, mask)
+    if ctx.opt.verbosity >= 1:
+        print(
+            "Found 7LUT: %02x %02x %02x %3d %3d %3d %3d %3d %3d %3d"
+            % (
+                res["func_outer"],
+                res["func_middle"],
+                res["func_inner"],
+                a, b, c, d, e, f, gg,
+            )
+        )
+    return gid
+
+
 def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     """Full LUT search: 3-LUT, then 5-LUT (2 new gates), then 7-LUT (3 new
     gates), with budget gating between phases (reference: lut_search,
@@ -728,37 +791,71 @@ def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     with ctx.prof.phase("lut5"):
         res = lut5_search(ctx, st, target, mask, inbits)
     if res is not None:
-        a, b, c, d, e = res["gates"]
-        outer = st.add_lut(res["func_outer"], a, b, c)
-        gid = st.add_lut(res["func_inner"], outer, d, e)
-        st.verify_gate(gid, target, mask)
-        if ctx.opt.verbosity >= 1:
-            print(
-                "Found 5LUT: %02x %02x    %3d %3d %3d %3d %3d"
-                % (res["func_outer"], res["func_inner"], a, b, c, d, e)
-            )
-        return gid
+        return _add_lut5_result(ctx, st, res, target, mask)
 
-    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
+    return _lut7_phase(ctx, st, target, mask, inbits)
+
+
+def lut_search_from_head(
+    ctx: SearchContext, st: State, target, mask, inbits, head: np.ndarray
+) -> int:
+    """LUT-search continuation of a fused head dispatch (ctx.lut_step):
+    decode its 3/5-LUT verdict instead of re-dispatching those sweeps,
+    handle the 5-LUT overflow / pivot-sized cases, then the 7-LUT phase.
+
+    ``head`` is the int32[8] lut_step_stream verdict with step >= 4 or 0
+    (steps 1-3 were handled by the caller, kwan.py).
+    """
+    g = st.num_gates
+    step = int(head[0])
+
+    if step == 4:  # 3-LUT hit: same decode as lut3_search's fused path
+        return _add_lut3_result(
+            ctx, st, int(head[1]), int(head[2]) & 0xFF, int(head[3]) & 0xFF,
+            target, mask,
+        )
+
+    if not check_num_gates_possible(st, 2, 0, ctx.opt.metric):
         return NO_GATE
 
-    with ctx.prof.phase("lut7"):
-        res = lut7_search(ctx, st, target, mask, inbits)
-    if res is not None:
-        a, b, c, d, e, f, gg = res["gates"]
-        outer = st.add_lut(res["func_outer"], a, b, c)
-        middle = st.add_lut(res["func_middle"], d, e, f)
-        gid = st.add_lut(res["func_inner"], outer, middle, gg)
-        st.verify_gate(gid, target, mask)
-        if ctx.opt.verbosity >= 1:
-            print(
-                "Found 7LUT: %02x %02x %02x %3d %3d %3d %3d %3d %3d %3d"
-                % (
-                    res["func_outer"],
-                    res["func_middle"],
-                    res["func_inner"],
-                    a, b, c, d, e, f, gg,
-                )
+    res = None
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    if step == 5:
+        combo = comb.unrank_combination(int(head[1]), g, 5)
+        res = _decode_lut5(
+            ctx,
+            combo,
+            int(head[2]),
+            int(head[3]),
+            _unpack32(int(head[4]) & 0xFFFFFFFF),
+            _unpack32(int(head[5]) & 0xFFFFFFFF),
+            splits,
+            w_tab,
+            m_tab,
+        )
+    elif step == 6:
+        # In-kernel solver overflow: re-drive the flagged chunk through the
+        # two-phase path, then resume the fused stream after it.
+        jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+        cstart = int(head[1])
+        with ctx.prof.phase("lut5"):
+            res = _lut5_chunk_two_phase(
+                ctx, st, target, mask, inbits, cstart, jw, jm,
+                splits, w_tab, m_tab,
             )
-        return gid
-    return NO_GATE
+            if res is None:
+                chunk = pick_chunk(comb.n_choose_k(g, 5), STREAM_CHUNK[5])
+                res = _lut5_stream_loop(
+                    ctx, st, target, mask, inbits, cstart + chunk,
+                    jw, jm, splits, w_tab, m_tab,
+                )
+    elif not lut_head_has5(g):
+        # The head skipped 5-LUT (pivot-sized space or g < 5): run the
+        # full 5-LUT search separately.
+        with ctx.prof.phase("lut5"):
+            res = lut5_search(ctx, st, target, mask, inbits)
+
+    if res is not None:
+        return _add_lut5_result(ctx, st, res, target, mask)
+
+    return _lut7_phase(ctx, st, target, mask, inbits)

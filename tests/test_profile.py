@@ -22,15 +22,19 @@ DATA = os.path.join(os.path.dirname(__file__), "data")
 
 def test_self_time_excludes_children():
     prof = PhaseProfiler()
+    t0 = time.perf_counter()
     with prof.phase("outer"):
         time.sleep(0.02)
         with prof.phase("inner"):
             time.sleep(0.05)
+    wall = time.perf_counter() - t0
     assert prof.calls["outer"] == 1
     assert prof.calls["inner"] == 1
     assert prof.seconds["inner"] >= 0.05
-    # outer self-time excludes the inner 0.05 s
-    assert prof.seconds["outer"] < 0.05
+    # Structural property (robust to scheduler jitter): self-times are
+    # additive — outer self + inner self ≈ total wall, so outer self
+    # excludes the inner sleep.
+    assert prof.seconds["outer"] <= wall - prof.seconds["inner"] + 0.001
 
 
 def test_reentrant_phase_is_additive():
@@ -42,10 +46,13 @@ def test_reentrant_phase_is_additive():
             if depth:
                 recurse(depth - 1)
 
+    t0 = time.perf_counter()
     recurse(3)
+    wall = time.perf_counter() - t0
     assert prof.calls["rec"] == 4
-    # Self-times sum to total wall spent inside, not 4x it.
-    assert 0.04 <= prof.seconds["rec"] < 0.12
+    # Self-times sum to the wall spent inside, not 4x it (robust bound:
+    # the whole call tree ran once, so self-time can't exceed its wall).
+    assert 0.04 <= prof.seconds["rec"] <= wall + 0.001
 
 
 def test_threaded_phases_stay_sane():
@@ -102,8 +109,9 @@ def test_search_populates_phases():
     )
     assert results
     snap = ctx.prof.snapshot()
-    assert snap["gate_step"][0] > 0 and snap["gate_step"][1] >= 1
+    # LUT mode single-device runs the fused head (steps 1-3 + 3/5-LUT in
+    # one dispatch per node).
+    assert snap["lut_step"][0] > 0 and snap["lut_step"][1] >= 1
     assert snap["kwan_host"][0] > 0
-    assert "lut3" in snap
     # Phases appear in the report with the candidate-rate column.
-    assert "gate_step" in ctx.prof.report(ctx.stats)
+    assert "lut_step" in ctx.prof.report(ctx.stats)

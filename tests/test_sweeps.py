@@ -294,6 +294,44 @@ def test_lut5_pipeline_planted(rng):
     assert bool(tt.eq_mask(inner_t, target, mask))
 
 
+def test_lut7_pair_formulation_matches_group_oracle(rng):
+    """The pair-agreement bilinear form used by lut7_solve must agree with
+    the direct 'no inner-LUT group mixes required-1 and required-0 cells'
+    test for random constraints and decompositions."""
+    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+
+    def unpack(words):
+        return np.concatenate(
+            [((int(w) >> np.arange(32)) & 1) for w in words]
+        ).astype(bool)
+
+    for _ in range(100):
+        sigma = int(rng.integers(0, len(orders)))
+        fo = int(rng.integers(0, 256))
+        fm = int(rng.integers(0, 256))
+        cells = rng.integers(0, 3, size=128)  # 0: free, 1: req1, 2: req0
+        r1 = cells == 1
+        r0 = cells == 2
+
+        # Direct oracle: group cells by (fo output, fm output, free bit).
+        wob = unpack(wo_tab[sigma, fo])
+        wmb = unpack(wm_tab[sigma, fm])
+        gb = unpack(g_tab[sigma])
+        groups = wob * 4 + wmb * 2 + gb
+        conflict = any(
+            (r1 & (groups == g)).any() and (r0 & (groups == g)).any()
+            for g in range(8)
+        )
+
+        # Pair formulation: PP[fo] . B . PP[fm]^T > 0.
+        a1 = r1[idx_tab[sigma]].reshape(2, 8, 8).astype(np.float64)
+        a0 = r0[idx_tab[sigma]].reshape(2, 8, 8).astype(np.float64)
+        b = np.einsum("xpq,xrs->prqs", a1, a0).reshape(64, 64)
+        c = pp_tab[fo] @ b @ pp_tab[fm]
+        assert (c > 0) == conflict, (sigma, fo, fm)
+
+
 def test_lut7_pipeline_planted(rng):
     """Plant LUT(LUT(a,b,c),LUT(d,e,f),g); the 7-LUT solver must recover a
     valid decomposition."""
@@ -312,13 +350,13 @@ def test_lut7_pipeline_planted(rng):
     )
     assert bool(np.asarray(feas)[0])
     orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
     v = np.asarray(
         sweeps.lut7_solve(
             jnp.asarray(req1p),
             jnp.asarray(req0p),
-            jnp.asarray(wo_tab),
-            jnp.asarray(wm_tab),
-            jnp.asarray(g_tab),
+            jnp.asarray(idx_tab),
+            jnp.asarray(pp_tab),
             11,
         )
     )
