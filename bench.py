@@ -245,12 +245,18 @@ def _search_des_s1(**opt_kwargs):
 
 def bench_des_s1_lut():
     """End-to-end wall time + solution quality for the reference's LUT CI
-    config (.travis.yml:48: mpirun -N 10 ... -l -o 0 des_s1).  Returns the
-    best state so the Pallas bench can execute the searched circuit."""
-    dt, best = _search_des_s1(lut_graph=True, iterations=1)
+    config (.travis.yml:48: mpirun -N 10 ... -l -o 0 des_s1).  Runs twice:
+    the first run pays one-time jit tracing/compilation (amortized across
+    a session and partly cached on disk), the second is the steady-state
+    wall time.  Returns the best state so the Pallas bench can execute the
+    searched circuit."""
+    cold, best = _search_des_s1(lut_graph=True, iterations=1)
+    warm, best2 = _search_des_s1(lut_graph=True, iterations=1)
+    best = best2 or best
     entry = {
         "metric": "des_s1_bit0_lut",
-        "value": dt, "unit": "s",
+        "value": warm, "unit": "s",
+        "cold_first_run_s": cold,
         "gates": best.num_gates - best.num_inputs if best else None,
     }
     return entry, best

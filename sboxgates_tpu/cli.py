@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the -i restarts as one device batch "
                         "(independent restarts, vmapped sweeps) instead of "
                         "a serial loop")
+    p.add_argument("--serial-mux", action="store_true",
+                   help="disable concurrent exploration of mux select bits "
+                        "(single in-flight device sweep at a time)")
     p.add_argument("--output-dir", default=".", metavar="DIR",
                    help="directory for saved XML states (default: cwd)")
     p.add_argument("--coordinator", metavar="HOST:PORT", default=None,
@@ -180,6 +183,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         verbosity=args.verbose,
         seed=args.seed,
         batch_restarts=args.batch_iterations,
+        parallel_mux=False if args.serial_mux else None,
     )
     mesh_plan = None
     if args.mesh:
@@ -189,12 +193,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ctx = SearchContext(opt, mesh_plan=mesh_plan)
 
     if args.verbose >= 1:
-        log("Available gates: NOT " + " ".join(
-            bf.GATE_NAMES[f.fun] for f in ctx.avail_gates))
-        log("Generated gates: " + " ".join(
-            bf.GATE_NAMES[f.fun] for f in ctx.avail_not))
-        log("Generated 3-input gates: " + " ".join(
-            "%02x" % f.fun for f in ctx.avail_3))
+        # Byte-format parity with the reference's listing incl. trailing
+        # spaces (sboxgates.c:1080-1094).
+        log("Available gates: NOT " + "".join(
+            bf.GATE_NAMES[f.fun] + " " for f in ctx.avail_gates))
+        log("Generated gates: " + "".join(
+            bf.GATE_NAMES[f.fun] + " " for f in ctx.avail_not))
+        log("Generated 3-input gates: " + "".join(
+            "%02x " % f.fun for f in ctx.avail_3))
 
     if args.graph is None:
         st = State.init_inputs(num_inputs)

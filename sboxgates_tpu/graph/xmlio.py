@@ -262,3 +262,36 @@ def state_from_xml(text: str) -> State:
 def load_state(path: str) -> State:
     with open(path, "r", encoding="utf-8") as f:
         return state_from_xml(f.read())
+
+
+# -- schema validation ----------------------------------------------------
+
+_SCHEMA = None
+
+
+def validate_xml(text: str) -> None:
+    """Validates a state document against the shipped ``gates.xsd``
+    contract (the formal interop schema; reference counterpart:
+    gates.xsd).  Raises StateLoadError on violation.
+
+    This is a strict contract check used by tests and available to
+    callers; the loader itself (:func:`state_from_xml`) stays
+    schema-library-free and enforces the structural rules directly, as
+    the reference's load_state does (state.c:260-411).
+    """
+    global _SCHEMA
+    from lxml import etree
+
+    if _SCHEMA is None:
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "gates.xsd")
+        _SCHEMA = etree.XMLSchema(etree.parse(path))
+    try:
+        doc = etree.fromstring(text.encode("utf-8"))
+    except etree.XMLSyntaxError as e:
+        raise StateLoadError(f"XML parse error: {e}") from e
+    if not _SCHEMA.validate(doc):
+        raise StateLoadError(
+            f"schema violation: {_SCHEMA.error_log.last_error}"
+        )

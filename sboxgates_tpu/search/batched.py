@@ -47,20 +47,44 @@ from .context import SearchContext
 from .kwan import create_circuit
 
 
+# jit(vmap(kernel)) wrappers keyed by (key, bucket, shared).  Process-wide:
+# a submission key encodes every static of its kernel (kind, bucket sizes,
+# chunk shapes), so wrappers are safely shared across contexts and
+# rendezvous — re-tracing the big fused kernels per SearchContext costs
+# ~15 s of host time per search.
+_VMAP_CACHE: dict = {}
+
+_PAD_IS_CHEAP: Optional[bool] = None
+
+
+def _pad_is_cheap() -> bool:
+    """True on accelerator backends, where a padded vmap lane rides a
+    dispatch that is RTT/launch-bound anyway."""
+    global _PAD_IS_CHEAP
+    if _PAD_IS_CHEAP is None:
+        import jax
+
+        _PAD_IS_CHEAP = jax.default_backend() != "cpu"
+    return _PAD_IS_CHEAP
+
+
 class Rendezvous:
     """Collects sweep requests from R restart threads; when every live
     thread is blocked on one, same-key requests execute as one vmapped
     dispatch (the batch analog of the reference's per-rank lockstep
     collectives)."""
 
+    # Cap on concurrently-spawned helper threads (mux-branch workers).
+    # They mostly block on device sweeps, so the count trades RTT overlap
+    # against host-side GIL contention.
+    MAX_SPAWNED = 16
+
     def __init__(self, n_threads: int, vmap_cache: Optional[dict] = None):
         self.cv = threading.Condition()
         self.live = n_threads
+        self.spawned = 0
         self.waiting: List[dict] = []
-        # jit(vmap(kernel)) wrappers keyed by (key, R, shared).  Callers
-        # pass a long-lived dict (SearchContext's) so repeated rendezvous
-        # rounds reuse traces instead of re-tracing per Rendezvous.
-        self._vmapped = vmap_cache if vmap_cache is not None else {}
+        self._vmapped = vmap_cache if vmap_cache is not None else _VMAP_CACHE
         self.stats = {"submits": 0, "dispatches": 0, "batched_rows": 0}
 
     def submit(self, key, kernel: Callable, args, shared=()) -> np.ndarray:
@@ -84,13 +108,45 @@ class Rendezvous:
         return entry["result"]
 
     def finish(self) -> None:
-        """Marks the calling restart thread as done (it will submit no
-        further requests)."""
+        """Marks the calling thread as done submitting (leaves the pool)."""
         with self.cv:
-            self.live -= 1
-            if self.live > 0 and len(self.waiting) == self.live:
-                self._flush()
+            self._leave()
+
+    def _leave(self) -> None:
+        """Caller holds the lock: removes one thread from the pool and
+        flushes if everyone remaining is now blocked."""
+        self.live -= 1
+        if self.live > 0 and len(self.waiting) == self.live:
+            self._flush()
+        self.cv.notify_all()
+
+    def try_spawn(self) -> bool:
+        """Reserves a slot for a helper thread (adds it to the pool).
+        Returns False at the MAX_SPAWNED cap — the caller then runs the
+        job inline instead."""
+        with self.cv:
+            if self.spawned >= self.MAX_SPAWNED:
+                return False
+            self.spawned += 1
+            self.live += 1
             self.cv.notify_all()
+            return True
+
+    def child_done(self) -> None:
+        """Releases a try_spawn slot (the helper thread exits the pool)."""
+        with self.cv:
+            self.spawned -= 1
+            self._leave()
+
+    def suspend(self) -> None:
+        """The calling thread leaves the pool to block on something other
+        than a sweep (joining children); pair with resume()."""
+        self.finish()
+
+    def resume(self) -> None:
+        """Re-enters the pool after suspend()."""
+        with self.cv:
+            self.live += 1
 
     def _flush(self) -> None:
         """Dispatches every pending group (caller holds the lock; every
@@ -113,45 +169,124 @@ class Rendezvous:
         self.cv.notify_all()
 
     def _run_group(self, key, entries) -> None:
-        if len(entries) == 1:
+        n = len(entries)
+        if n == 1:
             e = entries[0]
             e["result"] = np.asarray(e["kernel"](*e["args"]))
             return
+        if n > 32:
+            # Larger than the biggest vmap bucket (possible via
+            # --batch-iterations or the batched multi-output beam):
+            # dispatch in slices.
+            for lo in range(0, n, 32):
+                self._run_group(key, entries[lo : lo + 32])
+            return
+        # Group size depends on thread timing.  On accelerators, pad to
+        # one of two fixed buckets (duplicating entries): a padded vmap
+        # lane rides a dispatch that is RTT-bound anyway, while an
+        # unbucketed R would compile a fresh kernel for nearly every
+        # distinct group size (~seconds each on a remote accelerator),
+        # swamping the round trips the batching saves.  On CPU padded
+        # lanes are real compute and compiles are fast+cached, so groups
+        # run at their exact size.
+        bucket = (16 if n <= 16 else 32) if _pad_is_cheap() else n
+        rows = [entries[i % n] for i in range(bucket)]
         shared = entries[0]["shared"]
         nargs = len(entries[0]["args"])
-        vkey = (key, len(entries), shared)
+        vkey = (key, bucket, shared)
         fn = self._vmapped.get(vkey)
         if fn is None:
             in_axes = [None if i in shared else 0 for i in range(nargs)]
             fn = jax.jit(jax.vmap(entries[0]["kernel"], in_axes=in_axes))
             self._vmapped[vkey] = fn
         stacked = [
-            entries[0]["args"][i]
+            rows[0]["args"][i]
             if i in shared
-            else jnp.stack([jnp.asarray(e["args"][i]) for e in entries])
+            else jnp.stack([jnp.asarray(e["args"][i]) for e in rows])
             for i in range(nargs)
         ]
         out = np.asarray(fn(*stacked))
         for r, e in enumerate(entries):
             e["result"] = out[r]
-        self.stats["batched_rows"] += len(entries)
+        self.stats["batched_rows"] += n
 
 
 class RestartContext(SearchContext):
-    """Per-restart view of a shared SearchContext: same derived tables and
-    options, its own PRNG stream and stats, sweeps routed through the
-    rendezvous."""
+    """Per-thread view of a shared SearchContext (a restart, or one mux
+    branch): same derived tables and options, its own PRNG stream and
+    stats, sweeps routed through the given rendezvous (the base class
+    _dispatch submits via ``self.rdv``)."""
 
     def __init__(self, base: SearchContext, seed: int, rdv: Rendezvous):
         # Share every derived structure (match tables, combo caches, binom);
-        # only the PRNG and counters are per-restart.
+        # only the PRNG and counters are per-thread.
         self.__dict__.update(base.__dict__)
         self.rng = np.random.default_rng(seed)
         self.stats = dict.fromkeys(base.stats, 0)
-        self._rdv = rdv
+        self.rdv = rdv
 
-    def _dispatch(self, key, kernel, args, shared=()) -> np.ndarray:
-        return self._rdv.submit(key, kernel, args, shared)
+    def merge_stats_into(self, base: SearchContext, lock) -> None:
+        with lock:
+            for k, v in self.stats.items():
+                base.stats[k] = base.stats.get(k, 0) + v
+
+
+def run_mux_jobs(ctx: SearchContext, jobs: List[Callable]) -> List:
+    """Runs independent mux-branch jobs concurrently over the context's
+    rendezvous: each job gets a per-branch RestartContext (deterministic
+    seed stream, own stats) and a helper thread while try_spawn slots
+    last; the rest run inline in the calling thread.  Results are returned
+    in job order, so the caller's fold is order-identical to the serial
+    loop — the parallelization is semantically transparent (the serial
+    bit loop's branches are already independent state copies,
+    sboxgates.c:458-607).
+
+    jobs: callables taking the per-branch context.
+    """
+    rdv = ctx.rdv
+    n = len(jobs)
+    seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
+    results: List = [None] * n
+    errors: List[BaseException] = []
+    threads: List[threading.Thread] = []
+    inline: List[int] = []
+
+    def child(i: int) -> None:
+        try:
+            cctx = RestartContext(ctx, seeds[i], rdv)
+            results[i] = jobs[i](cctx)
+            cctx.merge_stats_into(ctx, rdv.cv)
+        except BaseException as e:  # re-raised after join
+            errors.append(e)
+        finally:
+            rdv.child_done()
+
+    for i in range(n):
+        if rdv.try_spawn():
+            t = threading.Thread(target=child, args=(i,), name=f"mux-{i}")
+            threads.append(t)
+            t.start()
+        else:
+            inline.append(i)
+    try:
+        for i in inline:
+            cctx = RestartContext(ctx, seeds[i], rdv)
+            results[i] = jobs[i](cctx)
+            cctx.merge_stats_into(ctx, rdv.cv)
+    except BaseException as e:
+        # Deliver the error AFTER the children are joined — raising here
+        # would leave them blocked in rdv.submit forever (the caller
+        # stays counted as live).
+        errors.append(e)
+    finally:
+        if threads:
+            rdv.suspend()  # leave the pool while blocked on joins
+            for t in threads:
+                t.join()
+            rdv.resume()
+    if errors:
+        raise errors[0]
+    return results
 
 
 def run_batched_circuits(
@@ -164,7 +299,7 @@ def run_batched_circuits(
     (mutated in place).  Returns [(state, out_gid)] in job order.
     """
     n = len(jobs)
-    rdv = Rendezvous(n, vmap_cache=ctx.vmap_cache)
+    rdv = Rendezvous(n)
     seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
     results: List[Optional[tuple]] = [None] * n
     errors: List[BaseException] = []
@@ -175,9 +310,7 @@ def run_batched_circuits(
             nst, target, mask = jobs[i]
             out = create_circuit(rctx, nst, target, mask, [])
             results[i] = (nst, out)
-            with rdv.cv:
-                for k, v in rctx.stats.items():
-                    ctx.stats[k] += v
+            rctx.merge_stats_into(ctx, rdv.cv)
         except BaseException as e:  # surfaced after join
             errors.append(e)
         finally:

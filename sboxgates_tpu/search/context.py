@@ -77,6 +77,14 @@ class Options:
     # Run the --iterations restarts as a device batch axis (vmapped
     # rendezvous dispatches) instead of the reference's serial loop.
     batch_restarts: bool = False
+    # Explore the step-5 mux select bits concurrently (independent state
+    # copies, results folded in bit order — semantically identical to the
+    # serial loop), rendezvous-batching their sweeps.  Overlaps device
+    # round trips — the dominant win on network-attached chips.  None =
+    # auto: on for accelerator backends, off for CPU (where compute, not
+    # dispatch latency, is the bottleneck and vmapped early-exit chains
+    # execute both branches).
+    parallel_mux: Optional[bool] = None
 
 
 @dataclass(frozen=True)
@@ -194,12 +202,24 @@ class SearchContext:
         self._pair_combo_cache = {}
         self._binom = None
         self._lut5_tabs = None
-        # jit(vmap(...)) wrappers for the batched-restart rendezvous; lives
-        # here so traces survive across rendezvous rounds.
-        self.vmap_cache = {}
         # Per-phase wall-clock timers (SURVEY §5: the reference has none;
         # report via ``prof.report(stats)`` or the CLI's -vv summary).
         self.prof = PhaseProfiler()
+        # Rendezvous for concurrent mux-branch / restart threads: sweeps
+        # submitted while every pool thread is blocked execute as one
+        # vmapped dispatch.  None = plain direct dispatch (mesh runs:
+        # GSPMD owns the devices and the sharded drivers are not
+        # rendezvous-aware).
+        self.rdv = None
+        want_mux = opt.parallel_mux
+        if want_mux is None:
+            import jax
+
+            want_mux = jax.default_backend() != "cpu"
+        if mesh_plan is None and want_mux:
+            from .batched import Rendezvous  # deferred: import cycle
+
+            self.rdv = Rendezvous(1)
         # Sweep statistics (candidates examined), for benchmarking.
         self.stats = {
             "pair_candidates": 0,
@@ -328,12 +348,13 @@ class SearchContext:
 
     def _dispatch(self, key, kernel, args, shared=()) -> np.ndarray:
         """Executes one fixed-shape sweep kernel, returning its packed
-        verdict.  The batched-restart driver
-        (:mod:`sboxgates_tpu.search.batched`) overrides this to rendezvous
-        same-``key`` dispatches from concurrent restarts into one vmapped
-        call; ``shared`` marks arg indices identical across restarts
-        (mapped in_axes=None instead of stacked)."""
-        del key, shared
+        verdict.  With a rendezvous attached (``self.rdv``), same-``key``
+        dispatches from concurrent threads (mux branches, batched
+        restarts) merge into one vmapped call; ``shared`` marks arg
+        indices identical across threads (mapped in_axes=None instead of
+        stacked)."""
+        if self.rdv is not None:
+            return self.rdv.submit(key, kernel, args, shared)
         return np.asarray(kernel(*args))
 
     def _node_operands(self, st: State, target, mask):

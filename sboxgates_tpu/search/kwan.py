@@ -112,6 +112,12 @@ def _create_circuit(
     # bits are tracked — deeper levels may remux an earlier bit, but one
     # branch then gets an empty mask and terminates immediately (the
     # reference truncates identically, sboxgates.c:443-449).
+    #
+    # The per-bit branches are independent (each works on its own state
+    # copy) and the best is kept by a fold in bit order, so with a
+    # rendezvous attached they run as concurrent threads whose sweeps
+    # batch into shared dispatches (run_mux_jobs) — overlapping device
+    # round trips without changing the fold semantics.
     tracked = inbits[:6]
     num_inputs = st.num_inputs
     best: State = None
@@ -123,102 +129,26 @@ def _create_circuit(
     if opt.randomize:
         ctx.rng.shuffle(bit_order)
 
-    for bit in bit_order:
-        next_inbits = tracked + [bit]
-        fsel = st.table(bit).copy()
+    if ctx.rdv is not None and len(bit_order) > 1:
+        from .batched import run_mux_jobs
 
-        if opt.lut_graph:
-            nst = st.copy()
-            nst.max_gates -= 1  # reserve room for the mux LUT
-            fb = create_circuit(ctx, nst, target, mask & ~fsel, next_inbits)
-            if fb == NO_GATE:
-                continue
-            fc = create_circuit(ctx, nst, target, mask & fsel, next_inbits)
-            if fc == NO_GATE:
-                continue
-            nst.max_gates += 1
-            if fb == fc:
-                nst_out = fb
-            elif fb == bit:
-                nst_out = nst.add_and_gate(fb, fc, metric)
-            elif fc == bit:
-                nst_out = nst.add_or_gate(fb, fc, metric)
-            else:
-                # LUT mux 0xac = sel ? fc : fb (sboxgates.c:506-508)
-                nst_out = nst.add_lut(0xAC, bit, fb, fc)
-            if nst_out == NO_GATE:
-                continue
-            nst.verify_gate(nst_out, target, mask)
-        else:
-            # AND-based mux: out = fb ^ (sel & fc') (sboxgates.c:516-537)
-            nst_and = st.copy()
-            nst_and.max_gates -= 2
-            nst_and.max_sat_metric -= get_sat_metric(bf.AND) + get_sat_metric(bf.XOR)
-            fb = create_circuit(
-                ctx, nst_and, target & ~fsel, mask & ~fsel, next_inbits
+        def job(bit):
+            return lambda cctx: _mux_try_bit(
+                cctx, st, target, mask, bit, tracked
             )
-            mux_out_and = NO_GATE
-            if fb != NO_GATE:
-                fc = create_circuit(
-                    ctx,
-                    nst_and,
-                    nst_and.table(fb) ^ target,
-                    mask & fsel,
-                    next_inbits,
-                )
-                nst_and.max_gates += 2
-                nst_and.max_sat_metric += get_sat_metric(bf.AND) + get_sat_metric(
-                    bf.XOR
-                )
-                andg = nst_and.add_and_gate(fc, bit, metric)
-                mux_out_and = nst_and.add_xor_gate(fb, andg, metric)
-                if mux_out_and != NO_GATE:
-                    nst_and.verify_gate(mux_out_and, target, mask)
 
-            # OR-based mux: out = fd ^ (sel | fe) (sboxgates.c:539-567)
-            nst_or = st.copy()
-            if mux_out_and != NO_GATE:
-                nst_or.max_gates = nst_and.num_gates
-                nst_or.max_sat_metric = nst_and.sat_metric
-            nst_or.max_gates -= 2
-            nst_or.max_sat_metric -= get_sat_metric(bf.OR) + get_sat_metric(bf.XOR)
-            fd = create_circuit(
-                ctx, nst_or, ~target & fsel, mask & fsel, next_inbits
-            )
-            mux_out_or = NO_GATE
-            if fd != NO_GATE:
-                fe = create_circuit(
-                    ctx,
-                    nst_or,
-                    nst_or.table(fd) ^ target,
-                    mask & ~fsel,
-                    next_inbits,
-                )
-                nst_or.max_gates += 2
-                nst_or.max_sat_metric += get_sat_metric(bf.OR) + get_sat_metric(
-                    bf.XOR
-                )
-                org = nst_or.add_or_gate(fe, bit, metric)
-                mux_out_or = nst_or.add_xor_gate(fd, org, metric)
-                if mux_out_or != NO_GATE:
-                    nst_or.verify_gate(mux_out_or, target, mask)
-                nst_or.max_gates = st.max_gates
-                nst_or.max_sat_metric = st.max_sat_metric
+        outcomes = run_mux_jobs(ctx, [job(b) for b in bit_order])
+    else:
+        outcomes = [
+            _mux_try_bit(ctx, st, target, mask, b, tracked) for b in bit_order
+        ]
 
-            if mux_out_and == NO_GATE and mux_out_or == NO_GATE:
-                continue
-            if metric == GATES:
-                use_and = mux_out_or == NO_GATE or (
-                    mux_out_and != NO_GATE and nst_and.num_gates < nst_or.num_gates
-                )
-            else:
-                use_and = mux_out_or == NO_GATE or (
-                    mux_out_and != NO_GATE and nst_and.sat_metric < nst_or.sat_metric
-                )
-            nst, nst_out = (nst_and, mux_out_and) if use_and else (nst_or, mux_out_or)
-
-        # Keep the best mux construction over all select bits
-        # (sboxgates.c:593-606).
+    # Keep the best mux construction over all select bits
+    # (sboxgates.c:593-606).
+    for outcome in outcomes:
+        if outcome is None:
+            continue
+        nst, nst_out = outcome
         if metric == GATES:
             better = best is None or nst.num_gates < best.num_gates
         else:
@@ -238,3 +168,105 @@ def _create_circuit(
     st.outputs = best.outputs
     st.tables = best.tables
     return best_out
+
+
+def _mux_try_bit(ctx: SearchContext, st: State, target, mask, bit, tracked):
+    """One select bit of the step-5 multiplexer: try the mux
+    construction(s) on a copy of ``st``; returns (new_state, out_gate) or
+    None.  ``ctx`` may be a per-branch view (own PRNG/stats) when branches
+    run concurrently; ``st`` is only read."""
+    opt = ctx.opt
+    metric = opt.metric
+    next_inbits = tracked + [bit]
+    fsel = st.table(bit).copy()
+
+    if opt.lut_graph:
+        nst = st.copy()
+        nst.max_gates -= 1  # reserve room for the mux LUT
+        fb = create_circuit(ctx, nst, target, mask & ~fsel, next_inbits)
+        if fb == NO_GATE:
+            return None
+        fc = create_circuit(ctx, nst, target, mask & fsel, next_inbits)
+        if fc == NO_GATE:
+            return None
+        nst.max_gates += 1
+        if fb == fc:
+            nst_out = fb
+        elif fb == bit:
+            nst_out = nst.add_and_gate(fb, fc, metric)
+        elif fc == bit:
+            nst_out = nst.add_or_gate(fb, fc, metric)
+        else:
+            # LUT mux 0xac = sel ? fc : fb (sboxgates.c:506-508)
+            nst_out = nst.add_lut(0xAC, bit, fb, fc)
+        if nst_out == NO_GATE:
+            return None
+        nst.verify_gate(nst_out, target, mask)
+        return nst, nst_out
+
+    # AND-based mux: out = fb ^ (sel & fc') (sboxgates.c:516-537)
+    nst_and = st.copy()
+    nst_and.max_gates -= 2
+    nst_and.max_sat_metric -= get_sat_metric(bf.AND) + get_sat_metric(bf.XOR)
+    fb = create_circuit(
+        ctx, nst_and, target & ~fsel, mask & ~fsel, next_inbits
+    )
+    mux_out_and = NO_GATE
+    if fb != NO_GATE:
+        fc = create_circuit(
+            ctx,
+            nst_and,
+            nst_and.table(fb) ^ target,
+            mask & fsel,
+            next_inbits,
+        )
+        nst_and.max_gates += 2
+        nst_and.max_sat_metric += get_sat_metric(bf.AND) + get_sat_metric(
+            bf.XOR
+        )
+        andg = nst_and.add_and_gate(fc, bit, metric)
+        mux_out_and = nst_and.add_xor_gate(fb, andg, metric)
+        if mux_out_and != NO_GATE:
+            nst_and.verify_gate(mux_out_and, target, mask)
+
+    # OR-based mux: out = fd ^ (sel | fe) (sboxgates.c:539-567)
+    nst_or = st.copy()
+    if mux_out_and != NO_GATE:
+        nst_or.max_gates = nst_and.num_gates
+        nst_or.max_sat_metric = nst_and.sat_metric
+    nst_or.max_gates -= 2
+    nst_or.max_sat_metric -= get_sat_metric(bf.OR) + get_sat_metric(bf.XOR)
+    fd = create_circuit(
+        ctx, nst_or, ~target & fsel, mask & fsel, next_inbits
+    )
+    mux_out_or = NO_GATE
+    if fd != NO_GATE:
+        fe = create_circuit(
+            ctx,
+            nst_or,
+            nst_or.table(fd) ^ target,
+            mask & ~fsel,
+            next_inbits,
+        )
+        nst_or.max_gates += 2
+        nst_or.max_sat_metric += get_sat_metric(bf.OR) + get_sat_metric(
+            bf.XOR
+        )
+        org = nst_or.add_or_gate(fe, bit, metric)
+        mux_out_or = nst_or.add_xor_gate(fd, org, metric)
+        if mux_out_or != NO_GATE:
+            nst_or.verify_gate(mux_out_or, target, mask)
+        nst_or.max_gates = st.max_gates
+        nst_or.max_sat_metric = st.max_sat_metric
+
+    if mux_out_and == NO_GATE and mux_out_or == NO_GATE:
+        return None
+    if metric == GATES:
+        use_and = mux_out_or == NO_GATE or (
+            mux_out_and != NO_GATE and nst_and.num_gates < nst_or.num_gates
+        )
+    else:
+        use_and = mux_out_or == NO_GATE or (
+            mux_out_and != NO_GATE and nst_and.sat_metric < nst_or.sat_metric
+        )
+    return (nst_and, mux_out_and) if use_and else (nst_or, mux_out_or)

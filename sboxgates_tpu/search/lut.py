@@ -733,6 +733,14 @@ def _lut7_solve_hits(
 # -------------------------------------------------------------------------
 
 
+def _rank() -> int:
+    """Printed rank tag: process index under multi-host, else 0 (the
+    reference tags find lines with the MPI rank, lut.c:219-222)."""
+    import jax
+
+    return jax.process_index()
+
+
 def _add_lut5_result(ctx: SearchContext, st: State, res: dict, target, mask) -> int:
     """Materializes a 5-LUT decomposition as two LUT gates (reference:
     lut.c:553-580)."""
@@ -741,9 +749,10 @@ def _add_lut5_result(ctx: SearchContext, st: State, res: dict, target, mask) -> 
     gid = st.add_lut(res["func_inner"], outer, d, e)
     st.verify_gate(gid, target, mask)
     if ctx.opt.verbosity >= 1:
+        # Byte format as the reference's rank-tagged find line (lut.c:219).
         print(
-            "Found 5LUT: %02x %02x    %3d %3d %3d %3d %3d"
-            % (res["func_outer"], res["func_inner"], a, b, c, d, e)
+            "[% 4d] Found 5LUT: %02x %02x    %3d %3d %3d %3d %3d"
+            % (_rank(), res["func_outer"], res["func_inner"], a, b, c, d, e)
         )
     return gid
 
@@ -764,9 +773,11 @@ def _lut7_phase(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     gid = st.add_lut(res["func_inner"], outer, middle, gg)
     st.verify_gate(gid, target, mask)
     if ctx.opt.verbosity >= 1:
+        # Byte format as the reference's rank-tagged find line (lut.c:471).
         print(
-            "Found 7LUT: %02x %02x %02x %3d %3d %3d %3d %3d %3d %3d"
+            "[% 4d] Found 7LUT: %02x %02x %02x %3d %3d %3d %3d %3d %3d %3d"
             % (
+                _rank(),
                 res["func_outer"],
                 res["func_middle"],
                 res["func_inner"],

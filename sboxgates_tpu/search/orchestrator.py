@@ -45,6 +45,7 @@ def generate_graph_one_output(
     sweep round across all restarts; restarts are then independent — no
     cross-iteration budget ratchet, as if run in parallel processes)."""
     opt = ctx.opt
+    log(f"Generating graphs for output {output}...")
     if opt.batch_restarts and opt.iterations > 1:
         from .batched import generate_graph_one_output_batched
 
@@ -159,7 +160,9 @@ def generate_graph(
                 for start in start_states:
                     for output in range(num_outputs):
                         if start.outputs[output] != NO_GATE:
+                            log(f"Skipping output {output}.")
                             continue
+                        log(f"Generating circuit for output {output}...")
                         nst = start.copy()
                         if opt.metric == GATES:
                             nst.max_gates = max_gates

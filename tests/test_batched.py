@@ -97,10 +97,15 @@ def test_batched_error_propagates(monkeypatch):
     import pytest
 
     from sboxgates_tpu.ops import sweeps as sw
+    from sboxgates_tpu.search import batched
 
     def boom(*a, **k):
         raise RuntimeError("kernel boom")
 
     monkeypatch.setattr(sw, "gate_step_stream", boom)
+    # The process-wide vmap-wrapper cache maps submission keys to real
+    # kernels; without a fresh cache a wrapper from an earlier test would
+    # bypass the monkeypatched kernel.
+    monkeypatch.setattr(batched, "_VMAP_CACHE", {})
     with pytest.raises(RuntimeError, match="kernel boom"):
         _run(os.path.join(DATA, "crypto1_fa.txt"), 3)

@@ -168,6 +168,42 @@ def test_xml_validation_errors():
         )
 
 
+def test_xsd_contract():
+    """Emitted XML must validate against the shipped gates.xsd schema, and
+    the schema must reject contract violations (the formal interop
+    contract; reference counterpart: gates.xsd)."""
+    from sboxgates_tpu.graph.xmlio import validate_xml
+
+    # Well-formed gate and LUT states validate.
+    validate_xml(state_to_xml(build_simple_state()))
+    st = State.init_inputs(3)
+    lut = st.add_lut(0xAC, 0, 1, 2)
+    st.outputs[0] = lut
+    validate_xml(state_to_xml(st))
+
+    # Schema-level violations are rejected.
+    bad_docs = [
+        # unknown gate type
+        '<gates><output bit="0" gate="0" /><gate type="MAYBE" /></gates>',
+        # output bit out of range
+        '<gates><output bit="8" gate="0" /><gate type="IN" /></gates>',
+        # gate id beyond MAX_GATES
+        '<gates><output bit="0" gate="500" /><gate type="IN" /></gates>',
+        # four inputs on one gate
+        '<gates><output bit="0" gate="0" /><gate type="LUT" function="ac">'
+        '<input gate="0" /><input gate="0" /><input gate="0" />'
+        '<input gate="0" /></gate></gates>',
+        # function attribute not two hex digits
+        '<gates><output bit="0" gate="0" /><gate type="LUT" function="xyz">'
+        "</gate></gates>",
+        # no outputs at all
+        '<gates><gate type="IN" /></gates>',
+    ]
+    for doc in bad_docs:
+        with pytest.raises(StateLoadError):
+            validate_xml(doc)
+
+
 def test_fingerprint_stability_and_sensitivity():
     st = build_simple_state()
     fp1 = state_fingerprint(st)
