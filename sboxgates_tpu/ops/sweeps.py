@@ -285,30 +285,9 @@ def _unpack_words_to_bits(words):
     return b.reshape(*words.shape[:-1], words.shape[-1] * 32)
 
 
-@jax.jit
-def lut7_solve(req1p, req0p, idx_tab, pp_tab, seed):
-    """7-LUT stage B as pair-agreement matmuls (the MXU path).
-
-    A decomposition (ordering σ, outer fo, middle fm) fails iff some
-    required-1 cell and some required-0 cell land in the same inner-LUT
-    input group — i.e. fo agrees on their outer patterns, fm agrees on
-    their middle patterns, and their free bits are equal.  Counting such
-    conflicting pairs is a bilinear form
-
-        C[t, fo, fm] = PP[fo] · B[t] · PP[fm]ᵀ
-
-    where B[t, (p1,p0), (q1,q0)] counts same-free-bit (R1-cell, R0-cell)
-    pairs by outer-pattern pair and middle-pattern pair, and
-    PP[f, p1*8+p0] = 1 iff bits p1,p0 of f agree.  This replaces an
-    8-way polarity loop over [T,256,256,4] mask intermediates (HBM-bound)
-    with three small matmuls per ordering (reference inner loops:
-    lut.c:416-475).  All products are exact: B ≤ 2 and PP·B ≤ 128 fit
-    bfloat16 integers; C ≤ 8192 accumulates in float32.
-
-    req1p/req0p: [T, 4] uint32 (128 cells packed); idx_tab/pp_tab from
-    :func:`lut7_pair_tables`.  Returns packed int32[4]
-    [found, best_t, sigma, fo*256+fm].
-    """
+def _lut7_solve_core(req1p, req0p, idx_tab, pp_tab, seed):
+    """Core of the pair-agreement 7-LUT solver (see :func:`lut7_solve`).
+    Returns (found bool, best_t, sigma, fo*256+fm)."""
     num_t = req1p.shape[0]
     bits1 = _unpack_words_to_bits(req1p)  # [T, 128]
     bits0 = _unpack_words_to_bits(req0p)
@@ -361,14 +340,37 @@ def lut7_solve(req1p, req0p, idx_tab, pp_tab, seed):
     )
     prio = jnp.where(found, _priority(num_t, seed), 0)
     best_t = jnp.argmax(prio).astype(jnp.int32)
-    return jnp.stack(
-        [
-            found.any().astype(jnp.int32),
-            best_t,
-            sel_sigma[best_t],
-            sel_flat[best_t],
-        ]
+    return found.any(), best_t, sel_sigma[best_t], sel_flat[best_t]
+
+
+@jax.jit
+def lut7_solve(req1p, req0p, idx_tab, pp_tab, seed):
+    """7-LUT stage B as pair-agreement matmuls (the MXU path).
+
+    A decomposition (ordering σ, outer fo, middle fm) fails iff some
+    required-1 cell and some required-0 cell land in the same inner-LUT
+    input group — i.e. fo agrees on their outer patterns, fm agrees on
+    their middle patterns, and their free bits are equal.  Counting such
+    conflicting pairs is a bilinear form
+
+        C[t, fo, fm] = PP[fo] · B[t] · PP[fm]ᵀ
+
+    where B[t, (p1,p0), (q1,q0)] counts same-free-bit (R1-cell, R0-cell)
+    pairs by outer-pattern pair and middle-pattern pair, and
+    PP[f, p1*8+p0] = 1 iff bits p1,p0 of f agree.  This replaces an
+    8-way polarity loop over [T,256,256,4] mask intermediates (HBM-bound)
+    with three small matmuls per ordering (reference inner loops:
+    lut.c:416-475).  All products are exact: B ≤ 2 and PP·B ≤ 128 fit
+    bfloat16 integers; C ≤ 8192 accumulates in float32.
+
+    req1p/req0p: [T, 4] uint32 (128 cells packed); idx_tab/pp_tab from
+    :func:`lut7_pair_tables`.  Returns packed int32[4]
+    [found, best_t, sigma, fo*256+fm].
+    """
+    found, best_t, sigma, flat = _lut7_solve_core(
+        req1p, req0p, idx_tab, pp_tab, seed
     )
+    return jnp.stack([found.astype(jnp.int32), best_t, sigma, flat])
 
 
 # -------------------------------------------------------------------------
@@ -1039,7 +1041,10 @@ def lut_step_stream(
     costs up to four device round trips per recursion node — the dominant
     cost on hardware behind a network link (measured ~73 ms RTT vs. <5 ms
     of kernel time at DES-S1 state sizes).  Later sweeps execute under
-    lax.cond only when earlier ones miss.
+    lax.cond only when earlier ones miss.  The (rare) 7-LUT phase is a
+    separate dispatch (:func:`lut7_step_stream`) — fusing it here would
+    tax every vmapped head dispatch with the 70-ordering solve, since
+    vmapped lax.cond executes both branches.
 
     ``excl`` (mux-used input bits) applies only to the 5-LUT stream — the
     reference's 3-LUT phase scans all triples (lut.c:501-523) while
@@ -1118,6 +1123,61 @@ def lut_step_stream(
         return jax.lax.cond(pf, pair_hit, try_lut3, None)
 
     return jax.lax.cond(direct | neq.any(), scan_hit, try_pair, None)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk7", "solve7"))
+def lut7_step_stream(
+    tables, binom, g, target, mask, excl, total7, idx_tab, pp_tab, seed,
+    *, chunk7, solve7=256
+):
+    """Whole single-chunk 7-LUT search in ONE dispatch: stage-A
+    feasibility filter over C(g,7) (one chunk) + pair-matmul stage-B solve
+    of the top-``solve7`` hits (reference: search_7lut, lut.c:256-487).
+    Only applicable when C(g,7) <= chunk7; larger spaces run the host's
+    staged path.
+
+    Returns packed int32[14]:
+    [status, rank, sigma, fo*256+fm, ex7, solved, r7_1[4], r7_0[4]] with
+    status 0 = no decomposition, 1 = found, 2 = more than ``solve7``
+    feasible tuples and none of the solved subset decomposed (the host
+    re-runs the staged path).  ``solved`` counts the stage-B tuples
+    examined.
+    """
+    z = jnp.int32(0)
+    zw = jnp.zeros(4, jnp.int32)
+    ranks = jnp.arange(chunk7, dtype=jnp.int32)
+    feasible, r1, r0 = _stream_chunk_constraints(
+        tables, binom, g, 7, target, mask, excl, ranks, total7
+    )
+    ex7 = jnp.minimum(total7, chunk7)
+
+    def pack(status, rank=z, sigma=z, flat=z, solved=z, r7_1=zw, r7_0=zw):
+        head = jnp.stack(
+            [jnp.asarray(status, jnp.int32), rank, sigma, flat, ex7, solved]
+        )
+        return jnp.concatenate([head, r7_1, r7_0])
+
+    def solve_fn(_):
+        nfeas = feasible.sum(dtype=jnp.int32)
+        prio = jnp.where(feasible, _priority(chunk7, seed ^ 0x77A1), 0)
+        topv, topi = jax.lax.top_k(prio, solve7)
+        fsel = topv > 0
+        full = jnp.uint32(0xFFFFFFFF)
+        sr1 = jnp.where(fsel[:, None], r1[topi], full)
+        sr0 = jnp.where(fsel[:, None], r0[topi], full)
+        found, best_t, sigma, flat = _lut7_solve_core(
+            sr1, sr0, idx_tab, pp_tab, seed ^ 0x77A1
+        )
+        overflow = (nfeas > solve7) & ~found
+        status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
+        return pack(
+            status, ranks[topi[best_t]], sigma, flat,
+            solved=jnp.minimum(nfeas, solve7),
+            r7_1=_bitcast_i32(sr1[best_t]),
+            r7_0=_bitcast_i32(sr0[best_t]),
+        )
+
+    return jax.lax.cond(feasible.any(), solve_fn, lambda _: pack(0), None)
 
 
 # -------------------------------------------------------------------------

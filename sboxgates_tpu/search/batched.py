@@ -15,11 +15,11 @@ Semantics: restarts are *independent* (each has its own PRNG stream and the
 full initial budget); unlike the serial loop, a restart's budget is not
 ratcheted by another's success — the same semantics as the reference run
 R times in parallel processes.  Kinds that rendezvous are the fixed-shape
-per-node head kernels — gate mode's gate_step_stream and LUT mode's
-lut_step_stream — grouped by their full shape key (bucket, chunk sizes,
-has5), so only same-shaped nodes stack; the remaining variable-shape LUT
-paths (pivot sweeps, 7-LUT stages, overflow re-drives) execute per-thread
-without waiting.
+per-node kernels — gate mode's gate_step_stream, LUT mode's
+lut_step_stream, and the single-chunk lut7_step_stream — grouped by their
+full shape key (bucket, chunk sizes, has5), so only same-shaped nodes
+stack; the remaining variable-shape LUT paths (pivot sweeps, staged 7-LUT
+collection, overflow re-drives) execute per-thread without waiting.
 
 Cost model caveat: under ``jax.vmap`` the fused head kernels'
 ``lax.cond`` early-exit chains execute BOTH branches and select, so a

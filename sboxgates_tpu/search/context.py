@@ -60,6 +60,12 @@ def lut_head_has5(g: int) -> bool:
     return 5 <= g and comb.n_choose_k(g, 5) < PIVOT_MIN_TOTAL
 
 
+def lut_head_has7(g: int) -> bool:
+    """True when the fused LUT head dispatch includes the 7-LUT search
+    (single-chunk spaces; larger ones run the host's staged path)."""
+    return 7 <= g and comb.n_choose_k(g, 7) <= STREAM_CHUNK[7]
+
+
 @dataclass
 class Options:
     """User configuration (reference: options struct + defaults,
@@ -202,6 +208,7 @@ class SearchContext:
         self._pair_combo_cache = {}
         self._binom = None
         self._lut5_tabs = None
+        self._lut7_tabs = None
         # Per-phase wall-clock timers (SURVEY §5: the reference has none;
         # report via ``prof.report(stats)`` or the CLI's -vv summary).
         self.prof = PhaseProfiler()
@@ -475,6 +482,47 @@ class SearchContext:
             self.stats["pair_candidates"] += g * (g - 1) // 2
         self.stats["lut3_candidates"] += int(v[6])
         self.stats["lut5_candidates"] += int(v[7])
+        return v
+
+    def lut7_step(self, st: State, target, mask, inbits) -> np.ndarray:
+        """Whole single-chunk 7-LUT search as ONE dispatch
+        (sweeps.lut7_step_stream); only valid when ``lut_head_has7(g)``.
+        Returns the packed int32[14] verdict."""
+        g = st.num_gates
+        total7 = comb.n_choose_k(g, 7)
+        chunk7 = pick_chunk(max(total7, 1), STREAM_CHUNK[7])
+        tables, _ = self.device_tables(st)
+        if self._lut7_tabs is None:
+            idx_tab, pp_tab = sweeps.lut7_pair_tables()
+            self._lut7_tabs = (
+                self.place_replicated(idx_tab),
+                self.place_replicated(pp_tab),
+            )
+        jidx, jpp = self._lut7_tabs
+        with self.prof.phase("lut7_step"):
+            v = self._dispatch(
+                ("l7step", tables.shape[0], chunk7),
+                functools.partial(
+                    sweeps.lut7_step_stream, chunk7=chunk7
+                ),
+                (
+                    tables,
+                    self.binom,
+                    g,
+                    self.place_replicated(np.asarray(target)),
+                    self.place_replicated(np.asarray(mask)),
+                    self.place_replicated(self.excl_array(inbits)),
+                    total7,
+                    jidx,
+                    jpp,
+                    self.next_seed(),
+                ),
+                # identical across restarts under one key: binomial table
+                # and the 7-LUT pair tables
+                shared=(1, 7, 8),
+            )
+        self.stats["lut7_candidates"] += int(v[4])
+        self.stats["lut7_solved"] += int(v[5])
         return v
 
     def decode_pair_hit(self, st: State, index: int, slot: int, use_not: bool):

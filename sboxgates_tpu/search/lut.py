@@ -38,6 +38,7 @@ from .context import (
     STREAM_CHUNK,
     SearchContext,
     lut_head_has5,
+    lut_head_has7,
     pick_chunk,
 )
 
@@ -675,7 +676,6 @@ def _lut7_solve_hits(
 ) -> Optional[dict]:
     """Stage B: sweep (ordering x outer x middle) function space over the
     collected hit list (reference: lut.c:416-475)."""
-    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
     idx_tab, pp_tab = sweeps.lut7_pair_tables()
     jidx = ctx.place_replicated(idx_tab)
     jpp = ctx.place_replicated(pp_tab)
@@ -700,32 +700,42 @@ def _lut7_solve_hits(
         t = lo + int(v[1])
         sigma = int(v[2])
         func_outer, func_middle = divmod(int(v[3]), 256)
-        combo = combos[t]
-        order = orders[sigma]
-        a, b, c, d, e, f = (int(combo[p]) for p in order[:6])
-        gg = int(combo[order[6]])
-        # Inner function: group 128 cells by (outer out, middle out, x_g).
-        req1_cells = _unpack128(req1[t])
-        req0_cells = _unpack128(req0[t])
-        wobits = _unpack128(wo_tab[sigma, func_outer])
-        wmbits = _unpack128(wm_tab[sigma, func_middle])
-        gbits = _unpack128(g_tab[sigma])
-        groups = (
-            wobits.astype(np.int64) * 4
-            + wmbits.astype(np.int64) * 2
-            + gbits.astype(np.int64)
+        return _decode_lut7(
+            ctx, combos[t], sigma, func_outer, func_middle, req1[t], req0[t]
         )
-        func_inner = sweeps.solve_inner_function(
-            req1_cells, req0_cells, groups, ctx.rng if ctx.opt.randomize else None
-        )
-        assert func_inner is not None, "device reported spurious 7-LUT hit"
-        return {
-            "func_outer": func_outer,
-            "func_middle": func_middle,
-            "func_inner": func_inner,
-            "gates": (a, b, c, d, e, f, gg),
-        }
     return None
+
+
+def _decode_lut7(
+    ctx: SearchContext, combo, sigma: int, func_outer: int, func_middle: int,
+    req1w: np.ndarray, req0w: np.ndarray,
+) -> dict:
+    """Reconstructs the inner LUT function for a device-selected 7-LUT
+    decomposition: group the 128 cells by (outer out, middle out, x_g)."""
+    orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+    order = orders[sigma]
+    a, b, c, d, e, f = (int(combo[p]) for p in order[:6])
+    gg = int(combo[order[6]])
+    req1_cells = _unpack128(req1w)
+    req0_cells = _unpack128(req0w)
+    wobits = _unpack128(wo_tab[sigma, func_outer])
+    wmbits = _unpack128(wm_tab[sigma, func_middle])
+    gbits = _unpack128(g_tab[sigma])
+    groups = (
+        wobits.astype(np.int64) * 4
+        + wmbits.astype(np.int64) * 2
+        + gbits.astype(np.int64)
+    )
+    func_inner = sweeps.solve_inner_function(
+        req1_cells, req0_cells, groups, ctx.rng if ctx.opt.randomize else None
+    )
+    assert func_inner is not None, "device reported spurious 7-LUT hit"
+    return {
+        "func_outer": func_outer,
+        "func_middle": func_middle,
+        "func_inner": func_inner,
+        "gates": (a, b, c, d, e, f, gg),
+    }
 
 
 # -------------------------------------------------------------------------
@@ -757,16 +767,9 @@ def _add_lut5_result(ctx: SearchContext, st: State, res: dict, target, mask) -> 
     return gid
 
 
-def _lut7_phase(ctx: SearchContext, st: State, target, mask, inbits) -> int:
-    """Budget-gated 7-LUT phase: three new gates on success (reference:
-    lut.c:582-625)."""
-    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
-        return NO_GATE
-
-    with ctx.prof.phase("lut7"):
-        res = lut7_search(ctx, st, target, mask, inbits)
-    if res is None:
-        return NO_GATE
+def _add_lut7_result(ctx: SearchContext, st: State, res: dict, target, mask) -> int:
+    """Materializes a 7-LUT decomposition as three LUT gates (reference:
+    lut.c:593-624)."""
     a, b, c, d, e, f, gg = res["gates"]
     outer = st.add_lut(res["func_outer"], a, b, c)
     middle = st.add_lut(res["func_middle"], d, e, f)
@@ -785,6 +788,19 @@ def _lut7_phase(ctx: SearchContext, st: State, target, mask, inbits) -> int:
             )
         )
     return gid
+
+
+def _lut7_phase(ctx: SearchContext, st: State, target, mask, inbits) -> int:
+    """Budget-gated 7-LUT phase: three new gates on success (reference:
+    lut.c:582-625)."""
+    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
+        return NO_GATE
+
+    with ctx.prof.phase("lut7"):
+        res = lut7_search(ctx, st, target, mask, inbits)
+    if res is None:
+        return NO_GATE
+    return _add_lut7_result(ctx, st, res, target, mask)
 
 
 def lut_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
@@ -869,4 +885,31 @@ def lut_search_from_head(
     if res is not None:
         return _add_lut5_result(ctx, st, res, target, mask)
 
-    return _lut7_phase(ctx, st, target, mask, inbits)
+    if not lut_head_has7(g):
+        return _lut7_phase(ctx, st, target, mask, inbits)
+
+    # Single-chunk 7-LUT space: one fused dispatch (stage A + stage B),
+    # rendezvous-batched across concurrent branches like the head.
+    if not check_num_gates_possible(st, 3, 0, ctx.opt.metric):
+        return NO_GATE
+    v = ctx.lut7_step(st, target, mask, inbits)
+    status = int(v[0])
+    if status == 1:
+        combo = comb.unrank_combination(int(v[1]), g, 7)
+        fo, fm = divmod(int(v[3]), 256)
+        r7_1 = (np.asarray(v[6:10]).astype(np.int64) & 0xFFFFFFFF).astype(
+            np.uint32
+        )
+        r7_0 = (np.asarray(v[10:14]).astype(np.int64) & 0xFFFFFFFF).astype(
+            np.uint32
+        )
+        res7 = _decode_lut7(ctx, combo, int(v[2]), fo, fm, r7_1, r7_0)
+        return _add_lut7_result(ctx, st, res7, target, mask)
+    if status == 2:
+        # In-kernel solver overflow: re-run the staged path (collects the
+        # full hit list and sweeps it in LUT7_SOLVE_CHUNK blocks).  The
+        # staged path re-counts the same candidate space; back out the
+        # fused dispatch's tally so stats stay exact.
+        ctx.stats["lut7_candidates"] -= int(v[4])
+        return _lut7_phase(ctx, st, target, mask, inbits)
+    return NO_GATE

@@ -161,6 +161,28 @@ def test_non_randomized_runs_are_identical():
     assert run() == run()
 
 
+def test_planted_7lut_found_via_search():
+    """A target planted as LUT(LUT(a,b,c), LUT(d,e,f), g) over the 8 input
+    gates must be solved by the LUT search in at most 3 added gates —
+    exercising the 7-LUT phase (fused single-chunk path at this size)
+    through the real create_circuit driver."""
+    from sboxgates_tpu.core import ttable as tt
+
+    st = State.init_inputs(8)
+    outer = tt.eval_lut(0x1D, st.table(0), st.table(1), st.table(2))
+    middle = tt.eval_lut(0xB2, st.table(3), st.table(4), st.table(5))
+    target = tt.eval_lut(0x6A, outer, middle, st.table(6))
+    mask = tt.mask_table(8)
+    ctx = SearchContext(Options(seed=11, lut_graph=True))
+    from sboxgates_tpu.search import create_circuit
+
+    out = create_circuit(ctx, st, target, mask, [])
+    assert out != NO_GATE
+    assert bool(tt.eq_mask(st.table(out), target, mask))
+    assert st.num_gates - st.num_inputs <= 3
+    assert ctx.stats["lut7_candidates"] > 0  # the 7-LUT phase actually ran
+
+
 @pytest.mark.slow
 def test_full_graph_linear_sbox():
     """Full multi-output beam search on the 8x8 linear sanity box."""
