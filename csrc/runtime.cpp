@@ -20,6 +20,16 @@
 //                            the measured CPU-baseline for candidates/sec
 //                            comparisons (the reference binary itself needs
 //                            MPI + libxml2, unavailable in this image).
+//  - sbg_gate_step:          fused gate-mode search node (steps 1-4,
+//                            sboxgates.c:301-435) for SMALL states, where a
+//                            device dispatch is pure overhead: the whole
+//                            candidate space fits in microseconds of host
+//                            work while one accelerator round trip costs
+//                            tens of milliseconds.  Bit-identical selection
+//                            semantics to the jitted kernel
+//                            (ops/sweeps.py:gate_step_stream) — same hashed
+//                            priorities, same chunk order — so routing a
+//                            node host-side never changes the search result.
 //
 // Build: see csrc/Makefile (g++ -O3 -march=native -shared -fPIC).
 
@@ -295,6 +305,192 @@ int64_t sbg_lut5_search_cpu(const uint64_t* tables, int32_t g,
     }
   }
   return -1;
+}
+
+// ---------------------------------------------------------------------
+// Fused gate-mode node step (native counterpart of sweeps.gate_step_stream
+// for small states)
+// ---------------------------------------------------------------------
+
+namespace {
+
+// Exact replica of sweeps._priority's hash (uint32 xorshift-multiply mix,
+// never zero) so native and device paths select identical candidates.
+inline uint32_t hash_prio(uint32_t i, uint32_t seed) {
+  uint32_t x = i + seed;
+  x = (x ^ (x >> 16)) * 0x7FEB352Du;
+  x = (x ^ (x >> 15)) * 0x846CA68Bu;
+  x = x ^ (x >> 16);
+  return x | 1u;
+}
+
+inline bool tt_eq_mask(const TT& a, const TT& b, const TT& m) {
+  return !tt_any(tt_and(tt_xor(a, b), m));
+}
+
+// Per-tuple cell constraints: bit c of req1/req0 set when cell c contains
+// a required-1 / required-0 position.  Cell index bit (k-1-i) is input i's
+// value (input 0 on the MSB) — the sweeps._cell_constraints convention.
+inline void cell_constraints(const TT* tabs, int k, const TT& need1,
+                             const TT& need0, uint32_t* req1,
+                             uint32_t* req0) {
+  const int cells = 1 << k;
+  uint32_t r1 = 0, r0 = 0;
+  for (int c = 0; c < cells; c++) {
+    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+    for (int i = 0; i < k; i++) {
+      const TT& t = tabs[i];
+      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
+    }
+    if (tt_any(tt_and(m, need1))) r1 |= 1u << c;
+    if (tt_any(tt_and(m, need0))) r0 |= 1u << c;
+  }
+  *req1 = r1;
+  *req0 = r0;
+}
+
+}  // namespace
+
+// One gate-mode search node: steps 1-4 of create_circuit
+// (sboxgates.c:301-435) over the full candidate space, encoded exactly as
+// the jitted kernel's verdict (ops/sweeps.py:gate_step_stream):
+//
+//   out4 = [step, x0, x1, examined3]
+//     step 1: existing gate matches        (x0 = gate id)
+//     step 2: complement of existing gate  (x0 = gate id)
+//     step 3: pair x available function    (x0 = pair index over the
+//             `bucket`-row triangular grid, x1 = match-table slot)
+//     step 4: pair x NOT-augmented function (same payload, not_table)
+//     step 5: triple x 3-input function    (x0 = lexicographic rank,
+//             x1 = slot); examined3 = ranks swept (stats)
+//     step 0: nothing found
+//
+// pair_table/not_table: int16[256] match tables keyed req1 | (req1|req0)<<4;
+// triple_table: int16[65536] keyed req1 | (req1|req0)<<8 (NULL = stage off).
+// seed < 0 selects deterministically (scan order; newest-first for steps
+// 1-2), otherwise by the kernel's hashed priorities — bit-identical either
+// way.
+void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
+                   const uint64_t* target, const uint64_t* mask,
+                   const int16_t* pair_table, const int16_t* not_table,
+                   const int16_t* triple_table, int64_t total3,
+                   int32_t chunk3, int32_t seed, int32_t* out4) {
+  const TT* T = reinterpret_cast<const TT*>(tables);
+  TT tgt, msk;
+  std::memcpy(tgt.w, target, sizeof(TT));
+  std::memcpy(msk.w, mask, sizeof(TT));
+  const TT need1 = tt_and(msk, tgt);
+  const TT need0 = tt_and(msk, tt_not(tgt));
+  out4[0] = out4[1] = out4[2] = out4[3] = 0;
+
+  // Steps 1-2: existing gate or its complement (priority ascends with the
+  // index when deterministic — the reference's newest-first scan order,
+  // sboxgates.c:285-299).
+  {
+    uint32_t bestd = 0, besti = 0;
+    int32_t dbest = 0, ibest = 0;
+    bool anyd = false, anyi = false;
+    for (int32_t i = 0; i < g; i++) {
+      uint32_t prio = seed < 0 ? (uint32_t)(i + 1)
+                               : hash_prio((uint32_t)i, (uint32_t)seed);
+      if (tt_eq_mask(T[i], tgt, msk) && prio > bestd) {
+        bestd = prio; dbest = i; anyd = true;
+      }
+      if (tt_eq_mask(tt_not(T[i]), tgt, msk) && prio > besti) {
+        besti = prio; ibest = i; anyi = true;
+      }
+    }
+    if (anyd) { out4[0] = 1; out4[1] = dbest; return; }
+    if (anyi) { out4[0] = 2; out4[1] = ibest; return; }
+  }
+
+  // Steps 3 / 4a: one function over all gate pairs, via the 4-cell
+  // constraint key and a match table (sboxgates.c:323-350, 366-386).
+  // Pair index n runs over the bucket-row upper-triangular grid in
+  // np.triu_indices order — the index the host decodes with.
+  auto pair_stage = [&](const int16_t* mt, uint32_t sx,
+                        int32_t step_code) -> bool {
+    if (mt == nullptr) return false;
+    const int32_t s = (int32_t)(seed ^ (int32_t)sx);
+    const int64_t N = (int64_t)bucket * (bucket - 1) / 2;
+    uint32_t best = 0;
+    int64_t bi = -1;
+    int32_t bslot = 0;
+    int64_t n = 0;
+    for (int32_t i = 0; i < bucket - 1; i++) {
+      for (int32_t j = i + 1; j < bucket; j++, n++) {
+        if (j >= g) continue;  // i < j, so j < g implies both valid
+        TT tabs[2] = {T[i], T[j]};
+        uint32_t r1, r0;
+        cell_constraints(tabs, 2, need1, need0, &r1, &r0);
+        if (r1 & r0) continue;
+        int16_t slot = mt[r1 | ((r1 | r0) << 4)];
+        if (slot < 0) continue;
+        uint32_t prio = s < 0 ? (uint32_t)(N - n)
+                              : hash_prio((uint32_t)n, (uint32_t)s);
+        if (prio > best) { best = prio; bi = n; bslot = slot; }
+      }
+    }
+    if (bi < 0) return false;
+    out4[0] = step_code;
+    out4[1] = (int32_t)bi;
+    out4[2] = bslot;
+    return true;
+  };
+  if (pair_stage(pair_table, 0x3D4Au, 3)) return;
+  if (pair_stage(not_table, 0x11C9u, 4)) return;
+
+  // Step 4b: gate triples x 3-input functions (sboxgates.c:392-435),
+  // streamed in chunk3-rank chunks with the kernel's per-chunk seeds and
+  // first-matching-chunk early exit (sweeps._match_stream_core semantics).
+  if (triple_table != nullptr && total3 > 0) {
+    const int32_t s3 = (int32_t)(seed ^ 0x7777);
+    int32_t combo[3] = {0, 1, 2};
+    int64_t rank = 0;
+    while (rank < total3) {
+      const int64_t cstart = rank;
+      int64_t cend = cstart + chunk3;
+      if (cend > total3) cend = total3;
+      const int32_t sc = (int32_t)(s3 ^ (int32_t)cstart);
+      uint32_t best = 0;
+      int64_t babs = -1;
+      int32_t bslot = 0;
+      for (; rank < cend; rank++) {
+        TT tabs[3] = {T[combo[0]], T[combo[1]], T[combo[2]]};
+        uint32_t r1, r0;
+        cell_constraints(tabs, 3, need1, need0, &r1, &r0);
+        if (!(r1 & r0)) {
+          int16_t slot = triple_table[r1 | ((r1 | r0) << 8)];
+          if (slot >= 0) {
+            uint32_t row = (uint32_t)(rank - cstart);
+            uint32_t prio = sc < 0 ? (uint32_t)((uint32_t)chunk3 - row)
+                                   : hash_prio(row, (uint32_t)sc);
+            if (prio > best) { best = prio; babs = rank; bslot = slot; }
+          }
+        }
+        // lexicographic successor
+        if (combo[2] + 1 < g) {
+          combo[2]++;
+        } else if (combo[1] + 2 < g) {
+          combo[1]++;
+          combo[2] = combo[1] + 1;
+        } else {
+          combo[0]++;
+          combo[1] = combo[0] + 1;
+          combo[2] = combo[1] + 1;
+        }
+      }
+      // examined = min(chunk end, total) - 0, as the kernel reports it
+      int64_t nxt_after = cstart + chunk3;
+      out4[3] = (int32_t)(nxt_after < total3 ? nxt_after : total3);
+      if (babs >= 0) {
+        out4[0] = 5;
+        out4[1] = (int32_t)babs;
+        out4[2] = bslot;
+        return;
+      }
+    }
+  }
 }
 
 }  // extern "C"

@@ -13,6 +13,8 @@ Exposed surface (mirrors the C ABI):
 - :func:`execute_circuit`      — bitslice interpreter for a gate program
 - :func:`lut5_search_cpu`      — reference-shaped CPU 5-LUT search
   (the measured baseline for bench.py)
+- :func:`gate_step`            — fused gate-mode search node (steps 1-4)
+  for small states, bit-identical to the jitted kernel's selection
 """
 
 from __future__ import annotations
@@ -129,6 +131,22 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.sbg_lut5_search_cpu.restype = ctypes.c_int64
 
+        lib.sbg_gate_step.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_gate_step.restype = None
+
         _lib = lib
         return lib
 
@@ -244,6 +262,63 @@ def lut5_search_cpu(
         "func_inner": int(res[1]),
         "gates": tuple(int(x) for x in res[2:7]),
     }
+
+
+def gate_step(
+    tables64: np.ndarray,
+    g: int,
+    bucket: int,
+    target64: np.ndarray,
+    mask64: np.ndarray,
+    pair_table: np.ndarray,
+    not_table: Optional[np.ndarray],
+    triple_table: Optional[np.ndarray],
+    total3: int,
+    chunk3: int,
+    seed: int,
+) -> np.ndarray:
+    """One fused gate-mode search node (steps 1-4) on the host.
+
+    Same int32[4] verdict encoding and bit-identical candidate selection
+    as ``sweeps.gate_step_stream`` — see the C entry point's docs.  Match
+    tables are int16 arrays from ``SearchContext`` (None disables the
+    NOT-pair / triple stages)."""
+    lib = _require()
+    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
+    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
+    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
+    pair_table = np.ascontiguousarray(pair_table, dtype=np.int16)
+    # Hold materialized copies in locals so the buffers outlive the call.
+    not_table = (
+        None if not_table is None
+        else np.ascontiguousarray(not_table, dtype=np.int16)
+    )
+    triple_table = (
+        None if triple_table is None
+        else np.ascontiguousarray(triple_table, dtype=np.int16)
+    )
+
+    def tab_ptr(t):
+        if t is None:
+            return ctypes.POINTER(ctypes.c_int16)()
+        return _ptr(t, ctypes.c_int16)
+
+    out = np.zeros(4, dtype=np.int32)
+    lib.sbg_gate_step(
+        _ptr(tables64, ctypes.c_uint64),
+        g,
+        bucket,
+        _ptr(target64, ctypes.c_uint64),
+        _ptr(mask64, ctypes.c_uint64),
+        _ptr(pair_table, ctypes.c_int16),
+        tab_ptr(not_table),
+        tab_ptr(triple_table),
+        total3,
+        chunk3,
+        seed,
+        _ptr(out, ctypes.c_int32),
+    )
+    return out
 
 
 def tables32_to_64(tables32: np.ndarray) -> np.ndarray:

@@ -53,6 +53,13 @@ STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 15}
 # 5-LUT sweep.
 PIVOT_MIN_TOTAL = 1 << 21
 
+# Gate-mode nodes at or below this many gates run on the host via the
+# native runtime (Options.host_small_steps): the full steps-1-4 space
+# (C(64,2) pairs, C(64,3)=41664 triples) is well under a millisecond of
+# native work — cheaper than any dispatch.  Also the first BUCKETS entry:
+# the native pair index is decoded against the same 64-row grid.
+NATIVE_STEP_MAX_G = 64
+
 
 def lut_head_has5(g: int) -> bool:
     """True when the fused LUT head dispatch includes the 5-LUT stream
@@ -91,6 +98,15 @@ class Options:
     # dispatch latency, is the bottleneck and vmapped early-exit chains
     # execute both branches).
     parallel_mux: Optional[bool] = None
+    # Route gate-mode search nodes with <= NATIVE_STEP_MAX_G gates to the
+    # native host runtime (csrc sbg_gate_step) instead of a device
+    # dispatch.  At those sizes the full steps-1-4 space is microseconds
+    # of host work while one accelerator round trip costs tens of
+    # milliseconds (and a vmapped CPU dispatch pays the padded
+    # full-chain sweep); selection is bit-identical to the kernel, so
+    # results do not depend on the routing.  Disabled automatically when
+    # the native library is unavailable.
+    host_small_steps: bool = True
 
 
 @dataclass(frozen=True)
@@ -129,7 +145,7 @@ def _build_pair_table(funs: Sequence[bf.BoolFunc]):
                 entries.append(MatchEntry(f, perm))
                 bytes_.append(eff)
     table = sweeps.build_match_table(bytes_, num_cells=4)
-    return jnp.asarray(table), entries
+    return table, entries
 
 
 def _build_triple_table(funs: Sequence[bf.BoolFunc]):
@@ -158,7 +174,7 @@ def _build_triple_table(funs: Sequence[bf.BoolFunc]):
                 entries.append(MatchEntry(f, perm))
                 bytes_.append(eff)
     table = sweeps.build_match_table(bytes_, num_cells=8)
-    return jnp.asarray(table), entries
+    return table, entries
 
 
 def bucket_size(n: int) -> int:
@@ -199,16 +215,22 @@ class SearchContext:
             bf.get_not_functions(self.avail_gates) if opt.try_nots else []
         )
         self.avail_3 = bf.get_3_input_function_list(self.avail_gates, opt.try_nots)
-        self.pair_table, self.pair_entries = _build_pair_table(self.avail_gates)
+        # Match tables are kept both as numpy (native host path) and on
+        # device (jitted kernels).
+        self.pair_table_np, self.pair_entries = _build_pair_table(self.avail_gates)
+        self.pair_table = jnp.asarray(self.pair_table_np)
         if self.avail_not:
-            self.not_table, self.not_entries = _build_pair_table(self.avail_not)
+            self.not_table_np, self.not_entries = _build_pair_table(self.avail_not)
+            self.not_table = jnp.asarray(self.not_table_np)
         else:
-            self.not_table, self.not_entries = None, []
-        self.triple_table, self.triple_entries = _build_triple_table(self.avail_3)
+            self.not_table_np, self.not_table, self.not_entries = None, None, []
+        self.triple_table_np, self.triple_entries = _build_triple_table(self.avail_3)
+        self.triple_table = jnp.asarray(self.triple_table_np)
         self._pair_combo_cache = {}
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs = None
+        self._native_probe = None
         # Per-phase wall-clock timers (SURVEY §5: the reference has none;
         # report via ``prof.report(stats)`` or the CLI's -vv summary).
         self.prof = PhaseProfiler()
@@ -379,11 +401,73 @@ class SearchContext:
         jmask = self.place_replicated(np.asarray(mask))
         return tables, g, b, valid_g, combos, pair_valid, jtarget, jmask
 
+    def _native_ok(self) -> bool:
+        """Cached probe for the native host runtime."""
+        if self._native_probe is None:
+            try:
+                from .. import native
+
+                self._native_probe = native.available()
+            except Exception:
+                self._native_probe = False
+        return self._native_probe
+
+    def uses_native_step(self, st: State) -> bool:
+        """True when this state's node sweeps run on the host
+        (:meth:`_gate_step_native`) — also the signal for the mux recursion
+        to skip its concurrency threads: overlapping device round trips is
+        the threads' whole value, and native nodes have none (measured
+        ~1.4x slower with threads, pure GIL contention)."""
+        return (
+            self.opt.host_small_steps
+            and self.mesh_plan is None
+            and not self.opt.lut_graph
+            and st.num_gates <= NATIVE_STEP_MAX_G
+            and self._native_ok()
+        )
+
+    def _gate_step_native(self, st: State, target, mask):
+        """Host-native fused node step (csrc sbg_gate_step) — bit-identical
+        verdict to the device kernel, without the dispatch."""
+        from .. import native
+
+        g = st.num_gates
+        has_not = bool(self.not_entries) and not self.opt.lut_graph
+        has_triple = not self.opt.lut_graph and g >= 3
+        total3 = comb.n_choose_k(g, 3) if has_triple else 0
+        chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
+        with self.prof.phase("gate_step_native"):
+            v = native.gate_step(
+                native.tables32_to_64(st.live_tables()),
+                g,
+                bucket_size(g),
+                native.tables32_to_64(np.asarray(target)),
+                native.tables32_to_64(np.asarray(mask)),
+                self.pair_table_np,
+                self.not_table_np if has_not else None,
+                self.triple_table_np if has_triple else None,
+                total3,
+                chunk3,
+                self.next_seed(),
+            )
+        step = int(v[0])
+        if step == 0 or step >= 3:
+            self.stats["pair_candidates"] += g * (g - 1) // 2
+        if has_triple and step in (0, 5):
+            self.stats["triple_candidates"] += int(v[3])
+        return step, int(v[1]), int(v[2])
+
     def gate_step(self, st: State, target, mask):
         """Steps 1-4 of one gate-mode search node as ONE fused dispatch
         (sweeps.gate_step_stream).  Returns (step, x0, x1) — see the kernel
         docstring for the step encoding; use :meth:`decode_pair_hit` /
-        :meth:`decode_triple_hit` on the payload."""
+        :meth:`decode_triple_hit` on the payload.
+
+        Small states route to the native host runtime instead
+        (:meth:`uses_native_step`, Options.host_small_steps) — same
+        verdict, no dispatch."""
+        if self.uses_native_step(st):
+            return self._gate_step_native(st, target, mask)
         tables, g, b, valid_g, combos, pair_valid, jtarget, jmask = (
             self._node_operands(st, target, mask)
         )

@@ -129,7 +129,11 @@ def _create_circuit(
     if opt.randomize:
         ctx.rng.shuffle(bit_order)
 
-    if ctx.rdv is not None and len(bit_order) > 1:
+    if (
+        ctx.rdv is not None
+        and len(bit_order) > 1
+        and not ctx.uses_native_step(st)
+    ):
         from .batched import run_mux_jobs
 
         def job(bit):

@@ -908,8 +908,10 @@ def lut_search_from_head(
     if status == 2:
         # In-kernel solver overflow: re-run the staged path (collects the
         # full hit list and sweeps it in LUT7_SOLVE_CHUNK blocks).  The
-        # staged path re-counts the same candidate space; back out the
-        # fused dispatch's tally so stats stay exact.
+        # staged path re-counts the same candidate space AND re-solves the
+        # fused dispatch's tuples; back out both tallies so stats stay
+        # exact.
         ctx.stats["lut7_candidates"] -= int(v[4])
+        ctx.stats["lut7_solved"] -= int(v[5])
         return _lut7_phase(ctx, st, target, mask, inbits)
     return NO_GATE

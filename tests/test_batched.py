@@ -32,9 +32,10 @@ def _run(path, r, **kw):
 def test_batched_restarts_gate_mode():
     """R=4 gate-mode restarts: every returned circuit is valid, the batch
     actually batched (fewer dispatches than submits), and the best-last
-    ordering holds."""
+    ordering holds.  Forces the device-kernel path — natively-routed
+    small states never submit to the rendezvous."""
     ctx, results, sbox, n, targets = _run(
-        os.path.join(DATA, "crypto1_fa.txt"), 4
+        os.path.join(DATA, "crypto1_fa.txt"), 4, host_small_steps=False
     )
     assert results, "no restart found a circuit"
     mask = tt.mask_table(n)
@@ -80,7 +81,11 @@ def test_batched_full_graph_beam():
 
     sbox, n = load_sbox(os.path.join(DATA, "identity.txt"))
     targets = make_targets(sbox)
-    ctx = SearchContext(Options(seed=4, iterations=2, batch_restarts=True))
+    # device-kernel path forced: native-routed nodes don't submit
+    ctx = SearchContext(
+        Options(seed=4, iterations=2, batch_restarts=True,
+                host_small_steps=False)
+    )
     st = State.init_inputs(n)
     beam = generate_graph(ctx, st, targets, save_dir=None, log=lambda s: None)
     assert beam
@@ -108,4 +113,5 @@ def test_batched_error_propagates(monkeypatch):
     # bypass the monkeypatched kernel.
     monkeypatch.setattr(batched, "_VMAP_CACHE", {})
     with pytest.raises(RuntimeError, match="kernel boom"):
-        _run(os.path.join(DATA, "crypto1_fa.txt"), 3)
+        # device-kernel path forced so the patched kernel is reached
+        _run(os.path.join(DATA, "crypto1_fa.txt"), 3, host_small_steps=False)

@@ -128,11 +128,33 @@ def test_run_mux_jobs_inline_error_joins_children(monkeypatch):
 
 def test_parallel_mux_gate_mode_sat():
     """Gate-mode SAT search (the reference's .travis.yml:40 config shape)
-    under concurrency: valid circuit, sweeps actually batched."""
+    under concurrency: valid circuit, sweeps actually batched.  Forces the
+    device-kernel path (host_small_steps=False) — natively-routed nodes
+    deliberately bypass the rendezvous (SearchContext.uses_native_step)."""
+    ctx, best = _search(
+        os.path.join(DATA, "crypto1_fa.txt"), seed=5, metric=SAT,
+        try_nots=True, parallel_mux=True, host_small_steps=False,
+    )
+    assert best.sat_metric > 0
+    assert ctx.rdv.stats["dispatches"] <= ctx.rdv.stats["submits"]
+    assert ctx.rdv.stats["batched_rows"] > 0  # some sweeps merged
+
+
+def test_native_nodes_skip_mux_threads():
+    """Small gate-mode states route node sweeps to the native runtime and
+    must not submit anything to the rendezvous — the threads' only value
+    is overlapping device round trips, which native nodes don't make."""
+    import pytest
+
+    from sboxgates_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.build_error()}")
     ctx, best = _search(
         os.path.join(DATA, "crypto1_fa.txt"), seed=5, metric=SAT,
         try_nots=True, parallel_mux=True,
     )
     assert best.sat_metric > 0
-    assert ctx.rdv.stats["dispatches"] <= ctx.rdv.stats["submits"]
-    assert ctx.rdv.stats["batched_rows"] > 0  # some sweeps merged
+    assert ctx.uses_native_step(best)
+    assert ctx.rdv.stats["submits"] == 0
+    assert ctx.prof.calls.get("gate_step_native", 0) > 0

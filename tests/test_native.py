@@ -162,3 +162,113 @@ def test_lut5_search_cpu_no_false_positives():
         comb.CombinationStream(st.num_gates, 5).next_chunk(1 << 9),
     )
     assert idx == -1 and res is None
+
+
+# -- fused gate-mode node step (sbg_gate_step) ----------------------------
+
+
+def _step_contexts(seed, **opt_kwargs):
+    """(native-routed, device-routed) contexts with identical options and
+    PRNG streams."""
+    from sboxgates_tpu.search import Options, SearchContext
+
+    a = SearchContext(
+        Options(seed=seed, host_small_steps=True, **opt_kwargs)
+    )
+    b = SearchContext(
+        Options(seed=seed, host_small_steps=False, **opt_kwargs)
+    )
+    return a, b
+
+
+def _rand_gate_state(rng, num_inputs, extra):
+    st = State.init_inputs(num_inputs)
+    while st.num_gates < num_inputs + extra:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        if rng.integers(0, 4) == 0:
+            st.add_not_gate(int(a), GATES)
+        else:
+            st.add_gate(
+                int(rng.choice([bf.AND, bf.OR, bf.XOR])), int(a), int(b), GATES
+            )
+    return st
+
+
+@pytest.mark.parametrize("randomize", [False, True])
+@pytest.mark.parametrize("try_nots", [False, True])
+def test_gate_step_native_bitwise_matches_kernel(randomize, try_nots):
+    """The native node step must return the kernel's exact verdict — same
+    step, same selected candidate — in both selection modes, across states
+    that exercise every step (existing-gate hits, complements, pairs,
+    NOT-pairs, triples, and misses)."""
+    rng = np.random.default_rng(99)
+    steps_seen = set()
+    for case in range(24):
+        num_inputs = int(rng.integers(3, 7))
+        extra = int(rng.integers(0, 9))
+        st = _rand_gate_state(rng, num_inputs, extra)
+        mask = tt.mask_table(num_inputs)
+        kind = case % 4
+        if kind == 0:  # random target: usually a triple hit or a miss
+            target = np.asarray(
+                rng.integers(0, 2**32, size=8, dtype=np.uint32)
+            ) & np.asarray(mask)
+        elif kind == 1:  # existing gate (or complement) hit
+            gid = int(rng.integers(0, st.num_gates))
+            target = st.table(gid) if rng.integers(0, 2) else ~st.table(gid)
+            target = np.asarray(target) & np.asarray(mask)
+        elif kind == 2:  # pair hit
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            target = np.asarray(
+                tt.eval_gate2(bf.NAND, st.table(int(a)), st.table(int(b)))
+            ) & np.asarray(mask)
+        else:  # partial mask (mux-recursion shape)
+            sel = st.table(int(rng.integers(0, num_inputs)))
+            mask = np.asarray(mask) & ~np.asarray(sel)
+            target = np.asarray(
+                rng.integers(0, 2**32, size=8, dtype=np.uint32)
+            ) & mask
+        seed = int(rng.integers(0, 2**31)) if randomize else None
+        ctx_n, ctx_d = _step_contexts(
+            seed, randomize=randomize, try_nots=try_nots
+        )
+        got_n = ctx_n.gate_step(st, target, mask)
+        got_d = ctx_d.gate_step(st, target, mask)
+        if got_d[0] == 0:
+            # miss: the kernel's payload fields are unspecified junk
+            # (last chunk's argmax row); only the step must agree
+            assert got_n[0] == 0, f"case {case}: native {got_n}, kernel miss"
+        else:
+            assert got_n == got_d, (
+                f"case {case}: native {got_n} != kernel {got_d}"
+            )
+        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        steps_seen.add(got_n[0])
+    assert {1, 2, 3}.issubset(steps_seen), steps_seen
+
+
+def test_gate_step_native_full_search_identical():
+    """End-to-end: a non-randomized gate-mode search must produce the
+    identical circuit whichever path executes the node sweeps."""
+    from sboxgates_tpu.core.ttable import mask_table
+    from sboxgates_tpu.search import make_targets
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    with open("sboxes/crypto1_fa.txt") as f:
+        sbox, n = parse_sbox(f.read())
+    targets = make_targets(sbox)
+    circuits = []
+    for host in (True, False):
+        from sboxgates_tpu.search import Options, SearchContext
+
+        ctx = SearchContext(
+            Options(seed=5, randomize=False, host_small_steps=host,
+                    parallel_mux=False)
+        )
+        st = State.init_inputs(n)
+        out = create_circuit(ctx, st, targets[0], mask_table(n), [])
+        assert out != 0xFFFF
+        circuits.append(
+            [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
+        )
+    assert circuits[0] == circuits[1]
