@@ -269,6 +269,14 @@ def bench_des_s1_sat_not() -> dict:
     small never justify a device dispatch), so the measurement is
     backend-independent: the honest comparison point against the
     reference's own CPU/MPI run of the same config."""
+    from sboxgates_tpu import native
+
+    if not native.available():
+        # Without the native runtime every node would be a device dispatch
+        # — hours of link RTT, measuring the network instead of the search.
+        raise RuntimeError(
+            f"native runtime unavailable: {native.build_error()}"
+        )
     dt, best = _search_des_s1(metric=1, try_nots=True, iterations=3)
     return {
         "metric": "des_s1_bit0_sat_not_i3",

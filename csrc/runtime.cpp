@@ -416,10 +416,13 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
     uint32_t best = 0;
     int64_t bi = -1;
     int32_t bslot = 0;
-    int64_t n = 0;
-    for (int32_t i = 0; i < bucket - 1; i++) {
-      for (int32_t j = i + 1; j < bucket; j++, n++) {
-        if (j >= g) continue;  // i < j, so j < g implies both valid
+    // Iterate real pairs only (i < j < g), computing each pair's index in
+    // the bucket-grid triangular order the host decodes with.
+    for (int32_t i = 0; i + 1 < g; i++) {
+      const int64_t row0 =
+          (int64_t)i * bucket - (int64_t)i * (i + 1) / 2 - i - 1;
+      for (int32_t j = i + 1; j < g; j++) {
+        const int64_t n = row0 + j;
         TT tabs[2] = {T[i], T[j]};
         uint32_t r1, r0;
         cell_constraints(tabs, 2, need1, need0, &r1, &r0);

@@ -54,11 +54,15 @@ STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 15}
 PIVOT_MIN_TOTAL = 1 << 21
 
 # Gate-mode nodes at or below this many gates run on the host via the
-# native runtime (Options.host_small_steps): the full steps-1-4 space
-# (C(64,2) pairs, C(64,3)=41664 triples) is well under a millisecond of
-# native work — cheaper than any dispatch.  Also the first BUCKETS entry:
-# the native pair index is decoded against the same 64-row grid.
-NATIVE_STEP_MAX_G = 64
+# native runtime (Options.host_small_steps).  Measured through the
+# network-attached chip, the native step wins at EVERY gate-mode size —
+# 3 ms vs 42 ms at g=64, 215 ms vs 2.1 s at the g=500 cap (the device
+# triple stream is RTT- and gather-bound) — so the threshold covers all
+# states; the device kernels remain the path for mesh runs and the
+# host_small_steps=False opt-out.  This mirrors the reference's own
+# architecture: its gate-mode engine is serial C (sboxgates.c:282-616),
+# MPI parallelizes only the LUT search.
+NATIVE_STEP_MAX_G = 512
 
 
 def lut_head_has5(g: int) -> bool:
@@ -227,6 +231,7 @@ class SearchContext:
         self.triple_table_np, self.triple_entries = _build_triple_table(self.avail_3)
         self.triple_table = jnp.asarray(self.triple_table_np)
         self._pair_combo_cache = {}
+        self._pair_combo_np_cache = {}
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs = None
@@ -289,11 +294,20 @@ class SearchContext:
             return jnp.asarray(arr)
         return self.mesh_plan.replicate(np.asarray(arr))
 
+    def _pair_combos_np(self, bucket: int) -> np.ndarray:
+        """Host-side pair index grid per bucket (decode lookups must not
+        touch the device — fetching the grid costs a full link round trip)."""
+        if bucket not in self._pair_combo_np_cache:
+            i, j = np.triu_indices(bucket, k=1)
+            self._pair_combo_np_cache[bucket] = np.stack(
+                [i, j], axis=1
+            ).astype(np.int32)
+        return self._pair_combo_np_cache[bucket]
+
     def _pair_combos(self, bucket: int):
         """Device-cached (and mesh-sharded) pair index grid per bucket."""
         if bucket not in self._pair_combo_cache:
-            i, j = np.triu_indices(bucket, k=1)
-            combos = np.stack([i, j], axis=1).astype(np.int32)
+            combos = self._pair_combos_np(bucket)
             # pad fill is out-of-range so `combos < g` masks pad rows off
             self._pair_combo_cache[bucket] = self.place_chunk(
                 combos, fill=np.int32(2**30)
@@ -612,7 +626,7 @@ class SearchContext:
     def decode_pair_hit(self, st: State, index: int, slot: int, use_not: bool):
         """(gid1, gid2, entry) for a fused-kernel pair hit."""
         entries = self.not_entries if use_not else self.pair_entries
-        combos = np.asarray(self._pair_combos(bucket_size(st.num_gates)))
+        combos = self._pair_combos_np(bucket_size(st.num_gates))
         pair = combos[index]
         entry = entries[slot]
         gids = [int(pair[p]) for p in entry.perm]
@@ -651,7 +665,7 @@ class SearchContext:
             )
         if not bool(v[0]):
             return False, 0, 0, None
-        pair = np.asarray(combos[int(v[1])])
+        pair = self._pair_combos_np(tables.shape[0])[int(v[1])]
         entry = entries[int(v[2])]
         gids = [int(pair[p]) for p in entry.perm]
         return True, gids[0], gids[1], entry

@@ -272,3 +272,30 @@ def test_gate_step_native_full_search_identical():
             [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
         )
     assert circuits[0] == circuits[1]
+
+
+def test_gate_step_native_matches_kernel_large_bucket():
+    """g > 64 routes through the 512-row bucket grid: the native pair
+    index and triple rank must still decode identically to the kernel."""
+    rng = np.random.default_rng(3)
+    st = _rand_gate_state(rng, 8, 72)  # g = 80 -> bucket 512
+    mask = tt.mask_table(8)
+    a, b = rng.choice(st.num_gates, size=2, replace=False)
+    planted = np.asarray(
+        tt.eval_gate2(bf.NAND, st.table(int(a)), st.table(int(b)))
+    ) & np.asarray(mask)
+    rand = np.asarray(
+        rng.integers(0, 2**32, size=8, dtype=np.uint32)
+    ) & np.asarray(mask)
+    for target in (planted, rand):
+        for seed in (None, 77):
+            ctx_n, ctx_d = _step_contexts(
+                seed, randomize=seed is not None, try_nots=True
+            )
+            got_n = ctx_n.gate_step(st, target, mask)
+            got_d = ctx_d.gate_step(st, target, mask)
+            if got_d[0] == 0:
+                assert got_n[0] == 0
+            else:
+                assert got_n == got_d
+            assert ctx_n.stats == ctx_d.stats
