@@ -30,11 +30,18 @@
 //                            (ops/sweeps.py:gate_step_stream) — same hashed
 //                            priorities, same chunk order — so routing a
 //                            node host-side never changes the search result.
+//  - sbg_lut_step:           the LUT-mode counterpart (steps 1-3 + 3-LUT +
+//                            small-space 5-LUT streams; lut.c:501-580),
+//                            bit-identical to ops/sweeps.py:lut_step_stream.
+//                            Pivot-sized 5-LUT sweeps, overflow re-drives,
+//                            and the 7-LUT phase stay on the device.
 //
 // Build: see csrc/Makefile (g++ -O3 -march=native -shared -fPIC).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -349,6 +356,150 @@ inline void cell_constraints(const TT* tabs, int k, const TT& need1,
   *req0 = r0;
 }
 
+// Shared operands of one search node (either mode).
+struct NodeCtx {
+  const TT* T;
+  int32_t g;
+  int32_t bucket;
+  TT tgt, msk, need1, need0;
+  int32_t seed;
+};
+
+inline NodeCtx make_node_ctx(const uint64_t* tables, int32_t g,
+                             int32_t bucket, const uint64_t* target,
+                             const uint64_t* mask, int32_t seed) {
+  NodeCtx n;
+  n.T = reinterpret_cast<const TT*>(tables);
+  n.g = g;
+  n.bucket = bucket;
+  std::memcpy(n.tgt.w, target, sizeof(TT));
+  std::memcpy(n.msk.w, mask, sizeof(TT));
+  n.need1 = tt_and(n.msk, n.tgt);
+  n.need0 = tt_and(n.msk, tt_not(n.tgt));
+  n.seed = seed;
+  return n;
+}
+
+// Steps 1-2: existing gate or its complement (priority ascends with the
+// index when deterministic — the reference's newest-first scan order,
+// sboxgates.c:285-299).  Returns 1/2 with *x0 = gate id, or 0.
+inline int32_t scan_stage(const NodeCtx& n, int32_t* x0) {
+  uint32_t bestd = 0, besti = 0;
+  int32_t dbest = 0, ibest = 0;
+  bool anyd = false, anyi = false;
+  for (int32_t i = 0; i < n.g; i++) {
+    uint32_t prio = n.seed < 0 ? (uint32_t)(i + 1)
+                               : hash_prio((uint32_t)i, (uint32_t)n.seed);
+    if (tt_eq_mask(n.T[i], n.tgt, n.msk) && prio > bestd) {
+      bestd = prio; dbest = i; anyd = true;
+    }
+    if (tt_eq_mask(tt_not(n.T[i]), n.tgt, n.msk) && prio > besti) {
+      besti = prio; ibest = i; anyi = true;
+    }
+  }
+  if (anyd) { *x0 = dbest; return 1; }
+  if (anyi) { *x0 = ibest; return 2; }
+  return 0;
+}
+
+// Steps 3 / 4a: one function over all gate pairs, via the 4-cell
+// constraint key and a match table (sboxgates.c:323-350, 366-386).  Pair
+// index runs over the bucket-row upper-triangular grid in np.triu_indices
+// order — the index the host decodes with.  Returns true with *x0 = pair
+// index, *x1 = match-table slot.
+inline bool pair_stage(const NodeCtx& n, const int16_t* mt, uint32_t sx,
+                       int32_t* x0, int32_t* x1) {
+  if (mt == nullptr) return false;
+  const int32_t s = (int32_t)(n.seed ^ (int32_t)sx);
+  const int64_t N = (int64_t)n.bucket * (n.bucket - 1) / 2;
+  uint32_t best = 0;
+  int64_t bi = -1;
+  int32_t bslot = 0;
+  // Iterate real pairs only (i < j < g), computing each pair's index in
+  // the bucket-grid triangular order.
+  for (int32_t i = 0; i + 1 < n.g; i++) {
+    const int64_t row0 =
+        (int64_t)i * n.bucket - (int64_t)i * (i + 1) / 2 - i - 1;
+    for (int32_t j = i + 1; j < n.g; j++) {
+      const int64_t idx = row0 + j;
+      TT tabs[2] = {n.T[i], n.T[j]};
+      uint32_t r1, r0;
+      cell_constraints(tabs, 2, n.need1, n.need0, &r1, &r0);
+      if (r1 & r0) continue;
+      int16_t slot = mt[r1 | ((r1 | r0) << 4)];
+      if (slot < 0) continue;
+      uint32_t prio = s < 0 ? (uint32_t)(N - idx)
+                            : hash_prio((uint32_t)idx, (uint32_t)s);
+      if (prio > best) { best = prio; bi = idx; bslot = slot; }
+    }
+  }
+  if (bi < 0) return false;
+  *x0 = (int32_t)bi;
+  *x1 = bslot;
+  return true;
+}
+
+// Lexicographic k-combination successor state.
+struct ComboIter {
+  int32_t c[8];
+  int32_t g, k;
+  void init(int32_t g_, int32_t k_) {
+    g = g_; k = k_;
+    for (int32_t i = 0; i < k; i++) c[i] = i;
+  }
+  void next() {
+    int32_t i = k - 1;
+    while (i >= 0 && c[i] == g - k + i) i--;
+    if (i < 0) return;  // exhausted (caller bounds by total)
+    c[i]++;
+    for (int32_t j = i + 1; j < k; j++) c[j] = c[j - 1] + 1;
+  }
+};
+
+// Feasibility + packed cell constraints with early conflict exit (the
+// reference's check_n_lut_possible shape, lut.c:34-66): returns false as
+// soon as a cell holds both a required-1 and a required-0 position.
+inline bool feasible_constraints(const NodeCtx& n, const int32_t* combo,
+                                 int k, uint32_t* r1, uint32_t* r0) {
+  const int cells = 1 << k;
+  uint32_t a1 = 0, a0 = 0;
+  for (int c = 0; c < cells; c++) {
+    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+    for (int i = 0; i < k; i++) {
+      const TT& t = n.T[combo[i]];
+      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
+    }
+    bool h1 = tt_any(tt_and(m, n.need1));
+    bool h0 = tt_any(tt_and(m, n.need0));
+    if (h1 && h0) return false;
+    if (h1) a1 |= 1u << c;
+    if (h0) a0 |= 1u << c;
+  }
+  *r1 = a1;
+  *r0 = a0;
+  return true;
+}
+
+// 5-LUT decomposition test for one (split, outer-function): no inner cell
+// (outer output o, inner pattern m) may mix required-1 and required-0
+// cells (sweeps._lut5_solve_core semantics).
+inline bool lut5_pair_ok(uint32_t w, uint32_t mm, uint32_t r1, uint32_t r0) {
+  uint32_t c1 = w & mm;
+  if ((r1 & c1) && (r0 & c1)) return false;
+  uint32_t c0 = ~w & mm;
+  if ((r1 & c0) && (r0 & c0)) return false;
+  return true;
+}
+
+inline bool lut5_row_ok(const uint32_t* w_tab, const uint32_t* m_tab,
+                        int s, int f, uint32_t r1, uint32_t r0) {
+  const uint32_t w = w_tab[s * 256 + f];
+  for (int m = 0; m < 4; m++) {
+    if (!lut5_pair_ok(w, m_tab[s * 4 + m], r1, r0)) return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 // One gate-mode search node: steps 1-4 of create_circuit
@@ -375,80 +526,27 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
                    const int16_t* pair_table, const int16_t* not_table,
                    const int16_t* triple_table, int64_t total3,
                    int32_t chunk3, int32_t seed, int32_t* out4) {
-  const TT* T = reinterpret_cast<const TT*>(tables);
-  TT tgt, msk;
-  std::memcpy(tgt.w, target, sizeof(TT));
-  std::memcpy(msk.w, mask, sizeof(TT));
-  const TT need1 = tt_and(msk, tgt);
-  const TT need0 = tt_and(msk, tt_not(tgt));
+  const NodeCtx n = make_node_ctx(tables, g, bucket, target, mask, seed);
   out4[0] = out4[1] = out4[2] = out4[3] = 0;
 
-  // Steps 1-2: existing gate or its complement (priority ascends with the
-  // index when deterministic — the reference's newest-first scan order,
-  // sboxgates.c:285-299).
-  {
-    uint32_t bestd = 0, besti = 0;
-    int32_t dbest = 0, ibest = 0;
-    bool anyd = false, anyi = false;
-    for (int32_t i = 0; i < g; i++) {
-      uint32_t prio = seed < 0 ? (uint32_t)(i + 1)
-                               : hash_prio((uint32_t)i, (uint32_t)seed);
-      if (tt_eq_mask(T[i], tgt, msk) && prio > bestd) {
-        bestd = prio; dbest = i; anyd = true;
-      }
-      if (tt_eq_mask(tt_not(T[i]), tgt, msk) && prio > besti) {
-        besti = prio; ibest = i; anyi = true;
-      }
-    }
-    if (anyd) { out4[0] = 1; out4[1] = dbest; return; }
-    if (anyi) { out4[0] = 2; out4[1] = ibest; return; }
+  int32_t x0, x1;
+  if ((out4[0] = scan_stage(n, &x0)) != 0) { out4[1] = x0; return; }
+  if (pair_stage(n, pair_table, 0x3D4Au, &x0, &x1)) {
+    out4[0] = 3; out4[1] = x0; out4[2] = x1;
+    return;
   }
-
-  // Steps 3 / 4a: one function over all gate pairs, via the 4-cell
-  // constraint key and a match table (sboxgates.c:323-350, 366-386).
-  // Pair index n runs over the bucket-row upper-triangular grid in
-  // np.triu_indices order — the index the host decodes with.
-  auto pair_stage = [&](const int16_t* mt, uint32_t sx,
-                        int32_t step_code) -> bool {
-    if (mt == nullptr) return false;
-    const int32_t s = (int32_t)(seed ^ (int32_t)sx);
-    const int64_t N = (int64_t)bucket * (bucket - 1) / 2;
-    uint32_t best = 0;
-    int64_t bi = -1;
-    int32_t bslot = 0;
-    // Iterate real pairs only (i < j < g), computing each pair's index in
-    // the bucket-grid triangular order the host decodes with.
-    for (int32_t i = 0; i + 1 < g; i++) {
-      const int64_t row0 =
-          (int64_t)i * bucket - (int64_t)i * (i + 1) / 2 - i - 1;
-      for (int32_t j = i + 1; j < g; j++) {
-        const int64_t n = row0 + j;
-        TT tabs[2] = {T[i], T[j]};
-        uint32_t r1, r0;
-        cell_constraints(tabs, 2, need1, need0, &r1, &r0);
-        if (r1 & r0) continue;
-        int16_t slot = mt[r1 | ((r1 | r0) << 4)];
-        if (slot < 0) continue;
-        uint32_t prio = s < 0 ? (uint32_t)(N - n)
-                              : hash_prio((uint32_t)n, (uint32_t)s);
-        if (prio > best) { best = prio; bi = n; bslot = slot; }
-      }
-    }
-    if (bi < 0) return false;
-    out4[0] = step_code;
-    out4[1] = (int32_t)bi;
-    out4[2] = bslot;
-    return true;
-  };
-  if (pair_stage(pair_table, 0x3D4Au, 3)) return;
-  if (pair_stage(not_table, 0x11C9u, 4)) return;
+  if (pair_stage(n, not_table, 0x11C9u, &x0, &x1)) {
+    out4[0] = 4; out4[1] = x0; out4[2] = x1;
+    return;
+  }
 
   // Step 4b: gate triples x 3-input functions (sboxgates.c:392-435),
   // streamed in chunk3-rank chunks with the kernel's per-chunk seeds and
   // first-matching-chunk early exit (sweeps._match_stream_core semantics).
   if (triple_table != nullptr && total3 > 0) {
     const int32_t s3 = (int32_t)(seed ^ 0x7777);
-    int32_t combo[3] = {0, 1, 2};
+    ComboIter it;
+    it.init(g, 3);
     int64_t rank = 0;
     while (rank < total3) {
       const int64_t cstart = rank;
@@ -458,11 +556,9 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
       uint32_t best = 0;
       int64_t babs = -1;
       int32_t bslot = 0;
-      for (; rank < cend; rank++) {
-        TT tabs[3] = {T[combo[0]], T[combo[1]], T[combo[2]]};
+      for (; rank < cend; rank++, it.next()) {
         uint32_t r1, r0;
-        cell_constraints(tabs, 3, need1, need0, &r1, &r0);
-        if (!(r1 & r0)) {
+        if (feasible_constraints(n, it.c, 3, &r1, &r0)) {
           int16_t slot = triple_table[r1 | ((r1 | r0) << 8)];
           if (slot >= 0) {
             uint32_t row = (uint32_t)(rank - cstart);
@@ -470,17 +566,6 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
                                    : hash_prio(row, (uint32_t)sc);
             if (prio > best) { best = prio; babs = rank; bslot = slot; }
           }
-        }
-        // lexicographic successor
-        if (combo[2] + 1 < g) {
-          combo[2]++;
-        } else if (combo[1] + 2 < g) {
-          combo[1]++;
-          combo[2] = combo[1] + 1;
-        } else {
-          combo[0]++;
-          combo[1] = combo[0] + 1;
-          combo[2] = combo[1] + 1;
         }
       }
       // examined = min(chunk end, total) - 0, as the kernel reports it
@@ -490,6 +575,174 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
         out4[0] = 5;
         out4[1] = (int32_t)babs;
         out4[2] = bslot;
+        return;
+      }
+    }
+  }
+}
+
+// One LUT-mode search node's head: steps 1-3 plus the whole-space 3-LUT
+// stream and (when has5) the small-space 5-LUT stream, with the exact
+// verdict encoding and bit-identical candidate selection of the jitted
+// kernel (ops/sweeps.py:lut_step_stream) — out8 =
+// [step, x0, x1, x2, x3, x4, ex3, ex5]; see that kernel's docstring for
+// the step codes (4 = 3-LUT, 5 = 5-LUT, 6 = 5-LUT solver overflow).
+// excl/n_excl: mux-used input bits rejected by the 5-LUT stream only
+// (the 3-LUT phase scans all triples, lut.c:501-523 vs 176-186).
+// w_tab[10*256]/m_tab[10*4]: the 5-LUT split tables
+// (sweeps.lut5_split_tables).
+void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
+                  const uint64_t* target, const uint64_t* mask,
+                  const int16_t* pair_table, const int32_t* excl,
+                  int32_t n_excl, int64_t total3, int32_t chunk3,
+                  int32_t has5, int64_t total5, int32_t chunk5,
+                  int32_t solve_rows, const uint32_t* w_tab,
+                  const uint32_t* m_tab, int32_t seed, int32_t* out8) {
+  const NodeCtx n = make_node_ctx(tables, g, bucket, target, mask, seed);
+  for (int i = 0; i < 8; i++) out8[i] = 0;
+
+  int32_t x0, x1;
+  if ((out8[0] = scan_stage(n, &x0)) != 0) { out8[1] = x0; return; }
+  if (pair_stage(n, pair_table, 0x3D4Au, &x0, &x1)) {
+    out8[0] = 3; out8[1] = x0; out8[2] = x1;
+    return;
+  }
+
+  // Whole-space 3-LUT stream (reference: lut_search phase 1,
+  // lut.c:501-523; kernel: sweeps._lut3_stream_core with seed ^ 0x55D3).
+  // No exclusion list and no match table — feasibility alone guarantees a
+  // consistent 3-input function exists; the host derives it from the
+  // returned packed constraints.
+  if (total3 > 0) {
+    const int32_t s3 = (int32_t)(seed ^ 0x55D3);
+    ComboIter it;
+    it.init(g, 3);
+    int64_t rank = 0;
+    while (rank < total3) {
+      const int64_t cstart = rank;
+      int64_t cend = cstart + chunk3;
+      if (cend > total3) cend = total3;
+      const int32_t sc = (int32_t)(s3 ^ (int32_t)cstart);
+      uint32_t best = 0;
+      int64_t babs = -1;
+      uint32_t br1 = 0, br0 = 0;
+      for (; rank < cend; rank++, it.next()) {
+        uint32_t r1, r0;
+        if (feasible_constraints(n, it.c, 3, &r1, &r0)) {
+          uint32_t row = (uint32_t)(rank - cstart);
+          uint32_t prio = sc < 0 ? (uint32_t)((uint32_t)chunk3 - row)
+                                 : hash_prio(row, (uint32_t)sc);
+          if (prio > best) { best = prio; babs = rank; br1 = r1; br0 = r0; }
+        }
+      }
+      int64_t nxt_after = cstart + chunk3;
+      out8[6] = (int32_t)(nxt_after < total3 ? nxt_after : total3);
+      if (babs >= 0) {
+        out8[0] = 4;
+        out8[1] = (int32_t)babs;
+        out8[2] = (int32_t)br1;
+        out8[3] = (int32_t)br0;
+        return;
+      }
+    }
+  }
+
+  // Small-space 5-LUT stream (reference: search_5lut, lut.c:116-249;
+  // kernel: sweeps._lut5_stream_core with seed ^ 0x1BF5): per chunk,
+  // filter, take the top-`solve_rows` feasible tuples by chunk priority,
+  // solve for a LUT(LUT(a,b,c),d,e) decomposition in the packed cell
+  // domain; status 6 (overflow) when a chunk has more feasible tuples
+  // than the solver takes and none of the solved subset decomposes.
+  if (has5 && total5 > 0) {
+    const int32_t s5 = (int32_t)(seed ^ 0x1BF5);
+    ComboIter it;
+    it.init(g, 5);
+    int64_t rank = 0;
+    while (rank < total5) {
+      const int64_t cstart = rank;
+      int64_t cend = cstart + chunk5;
+      if (cend > total5) cend = total5;
+      const int32_t sc = (int32_t)(s5 ^ (int32_t)cstart);
+      int64_t nfeas = 0;
+      // Feasible rows of this chunk: (priority, rank, req1, req0).
+      struct Row {
+        uint32_t prio;
+        int64_t rank;
+        uint32_t r1, r0;
+      };
+      static thread_local std::vector<Row> rows;
+      rows.clear();
+      for (; rank < cend; rank++, it.next()) {
+        bool excluded = false;
+        for (int32_t e = 0; e < n_excl && !excluded; e++) {
+          for (int i = 0; i < 5; i++) {
+            if (it.c[i] == excl[e]) { excluded = true; break; }
+          }
+        }
+        if (excluded) continue;
+        uint32_t r1, r0;
+        if (!feasible_constraints(n, it.c, 5, &r1, &r0)) continue;
+        nfeas++;
+        uint32_t row = (uint32_t)(rank - cstart);
+        uint32_t prio = sc < 0 ? (uint32_t)((uint32_t)chunk5 - row)
+                               : hash_prio(row, (uint32_t)sc);
+        rows.push_back({prio, rank, r1, r0});
+      }
+      int64_t nxt_after = cstart + chunk5;
+      out8[7] = (int32_t)(nxt_after < total5 ? nxt_after : total5);
+      if (rows.empty()) continue;
+      // lax.top_k order: priority descending, ties by index ascending
+      // (stable sort preserves rank order within equal priorities).
+      std::stable_sort(rows.begin(), rows.end(),
+                       [](const Row& a, const Row& b) {
+                         return a.prio > b.prio;
+                       });
+      const int64_t take =
+          (int64_t)rows.size() < (int64_t)solve_rows ? (int64_t)rows.size()
+                                                     : (int64_t)solve_rows;
+      const int32_t ss = (int32_t)(sc ^ 0x9E37);
+      uint32_t best = 0;
+      int64_t best_t = -1;
+      for (int64_t t = 0; t < take; t++) {
+        bool any = false;
+        for (int s = 0; s < 10 && !any; s++) {
+          for (int f = 0; f < 256; f++) {
+            if (lut5_row_ok(w_tab, m_tab, s, f, rows[t].r1, rows[t].r0)) {
+              any = true;
+              break;
+            }
+          }
+        }
+        if (!any) continue;
+        uint32_t prio = ss < 0 ? (uint32_t)((uint32_t)solve_rows - (uint32_t)t)
+                               : hash_prio((uint32_t)t, (uint32_t)ss);
+        if (prio > best) { best = prio; best_t = t; }
+      }
+      if (best_t >= 0) {
+        // Random choice among this row's (split, outer-function)
+        // decompositions (kernel: flat priority with seed ^ 0x5BD1).
+        const int32_t sf = (int32_t)(ss ^ 0x5BD1);
+        uint32_t fbest = 0;
+        int32_t sel = 0;
+        for (int32_t flat = 0; flat < 2560; flat++) {
+          if (!lut5_row_ok(w_tab, m_tab, flat >> 8, flat & 255,
+                           rows[best_t].r1, rows[best_t].r0))
+            continue;
+          uint32_t prio = sf < 0 ? (uint32_t)(2560 - flat)
+                                 : hash_prio((uint32_t)flat, (uint32_t)sf);
+          if (prio > fbest) { fbest = prio; sel = flat; }
+        }
+        out8[0] = 5;
+        out8[1] = (int32_t)rows[best_t].rank;
+        out8[2] = sel >> 8;          // sigma
+        out8[3] = sel & 255;         // func_outer
+        out8[4] = (int32_t)rows[best_t].r1;
+        out8[5] = (int32_t)rows[best_t].r0;
+        return;
+      }
+      if (nfeas > solve_rows) {
+        out8[0] = 6;
+        out8[1] = (int32_t)cstart;
         return;
       }
     }

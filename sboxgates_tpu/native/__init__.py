@@ -15,6 +15,8 @@ Exposed surface (mirrors the C ABI):
   (the measured baseline for bench.py)
 - :func:`gate_step`            — fused gate-mode search node (steps 1-4)
   for small states, bit-identical to the jitted kernel's selection
+- :func:`lut_step`             — the LUT-mode head counterpart (steps 1-3
+  + 3-LUT + small-space 5-LUT), bit-identical to lut_step_stream
 """
 
 from __future__ import annotations
@@ -146,6 +148,28 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.sbg_gate_step.restype = None
+
+        lib.sbg_lut_step.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_lut_step.restype = None
 
         _lib = lib
         return lib
@@ -315,6 +339,60 @@ def gate_step(
         tab_ptr(triple_table),
         total3,
         chunk3,
+        seed,
+        _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+def lut_step(
+    tables64: np.ndarray,
+    g: int,
+    bucket: int,
+    target64: np.ndarray,
+    mask64: np.ndarray,
+    pair_table: np.ndarray,
+    excl: np.ndarray,
+    total3: int,
+    chunk3: int,
+    has5: bool,
+    total5: int,
+    chunk5: int,
+    solve_rows: int,
+    w_tab: np.ndarray,
+    m_tab: np.ndarray,
+    seed: int,
+) -> np.ndarray:
+    """One fused LUT-mode head (steps 1-3 + 3-LUT + small-space 5-LUT) on
+    the host: same int32[8] verdict encoding and bit-identical candidate
+    selection as ``sweeps.lut_step_stream``.  ``excl`` is the list of
+    mux-used input bit gate ids (applied by the 5-LUT stream only)."""
+    lib = _require()
+    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
+    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
+    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
+    pair_table = np.ascontiguousarray(pair_table, dtype=np.int16)
+    excl = np.ascontiguousarray(excl, dtype=np.int32)
+    w_tab = np.ascontiguousarray(w_tab, dtype=np.uint32)
+    m_tab = np.ascontiguousarray(m_tab, dtype=np.uint32)
+    out = np.zeros(8, dtype=np.int32)
+    lib.sbg_lut_step(
+        _ptr(tables64, ctypes.c_uint64),
+        g,
+        bucket,
+        _ptr(target64, ctypes.c_uint64),
+        _ptr(mask64, ctypes.c_uint64),
+        _ptr(pair_table, ctypes.c_int16),
+        _ptr(excl, ctypes.c_int32),
+        excl.shape[0],
+        total3,
+        chunk3,
+        1 if has5 else 0,
+        total5,
+        chunk5,
+        solve_rows,
+        _ptr(w_tab, ctypes.c_uint32),
+        _ptr(m_tab, ctypes.c_uint32),
         seed,
         _ptr(out, ctypes.c_int32),
     )

@@ -53,6 +53,11 @@ STREAM_CHUNK = {2: 1 << 14, 3: 1 << 15, 5: 1 << 17, 7: 1 << 15}
 # 5-LUT sweep.
 PIVOT_MIN_TOTAL = 1 << 21
 
+# Rows the fused LUT head's in-kernel 5-LUT solver takes per chunk —
+# shared by the device kernel (lut_step_stream's solve_rows) and the
+# native path so both select identical decompositions.
+LUT5_HEAD_SOLVE_ROWS = 1024
+
 # Gate-mode nodes at or below this many gates run on the host via the
 # native runtime (Options.host_small_steps).  Measured through the
 # network-attached chip, the native step wins at EVERY gate-mode size —
@@ -427,18 +432,29 @@ class SearchContext:
         return self._native_probe
 
     def uses_native_step(self, st: State) -> bool:
-        """True when this state's node sweeps run on the host
-        (:meth:`_gate_step_native`) — also the signal for the mux recursion
-        to skip its concurrency threads: overlapping device round trips is
-        the threads' whole value, and native nodes have none (measured
-        ~1.4x slower with threads, pure GIL contention)."""
+        """True when this state's node head sweeps run on the host
+        (:meth:`_gate_step_native` / :meth:`_lut_step_native`)."""
         return (
             self.opt.host_small_steps
             and self.mesh_plan is None
-            and not self.opt.lut_graph
             and st.num_gates <= NATIVE_STEP_MAX_G
             and self._native_ok()
         )
+
+    def node_host_only(self, st: State) -> bool:
+        """True when a search node runs entirely on the host in the common
+        path — the signal for the mux recursion to skip its concurrency
+        threads (their whole value is overlapping device round trips;
+        measured ~1.4x slower with threads on dispatch-free gate-mode
+        nodes, pure GIL contention).  LUT-mode nodes whose 5-LUT space is
+        pivot-sized still make a device dispatch per node, so they keep
+        the threads."""
+        if not self.uses_native_step(st):
+            return False
+        if not self.opt.lut_graph:
+            return True
+        g = st.num_gates
+        return g < 5 or lut_head_has5(g)
 
     def _gate_step_native(self, st: State, target, mask):
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical
@@ -525,13 +541,59 @@ class SearchContext:
             self.stats["triple_candidates"] += int(v[3])
         return step, int(v[1]), int(v[2])
 
+    def _lut_step_native(self, st: State, target, mask, inbits) -> np.ndarray:
+        """Host-native fused LUT head (csrc sbg_lut_step) — bit-identical
+        verdict to the device kernel, without the dispatch.  The 7-LUT
+        phase, pivot-sized 5-LUT sweeps, and overflow re-drives stay on
+        the device (lut_search_from_head handles all three from this
+        verdict exactly as from the kernel's)."""
+        from .. import native
+
+        g = st.num_gates
+        total3 = comb.n_choose_k(g, 3)
+        total5 = comb.n_choose_k(g, 5)
+        has5 = lut_head_has5(g)
+        chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
+        chunk5 = pick_chunk(max(total5, 1), STREAM_CHUNK[5]) if has5 else 1024
+        _, w_tab, m_tab = sweeps.lut5_split_tables()
+        with self.prof.phase("lut_step_native"):
+            v = native.lut_step(
+                native.tables32_to_64(st.live_tables()),
+                g,
+                bucket_size(g),
+                native.tables32_to_64(np.asarray(target)),
+                native.tables32_to_64(np.asarray(mask)),
+                self.pair_table_np,
+                self.excl_array(inbits),
+                total3,
+                chunk3,
+                has5,
+                total5,
+                chunk5,
+                LUT5_HEAD_SOLVE_ROWS,
+                w_tab,
+                m_tab,
+                self.next_seed(),
+            )
+        step = int(v[0])
+        if step == 0 or step >= 3:
+            self.stats["pair_candidates"] += g * (g - 1) // 2
+        self.stats["lut3_candidates"] += int(v[6])
+        self.stats["lut5_candidates"] += int(v[7])
+        return v
+
     def lut_step(self, st: State, target, mask, inbits) -> np.ndarray:
         """Steps 1-3 plus the whole 3-LUT and (small-space) 5-LUT sweeps of
         one LUT-mode search node as ONE fused dispatch
         (sweeps.lut_step_stream).  Returns the packed int32[8] verdict —
         see the kernel docstring for the step encoding; steps 1-3 decode
         exactly as gate_step's, the LUT payloads via
-        :func:`sboxgates_tpu.search.lut.lut_search_from_head`."""
+        :func:`sboxgates_tpu.search.lut.lut_search_from_head`.
+
+        Small states route to the native host runtime instead
+        (:meth:`uses_native_step`) — same verdict, no dispatch."""
+        if self.uses_native_step(st):
+            return self._lut_step_native(st, target, mask, inbits)
         tables, g, b, valid_g, combos, pair_valid, jtarget, jmask = (
             self._node_operands(st, target, mask)
         )
@@ -553,6 +615,7 @@ class SearchContext:
                 functools.partial(
                     sweeps.lut_step_stream,
                     chunk3=chunk3, chunk5=chunk5, has5=has5,
+                    solve_rows=LUT5_HEAD_SOLVE_ROWS,
                 ),
                 (
                     tables,

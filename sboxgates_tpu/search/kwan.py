@@ -132,7 +132,7 @@ def _create_circuit(
     if (
         ctx.rdv is not None
         and len(bit_order) > 1
-        and not ctx.uses_native_step(st)
+        and not ctx.node_host_only(st)
     ):
         from .batched import run_mux_jobs
 

@@ -299,3 +299,217 @@ def test_gate_step_native_matches_kernel_large_bucket():
             else:
                 assert got_n == got_d
             assert ctx_n.stats == ctx_d.stats
+
+
+@pytest.mark.parametrize("randomize", [False, True])
+def test_lut_step_native_bitwise_matches_kernel(randomize):
+    """The native LUT-mode head must return the kernel's exact verdict —
+    same step, same payload — across states exercising scan hits, pair
+    hits, 3-LUT hits, 5-LUT hits, exclusions, and misses."""
+    rng = np.random.default_rng(7)
+    steps_seen = set()
+    for case in range(20):
+        num_inputs = int(rng.integers(4, 8))
+        extra = int(rng.integers(0, 7))
+        st = _rand_gate_state(rng, num_inputs, extra)
+        mask = tt.mask_table(num_inputs)
+        inbits = []
+        kind = case % 4
+        if kind == 0:  # random target: 3-LUT hit or miss
+            target = np.asarray(
+                rng.integers(0, 2**32, size=8, dtype=np.uint32)
+            ) & np.asarray(mask)
+        elif kind == 1:  # planted 5-LUT decomposition
+            gids = rng.choice(st.num_gates, size=5, replace=False)
+            outer = tt.eval_lut(
+                int(rng.integers(1, 255)),
+                st.table(int(gids[0])), st.table(int(gids[1])),
+                st.table(int(gids[2])),
+            )
+            target = np.asarray(
+                tt.eval_lut(
+                    int(rng.integers(1, 255)), outer,
+                    st.table(int(gids[3])), st.table(int(gids[4])),
+                )
+            ) & np.asarray(mask)
+        elif kind == 2:  # scan/complement hit
+            gid = int(rng.integers(0, st.num_gates))
+            target = st.table(gid) if rng.integers(0, 2) else ~st.table(gid)
+            target = np.asarray(target) & np.asarray(mask)
+        else:  # partial mask + exclusions (mux recursion shape)
+            bit = int(rng.integers(0, num_inputs))
+            inbits = [bit]
+            sel = st.table(bit)
+            mask = np.asarray(mask) & ~np.asarray(sel)
+            target = np.asarray(
+                rng.integers(0, 2**32, size=8, dtype=np.uint32)
+            ) & mask
+        seed = int(rng.integers(0, 2**31)) if randomize else None
+        ctx_n, ctx_d = _step_contexts(
+            seed, randomize=randomize, lut_graph=True
+        )
+        got_n = tuple(int(x) for x in ctx_n.lut_step(st, target, mask, inbits))
+        got_d = tuple(int(x) for x in ctx_d.lut_step(st, target, mask, inbits))
+        if got_d[0] == 0:
+            assert got_n[0] == 0, f"case {case}: native {got_n}, kernel miss"
+            # examined counters must still agree on a miss
+            assert got_n[6:] == got_d[6:], f"case {case}"
+        else:
+            assert got_n == got_d, (
+                f"case {case}: native {got_n} != kernel {got_d}"
+            )
+        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        steps_seen.add(got_n[0])
+    assert {1, 4, 5}.issubset(steps_seen), steps_seen
+
+
+def test_lut_step_native_full_search_identical():
+    """End-to-end: a LUT-mode search must produce the identical circuit
+    whichever path executes the head sweeps (fixed seed, both modes)."""
+    from sboxgates_tpu.core.ttable import mask_table
+    from sboxgates_tpu.graph.xmlio import state_fingerprint
+    from sboxgates_tpu.search import make_targets
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    with open("sboxes/des_s1.txt") as f:
+        sbox, n = parse_sbox(f.read())
+    targets = make_targets(sbox)
+    for randomize in (False, True):
+        prints = []
+        for host in (True, False):
+            from sboxgates_tpu.search import Options, SearchContext
+
+            ctx = SearchContext(
+                Options(seed=11, randomize=randomize, lut_graph=True,
+                        host_small_steps=host, parallel_mux=False)
+            )
+            st = State.init_inputs(n)
+            out = create_circuit(ctx, st, targets[0], mask_table(n), [])
+            assert out != 0xFFFF
+            st.outputs[0] = out
+            prints.append(state_fingerprint(st))
+        assert prints[0] == prints[1], f"randomize={randomize}"
+
+
+def test_gate_step_native_not_pair_and_triple_verdicts():
+    """Forces the step-4 (NOT-pair) and step-5 (triple stream) verdicts —
+    the two most intricate native/kernel correspondences — instead of
+    leaving their coverage to RNG luck."""
+    rng = np.random.default_rng(17)
+    st = State.init_inputs(6)
+    while st.num_gates < 14:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    mask = np.asarray(tt.mask_table(6))
+
+    # NAND of two gates: not in the AND/OR/XOR pair table, present in the
+    # NOT-augmented table -> step 4.
+    nand = np.asarray(tt.eval_gate2(bf.NAND, st.table(6), st.table(9))) & mask
+    # (a & b) ^ c: a 2-level composition in avail_3 without polarities ->
+    # step 5 via the chunked triple stream.
+    tri = np.asarray(
+        tt.eval_gate2(
+            bf.XOR,
+            tt.eval_gate2(bf.AND, st.table(7), st.table(10)),
+            st.table(12),
+        )
+    ) & mask
+    for target, want_step, try_nots in (
+        (nand, 4, True),
+        (tri, 5, True),
+        (tri, 5, False),
+    ):
+        for seed in (None, 1234):
+            ctx_n, ctx_d = _step_contexts(
+                seed, randomize=seed is not None, try_nots=try_nots
+            )
+            got_n = ctx_n.gate_step(st, target, mask)
+            got_d = ctx_d.gate_step(st, target, mask)
+            assert got_d[0] == want_step, (got_d, want_step, try_nots, seed)
+            assert got_n == got_d, (got_n, got_d)
+            assert ctx_n.stats == ctx_d.stats
+
+
+def test_lut_step_native_overflow_parity():
+    """5-LUT solver overflow (status 6): with solve_rows=1 and a target
+    admitting several feasible but undecomposable 5-tuples (majority-5
+    needs 4 outer classes, a single outer bit gives 2), native and kernel
+    must agree on the overflow verdict and resume point."""
+    import itertools
+
+    import jax.numpy as jnp
+
+    from sboxgates_tpu import native
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.context import pick_chunk, STREAM_CHUNK
+
+    st = State.init_inputs(8)
+    st.add_not_gate(0, GATES)        # gate 8 = ~in0
+    st.add_not_gate(8, GATES)        # gate 9: table == in0 (duplicate)
+    g = st.num_gates
+    mask = np.asarray(tt.mask_table(8))
+    ins = [np.asarray(st.table(i)) for i in range(5)]
+    maj = np.zeros(8, dtype=np.uint32)
+    for trip in itertools.combinations(range(5), 3):
+        maj |= ins[trip[0]] & ins[trip[1]] & ins[trip[2]]
+    target = maj & mask
+
+    total3 = comb.n_choose_k(g, 3)
+    total5 = comb.n_choose_k(g, 5)
+    chunk3 = pick_chunk(total3, STREAM_CHUNK[3])
+    chunk5 = pick_chunk(total5, STREAM_CHUNK[5])
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+
+    ctx = SearchContext(Options(seed=1, lut_graph=True))
+    tables, _ = ctx.device_tables(st)
+    b = tables.shape[0]
+    combos = ctx._pair_combos(b)
+    excl = ctx.excl_array([])
+    for seed in (-1, 555):
+        got_d = np.asarray(
+            sweeps.lut_step_stream(
+                tables,
+                jnp.arange(b) < g,
+                combos,
+                (np.asarray(ctx._pair_combos_np(b)) < g).all(axis=1),
+                ctx.binom,
+                g,
+                jnp.asarray(target),
+                jnp.asarray(np.asarray(mask)),
+                jnp.asarray(excl),
+                total3,
+                total5,
+                ctx.pair_table,
+                jnp.asarray(w_tab),
+                jnp.asarray(m_tab),
+                seed,
+                chunk3=chunk3,
+                chunk5=chunk5,
+                has5=True,
+                solve_rows=1,
+            )
+        )
+        got_n = native.lut_step(
+            native.tables32_to_64(st.live_tables()),
+            g,
+            b,
+            native.tables32_to_64(target),
+            native.tables32_to_64(mask),
+            ctx.pair_table_np,
+            excl,
+            total3,
+            chunk3,
+            True,
+            total5,
+            chunk5,
+            1,
+            w_tab,
+            m_tab,
+            seed,
+        )
+        assert got_d[0] == 6, got_d  # overflow actually exercised
+        assert got_n[0] == 6
+        # resume point and examined counters must agree exactly
+        assert int(got_n[1]) == int(got_d[1])
+        assert tuple(got_n[6:]) == tuple(got_d[6:])
