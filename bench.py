@@ -148,12 +148,28 @@ def bench_cpu_baseline() -> dict:
 
 
 def bench_gate_mode_sweeps() -> dict:
-    """Gate-mode (non-LUT) throughput: step-3 pair sweep and step-4b triple
-    stream rates (reference hot loops sboxgates.c:323-435)."""
+    """Gate-mode (non-LUT) throughput: the native fused node step (the
+    engine's actual path for single-process gate mode at every state
+    size) and the device pair/triple kernels (the mesh-run path), at
+    G=200 (reference hot loops sboxgates.c:323-435)."""
     from sboxgates_tpu.search import Options, SearchContext
 
     st, target, mask = build_state(G_HEAD)
-    ctx = SearchContext(Options(seed=1))
+
+    # Engine path: one full-miss native node = C(G,2) pairs + C(G,3)
+    # triples swept on the host.
+    nctx = SearchContext(Options(seed=1))
+    native_rate = float("nan")
+    if nctx.uses_native_step(st):
+        nctx._gate_step_native(st, target, mask)  # warm
+        base = nctx.stats["triple_candidates"]
+        t0 = time.perf_counter()
+        for _ in range(REPEATS):
+            nctx._gate_step_native(st, target, mask)
+        dt = time.perf_counter() - t0
+        native_rate = (nctx.stats["triple_candidates"] - base) / dt
+
+    ctx = SearchContext(Options(seed=1, host_small_steps=False))
 
     ctx.pair_search(st, target, mask, use_not_table=False)  # warmup
     base = ctx.stats["pair_candidates"]
@@ -172,8 +188,9 @@ def bench_gate_mode_sweeps() -> dict:
     tri_rate = (ctx.stats["triple_candidates"] - base) / dt_tri
     return {
         "metric": "gate_mode_sweeps",
-        "pair_candidates_per_sec": pair_rate,
-        "triple_candidates_per_sec": tri_rate,
+        "native_node_triples_per_sec": native_rate,
+        "device_pair_candidates_per_sec": pair_rate,
+        "device_triple_candidates_per_sec": tri_rate,
         "unit": "cand/s",
     }
 
@@ -286,6 +303,74 @@ def bench_des_s1_sat_not() -> dict:
     }
 
 
+def bench_des_s1_outputs_batched() -> dict:
+    """Batch-parallel axis (BASELINE configs 4-5): all four DES S1 output
+    bits searched as ONE concurrent LUT batch (rendezvous-merged device
+    dispatches + native heads) vs. the same four searches run serially.
+    The reference has no such axis — its only parallelism is MPI ranks
+    inside one search (sboxgates.c:619-642).
+
+    Honest caveat the numbers show: at DES-S1 state sizes the native
+    host routing makes the serial loop FASTER than the batch (the
+    rendezvous's value is merging device dispatches, and these nodes
+    make almost none; the threads only contend for the single-core
+    GIL).  The batch axis pays in dispatch-bound regimes —
+    device-kernel paths, pivot-sized spaces, mesh runs."""
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import (
+        Options, SearchContext, make_targets, sbox_num_outputs,
+    )
+    from sboxgates_tpu.search.batched import run_batched_circuits
+    from sboxgates_tpu.search.kwan import create_circuit
+    from sboxgates_tpu.utils.sbox import parse_sbox
+
+    with open(os.path.join(HERE, "sboxes/des_s1.txt")) as f:
+        sbox, n = parse_sbox(f.read())
+    targets = make_targets(sbox)
+    outs = sbox_num_outputs(targets)
+    mask = tt.mask_table(n)
+
+    def batched_run():
+        ctx = SearchContext(Options(seed=7, lut_graph=True))
+        st = State.init_inputs(n)
+        jobs = [(st.copy(), targets[o], mask) for o in range(outs)]
+        t0 = time.perf_counter()
+        results = run_batched_circuits(ctx, jobs)
+        dt = time.perf_counter() - t0
+        gates = [
+            r[0].num_gates - r[0].num_inputs
+            for r in results if r[1] != 0xFFFF
+        ]
+        return dt, gates
+
+    def serial_run():
+        ctx = SearchContext(Options(seed=7, lut_graph=True))
+        st = State.init_inputs(n)
+        t0 = time.perf_counter()
+        gates = []
+        for o in range(outs):
+            nst = st.copy()
+            if create_circuit(ctx, nst, targets[o], mask, []) != 0xFFFF:
+                gates.append(nst.num_gates - nst.num_inputs)
+        return time.perf_counter() - t0, gates
+
+    # Warm BOTH paths before timing: the rendezvous merges sweeps into
+    # batch shapes the serial path never compiles, so each needs its own
+    # warm pass for a fair comparison.
+    batched_run()
+    serial_run()
+    bdt, bgates = batched_run()
+    sdt, sgates = serial_run()
+    return {
+        "metric": "des_s1_all_outputs_lut",
+        "value": bdt, "unit": "s",
+        "batched_gates": bgates,
+        "serial_s": sdt, "serial_gates": sgates,
+        "outputs": outs,
+    }
+
+
 def bench_pallas_exec(best) -> dict:
     """Circuit-execution throughput of the Pallas kernel backend on a
     searched DES S1 LUT circuit (the reference's CUDA-LOP3 counterpart,
@@ -301,10 +386,11 @@ def bench_pallas_exec(best) -> dict:
     import jax.numpy as jnp
 
     n_in = best.num_inputs
-    w = 1 << 18  # words per call: 32 * 2^18 = 8.4M evaluations
+    w = 1 << 18   # words per evaluation pass: 32 * 2^18 = 8.4M inputs
+    loops = 64    # passes fused into ONE dispatch (lax.fori_loop), so the
+    #               measurement amortizes the dispatch/link round trip and
+    #               times circuit execution, not the tunnel
     rng = np.random.default_rng(0)
-    # Inputs live on device and outputs reduce to one word on device, so
-    # the measurement is circuit execution, not host<->device transfer.
     inputs = jnp.asarray(
         rng.integers(0, 2**32, size=(n_in, w), dtype=np.uint32)
     )
@@ -314,13 +400,24 @@ def bench_pallas_exec(best) -> dict:
 
     rates = []
     for fn in (pfn, jfn):
-        reduced = jax.jit(lambda x, f=fn: f(x).sum(dtype=jnp.uint32))
-        jax.block_until_ready(reduced(inputs))  # compile
+
+        @jax.jit
+        def looped(x, f=fn):
+            # vary the input each pass so no iteration can be folded away
+            def body(i, acc):
+                return acc ^ f(x ^ i.astype(jnp.uint32))
+
+            acc = jax.lax.fori_loop(1, loops, body, f(x))
+            return acc.sum(dtype=jnp.uint32)
+
+        jax.block_until_ready(looped(inputs))  # compile
         t0 = time.perf_counter()
         for _ in range(REPEATS):
-            out = reduced(inputs)
+            out = looped(inputs)
         jax.block_until_ready(out)
-        rates.append(REPEATS * 32 * w / (time.perf_counter() - t0))
+        rates.append(
+            REPEATS * loops * 32 * w / (time.perf_counter() - t0)
+        )
     pallas_rate, jnp_rate = rates
     return {
         "metric": "pallas_circuit_exec", "value": pallas_rate,
@@ -361,6 +458,7 @@ def main() -> None:
     except Exception as e:
         detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
     run(bench_des_s1_sat_not)
+    run(bench_des_s1_outputs_batched)
     run(bench_pallas_exec, best)
 
     with open(os.path.join(HERE, "BENCH_DETAIL.json"), "w") as f:

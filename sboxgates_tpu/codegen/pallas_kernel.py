@@ -26,11 +26,13 @@ BLOCK = 1024  # words per grid step; 32k evaluations per block
 def compile_pallas(st: State, block: int = BLOCK, interpret: bool = False) -> Callable:
     """Builds ``fn(inputs) -> outputs`` backed by a Pallas TPU kernel.
 
-    ``inputs``: uint32[num_inputs, W] with W a multiple of ``block``; returns
-    uint32[num_outputs, W] in ``output_bits(st)`` order.  ``interpret=True``
+    ``inputs``: uint32[num_inputs, W]; returns uint32[num_outputs, W] in
+    ``output_bits(st)`` order.  W is padded to a multiple of ``block``
+    internally (the pad is sliced off the output).  ``interpret=True``
     runs the kernel in interpreter mode (CPU testing).
     """
     import jax
+    import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     gates = [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
@@ -53,15 +55,18 @@ def compile_pallas(st: State, block: int = BLOCK, interpret: bool = False) -> Ca
     @jax.jit
     def fn(inputs):
         w = inputs.shape[1]
-        assert w % block == 0, (w, block)
-        grid = (w // block,)
-        return pl.pallas_call(
+        wp = -(-w // block) * block
+        if wp != w:
+            inputs = jnp.pad(inputs, ((0, 0), (0, wp - w)))
+        grid = (wp // block,)
+        out = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[pl.BlockSpec((n_in, block), lambda i: (0, i))],
             out_specs=pl.BlockSpec((n_out, block), lambda i: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((n_out, w), inputs.dtype),
+            out_shape=jax.ShapeDtypeStruct((n_out, wp), inputs.dtype),
             interpret=interpret,
         )(inputs)
+        return out[:, :w] if wp != w else out
 
     return fn

@@ -98,6 +98,10 @@ class Options:
     seed: Optional[int] = None
     # Run the --iterations restarts as a device batch axis (vmapped
     # rendezvous dispatches) instead of the reference's serial loop.
+    # Pays when node sweeps actually dispatch to the device (big states,
+    # pivot-sized LUT spaces, host_small_steps off); at natively-routed
+    # small states the serial loop is faster (measured ~1.7x on DES S1 —
+    # the restart threads only contend for the GIL).
     batch_restarts: bool = False
     # Explore the step-5 mux select bits concurrently (independent state
     # copies, results folded in bit order — semantically identical to the

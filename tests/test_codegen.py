@@ -93,13 +93,15 @@ def test_execute_native_matches_tables(fa_lut_circuit):
 def test_pallas_interpret_matches_jnp(fa_lut_circuit):
     st, _, n = fa_lut_circuit
     rng = np.random.default_rng(0)
-    w = 2048
-    inputs = rng.integers(0, 2**32, size=(n, w), dtype=np.uint32)
     jnp_fn = compile_circuit(st)
     pl_fn = compile_pallas(st, block=1024, interpret=True)
-    a = np.asarray(jnp_fn(inputs))
-    b = np.asarray(pl_fn(inputs))
-    assert (a == b).all()
+    # 2048 = whole blocks; 300 exercises the internal pad-and-slice path
+    for w in (2048, 300):
+        inputs = rng.integers(0, 2**32, size=(n, w), dtype=np.uint32)
+        a = np.asarray(jnp_fn(inputs))
+        b = np.asarray(pl_fn(inputs))
+        assert b.shape == a.shape
+        assert (a == b).all()
 
 
 def test_emitted_c_compiles_and_runs(fa_circuit):
