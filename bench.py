@@ -387,14 +387,16 @@ def bench_pallas_exec(best) -> dict:
 
     n_in = best.num_inputs
     w = 1 << 18   # words per evaluation pass: 32 * 2^18 = 8.4M inputs
-    loops = 64    # passes fused into ONE dispatch (lax.fori_loop), so the
-    #               measurement amortizes the dispatch/link round trip and
-    #               times circuit execution, not the tunnel
     rng = np.random.default_rng(0)
     inputs = jnp.asarray(
         rng.integers(0, 2**32, size=(n_in, w), dtype=np.uint32)
     )
     on_tpu = jax.default_backend() != "cpu"
+    # Passes fused into ONE dispatch (lax.fori_loop) so the measurement
+    # amortizes the dispatch/link round trip and times circuit execution,
+    # not the tunnel.  CPU/interpret runs have no dispatch latency to
+    # amortize — 64 interpreter passes would just take 64x longer.
+    loops = 64 if on_tpu else 2
     pfn = compile_pallas(best, interpret=not on_tpu)
     jfn = compile_circuit(best)
 

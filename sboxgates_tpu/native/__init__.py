@@ -31,7 +31,16 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "libsboxg_runtime.so")
-_SRC_PATH = os.path.join(_HERE, "..", "..", "csrc", "runtime.cpp")
+# Source candidates: repo layout first, then a copy dropped next to this
+# module (how an installed wheel/sdist can ship the runtime — see
+# MANIFEST.in / pyproject packaging notes).
+_SRC_CANDIDATES = (
+    os.path.join(_HERE, "..", "..", "csrc", "runtime.cpp"),
+    os.path.join(_HERE, "runtime.cpp"),
+)
+_SRC_PATH = next(
+    (p for p in _SRC_CANDIDATES if os.path.exists(p)), _SRC_CANDIDATES[0]
+)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

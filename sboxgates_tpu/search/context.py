@@ -425,12 +425,24 @@ class SearchContext:
         return tables, g, b, valid_g, combos, pair_valid, jtarget, jmask
 
     def _native_ok(self) -> bool:
-        """Cached probe for the native host runtime."""
+        """Cached probe for the native host runtime.  Warns once when it's
+        missing — small-state searches then pay a device dispatch per node
+        (orders of magnitude slower on network-attached hardware), which
+        should never happen silently."""
         if self._native_probe is None:
             try:
                 from .. import native
 
                 self._native_probe = native.available()
+                if not self._native_probe and self.opt.host_small_steps:
+                    import warnings
+
+                    warnings.warn(
+                        "native host runtime unavailable "
+                        f"({native.build_error()}); small-state search "
+                        "nodes will fall back to device dispatches",
+                        RuntimeWarning,
+                    )
             except Exception:
                 self._native_probe = False
         return self._native_probe
