@@ -35,6 +35,10 @@
 //                            bit-identical to ops/sweeps.py:lut_step_stream.
 //                            Pivot-sized 5-LUT sweeps, overflow re-drives,
 //                            and the 7-LUT phase stay on the device.
+//  - sbg_lut7_stage_a:       host-side 7-LUT feasibility filter + top-k
+//                            hit compaction (lut.c:290-327); only the hit
+//                            rows ship to the device pair-matmul solver,
+//                            so no-hit nodes skip the dispatch entirely.
 //
 // Build: see csrc/Makefile (g++ -O3 -march=native -shared -fPIC).
 
@@ -480,6 +484,29 @@ inline bool feasible_constraints(const NodeCtx& n, const int32_t* combo,
   return true;
 }
 
+// Wide (k > 5) variant of feasible_constraints: packed cell constraints
+// in uint32 words, bit j of word w = cell w*32 + j (the _pack_bits_t
+// order), with early conflict exit.
+inline bool feasible_constraints_wide(const NodeCtx& n, const int32_t* combo,
+                                      int k, uint32_t* r1, uint32_t* r0) {
+  const int cells = 1 << k;
+  const int words = cells / 32;
+  for (int w = 0; w < words; w++) { r1[w] = 0; r0[w] = 0; }
+  for (int c = 0; c < cells; c++) {
+    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+    for (int i = 0; i < k; i++) {
+      const TT& t = n.T[combo[i]];
+      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
+    }
+    bool h1 = tt_any(tt_and(m, n.need1));
+    bool h0 = tt_any(tt_and(m, n.need0));
+    if (h1 && h0) return false;
+    if (h1) r1[c >> 5] |= 1u << (c & 31);
+    if (h0) r0[c >> 5] |= 1u << (c & 31);
+  }
+  return true;
+}
+
 // 5-LUT decomposition test for one (split, outer-function): no inner cell
 // (outer output o, inner pattern m) may mix required-1 and required-0
 // cells (sweeps._lut5_solve_core semantics).
@@ -747,6 +774,202 @@ void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
       }
     }
   }
+}
+
+// 7-LUT stage A (the feasibility filter of the fused single-chunk 7-LUT
+// step, lut7_step_stream's _stream_chunk_constraints + top_k) on the
+// host: scans ranks [0, min(total7, chunk7)) of C(g, 7), rejects tuples
+// containing excluded gates, and returns the top-`solve7` feasible
+// tuples in the kernel's exact order (priority descending, rank
+// ascending; hashed with seed ^ 0x77A1, or scan order when seed < 0).
+//
+// Outputs: *nfeas_out = total feasible count; returns the number of rows
+// written (<= solve7); ranks_out[rows]; req1_out/req0_out[rows][4]
+// packed 128-cell constraints.  The caller ships ONLY these rows to the
+// device pair-matmul solver — nodes with no feasible 7-tuple (the common
+// case) skip the device round trip entirely.
+int64_t sbg_lut7_stage_a(const uint64_t* tables, int32_t g,
+                         const uint64_t* target, const uint64_t* mask,
+                         const int32_t* excl, int32_t n_excl, int64_t total7,
+                         int32_t chunk7, int32_t solve7, int32_t seed,
+                         int64_t* nfeas_out, int32_t* ranks_out,
+                         uint32_t* req1_out, uint32_t* req0_out) {
+  const NodeCtx n = make_node_ctx(tables, g, 0, target, mask, seed);
+  const int32_t sa = (int32_t)(seed ^ 0x77A1);
+  struct Row {
+    uint32_t prio;
+    int32_t rank;
+    uint32_t r1[4], r0[4];
+  };
+  static thread_local std::vector<Row> rows;
+  rows.clear();
+  ComboIter it;
+  it.init(g, 7);
+  int64_t end = total7 < (int64_t)chunk7 ? total7 : (int64_t)chunk7;
+  int64_t nfeas = 0;
+  for (int64_t rank = 0; rank < end; rank++, it.next()) {
+    bool excluded = false;
+    for (int32_t e = 0; e < n_excl && !excluded; e++) {
+      for (int i = 0; i < 7; i++) {
+        if (it.c[i] == excl[e]) { excluded = true; break; }
+      }
+    }
+    if (excluded) continue;
+    Row r;
+    if (!feasible_constraints_wide(n, it.c, 7, r.r1, r.r0)) continue;
+    nfeas++;
+    r.rank = (int32_t)rank;
+    r.prio = sa < 0 ? (uint32_t)((uint32_t)chunk7 - (uint32_t)rank)
+                    : hash_prio((uint32_t)rank, (uint32_t)sa);
+    rows.push_back(r);
+  }
+  *nfeas_out = nfeas;
+  std::stable_sort(rows.begin(), rows.end(),
+                   [](const Row& a, const Row& b) { return a.prio > b.prio; });
+  int64_t take = (int64_t)rows.size() < (int64_t)solve7 ? (int64_t)rows.size()
+                                                        : (int64_t)solve7;
+  for (int64_t t = 0; t < take; t++) {
+    ranks_out[t] = rows[t].rank;
+    for (int w = 0; w < 4; w++) {
+      req1_out[t * 4 + w] = rows[t].r1[w];
+      req0_out[t * 4 + w] = rows[t].r0[w];
+    }
+  }
+  return take;
+}
+
+namespace {
+
+// agree64[f] bit (q1*8 + q0) set iff bits q1, q0 of f are equal — the
+// native form of the kernel's PP table (sweeps.lut7_pair_tables).
+const uint64_t* agree64_table() {
+  static uint64_t tab[256];
+  static bool init = false;
+  if (!init) {
+    for (int f = 0; f < 256; f++) {
+      uint64_t m = 0;
+      for (int a = 0; a < 8; a++) {
+        for (int b = 0; b < 8; b++) {
+          if (((f >> a) & 1) == ((f >> b) & 1)) m |= 1ULL << (a * 8 + b);
+        }
+      }
+      tab[f] = m;
+    }
+    init = true;
+  }
+  return tab;
+}
+
+// Conflict-pair bitmatrix for one (row, ordering): B bit index
+// (p1*8+p0) row, (q1*8+q0) column set iff some required-1 cell with outer
+// pattern p1 / middle pattern q1 and some required-0 cell with (p0, q0)
+// share the same free bit — the native form of the kernel's einsum
+// B[t, (p,r), (q,s)] (sweeps._lut7_solve_core).
+inline void build_pair_matrix(const uint32_t* r1, const uint32_t* r0,
+                              const int32_t* idx, uint64_t B[64]) {
+  for (int i = 0; i < 64; i++) B[i] = 0;
+  for (int x = 0; x < 2; x++) {
+    uint8_t a1[8] = {0}, a0[8] = {0};  // per outer pattern: middle mask
+    for (int p = 0; p < 8; p++) {
+      for (int q = 0; q < 8; q++) {
+        int c = idx[x * 64 + p * 8 + q];
+        if ((r1[c >> 5] >> (c & 31)) & 1) a1[p] |= (uint8_t)(1 << q);
+        if ((r0[c >> 5] >> (c & 31)) & 1) a0[p] |= (uint8_t)(1 << q);
+      }
+    }
+    for (int p1 = 0; p1 < 8; p1++) {
+      if (!a1[p1]) continue;
+      for (int p0 = 0; p0 < 8; p0++) {
+        if (!a0[p0]) continue;
+        uint64_t outer = 0;
+        for (int q1 = 0; q1 < 8; q1++) {
+          if ((a1[p1] >> q1) & 1) outer |= (uint64_t)a0[p0] << (q1 * 8);
+        }
+        B[p1 * 8 + p0] |= outer;
+      }
+    }
+  }
+}
+
+// Middle-pair conflict mask for one outer function over B.
+inline uint64_t outer_conflict_mask(const uint64_t B[64], uint64_t agree_fo) {
+  uint64_t m = 0;
+  for (int i = 0; i < 64; i++) {
+    if ((agree_fo >> i) & 1) m |= B[i];
+  }
+  return m;
+}
+
+}  // namespace
+
+// 7-LUT stage B on the host for SMALL hit lists: for each of the `take`
+// (req1, req0) rows, find the first ordering sigma (scan order 0..69, the
+// kernel's lax.scan order) admitting a decomposition — (outer fo, middle
+// fm) with no conflicting required-1/required-0 cell pair — then select
+// best_t by the kernel's row priority and the (fo, fm) pair by its flat
+// priority.  Bit-identical to sweeps._lut7_solve_core on the same rows.
+// idx_tab: int32[70][128] from sweeps.lut7_pair_tables (pos = x*64+p*8+q).
+// seed: the already-xored solver seed (caller passes seed ^ 0x77A1).
+// out4 = [found, best_t, sigma, fo*256+fm].
+void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
+                          int32_t take, int32_t solve7,
+                          const int32_t* idx_tab, int32_t n_sigma,
+                          int32_t seed, int32_t* out4) {
+  const uint64_t* agree = agree64_table();
+  out4[0] = out4[1] = out4[3] = 0;
+  out4[2] = -1;  // kernel's sel_sigma init: -1 when nothing decomposes
+  if (take > 256) take = 256;  // row cap, enforced by the Python wrapper
+  int32_t sel_sigma[256];
+  bool found_row[256];
+  uint32_t best = 0;
+  int32_t best_t = -1;
+  for (int32_t t = 0; t < take && t < 256; t++) {
+    found_row[t] = false;
+    sel_sigma[t] = -1;
+    for (int32_t s = 0; s < n_sigma && !found_row[t]; s++) {
+      uint64_t B[64];
+      build_pair_matrix(req1 + t * 4, req0 + t * 4, idx_tab + s * 128, B);
+      for (int fo = 0; fo < 256 && !found_row[t]; fo++) {
+        uint64_t m = outer_conflict_mask(B, agree[fo]);
+        for (int fm = 0; fm < 256; fm++) {
+          if ((agree[fm] & m) == 0) {
+            found_row[t] = true;
+            sel_sigma[t] = s;
+            break;
+          }
+        }
+      }
+    }
+    if (found_row[t]) {
+      uint32_t prio = seed < 0 ? (uint32_t)((uint32_t)solve7 - (uint32_t)t)
+                               : hash_prio((uint32_t)t, (uint32_t)seed);
+      if (prio > best) { best = prio; best_t = t; }
+    }
+  }
+  if (best_t < 0) return;
+  // Flat (fo, fm) selection for the winning row at its first-valid sigma
+  // (kernel: priority seed ^ (sigma*2+1) over the 65536 flat pairs).
+  const int32_t s = sel_sigma[best_t];
+  const int32_t sf = (int32_t)(seed ^ (s * 2 + 1));
+  uint64_t B[64];
+  build_pair_matrix(req1 + best_t * 4, req0 + best_t * 4, idx_tab + s * 128,
+                    B);
+  uint32_t fbest = 0;
+  int32_t flat_sel = 0;
+  for (int fo = 0; fo < 256; fo++) {
+    uint64_t m = outer_conflict_mask(B, agree[fo]);
+    for (int fm = 0; fm < 256; fm++) {
+      if (agree[fm] & m) continue;
+      int32_t flat = fo * 256 + fm;
+      uint32_t prio = sf < 0 ? (uint32_t)(65536 - flat)
+                             : hash_prio((uint32_t)flat, (uint32_t)sf);
+      if (prio > fbest) { fbest = prio; flat_sel = flat; }
+    }
+  }
+  out4[0] = 1;
+  out4[1] = best_t;
+  out4[2] = s;
+  out4[3] = flat_sel;
 }
 
 }  // extern "C"

@@ -180,6 +180,36 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.sbg_lut_step.restype = None
 
+        lib.sbg_lut7_stage_a.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.sbg_lut7_stage_a.restype = ctypes.c_int64
+
+        lib.sbg_lut7_solve_small.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_lut7_solve_small.restype = None
+
         _lib = lib
         return lib
 
@@ -402,6 +432,79 @@ def lut_step(
         solve_rows,
         _ptr(w_tab, ctypes.c_uint32),
         _ptr(m_tab, ctypes.c_uint32),
+        seed,
+        _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+def lut7_stage_a(
+    tables64: np.ndarray,
+    g: int,
+    target64: np.ndarray,
+    mask64: np.ndarray,
+    excl: np.ndarray,
+    total7: int,
+    chunk7: int,
+    solve7: int,
+    seed: int,
+):
+    """Host 7-LUT stage A: feasibility over C(g,7) ranks [0, chunk7) with
+    the kernel's exact top-``solve7`` compaction order.  Returns
+    (nfeas, ranks[int32, take], req1[uint32, take, 4], req0[...])."""
+    lib = _require()
+    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
+    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
+    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
+    excl = np.ascontiguousarray(excl, dtype=np.int32)
+    nfeas = np.zeros(1, dtype=np.int64)
+    ranks = np.zeros(solve7, dtype=np.int32)
+    req1 = np.zeros((solve7, 4), dtype=np.uint32)
+    req0 = np.zeros((solve7, 4), dtype=np.uint32)
+    take = lib.sbg_lut7_stage_a(
+        _ptr(tables64, ctypes.c_uint64),
+        g,
+        _ptr(target64, ctypes.c_uint64),
+        _ptr(mask64, ctypes.c_uint64),
+        _ptr(excl, ctypes.c_int32),
+        excl.shape[0],
+        total7,
+        chunk7,
+        solve7,
+        seed,
+        _ptr(nfeas, ctypes.c_int64),
+        _ptr(ranks, ctypes.c_int32),
+        _ptr(req1, ctypes.c_uint32),
+        _ptr(req0, ctypes.c_uint32),
+    )
+    return int(nfeas[0]), ranks[:take], req1[:take], req0[:take]
+
+
+def lut7_solve_small(
+    req1: np.ndarray,
+    req0: np.ndarray,
+    solve7: int,
+    idx_tab: np.ndarray,
+    seed: int,
+) -> np.ndarray:
+    """Host 7-LUT stage-B solve for a small hit list: int32[4]
+    [found, best_t, sigma, fo*256+fm], bit-identical to
+    ``sweeps.lut7_solve`` on the same rows (pass the already-xored solver
+    seed)."""
+    lib = _require()
+    req1 = np.ascontiguousarray(req1, dtype=np.uint32)
+    req0 = np.ascontiguousarray(req0, dtype=np.uint32)
+    if req1.shape[0] > 256:
+        raise ValueError(f"at most 256 rows, got {req1.shape[0]}")
+    idx_tab = np.ascontiguousarray(idx_tab, dtype=np.int32)
+    out = np.zeros(4, dtype=np.int32)
+    lib.sbg_lut7_solve_small(
+        _ptr(req1, ctypes.c_uint32),
+        _ptr(req0, ctypes.c_uint32),
+        req1.shape[0],
+        solve7,
+        _ptr(idx_tab, ctypes.c_int32),
+        idx_tab.shape[0],
         seed,
         _ptr(out, ctypes.c_int32),
     )

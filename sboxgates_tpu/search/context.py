@@ -58,6 +58,17 @@ PIVOT_MIN_TOTAL = 1 << 21
 # native path so both select identical decompositions.
 LUT5_HEAD_SOLVE_ROWS = 1024
 
+# Rows the fused 7-LUT step's stage-B solver takes (lut7_step_stream's
+# solve7 default) — shared with the native stage-A compaction.
+LUT7_HEAD_SOLVE_ROWS = 256
+
+# Hit lists at or below this many rows solve stage B on the host
+# (sbg_lut7_solve_small) instead of dispatching the MXU solver.  A
+# no-decomposition row costs ~2.6 ms natively (full 70-ordering scan;
+# hits exit at the first valid ordering, microseconds) vs ~75 ms for a
+# dispatch through the network-attached chip — break-even near 28 rows.
+NATIVE_LUT7_SOLVE_MAX = 24
+
 # Gate-mode nodes at or below this many gates run on the host via the
 # native runtime (Options.host_small_steps).  Measured through the
 # network-attached chip, the native step wins at EVERY gate-mode size —
@@ -243,7 +254,7 @@ class SearchContext:
         self._pair_combo_np_cache = {}
         self._binom = None
         self._lut5_tabs = None
-        self._lut7_tabs = None
+        self._lut7_tabs_cache = None
         self._native_probe = None
         # Per-phase wall-clock timers (SURVEY §5: the reference has none;
         # report via ``prof.report(stats)`` or the CLI's -vv summary).
@@ -661,26 +672,103 @@ class SearchContext:
         self.stats["lut5_candidates"] += int(v[7])
         return v
 
+    def _lut7_tabs(self):
+        if self._lut7_tabs_cache is None:
+            idx_tab, pp_tab = sweeps.lut7_pair_tables()
+            self._lut7_tabs_cache = (
+                self.place_replicated(idx_tab),
+                self.place_replicated(pp_tab),
+            )
+        return self._lut7_tabs_cache
+
+    def _lut7_step_native(self, st: State, target, mask, inbits) -> np.ndarray:
+        """Hybrid 7-LUT step: native host stage A (feasibility + top-k
+        compaction, bit-identical to the kernel's), then the device
+        pair-matmul stage-B solve over ONLY the hit rows — a node with no
+        feasible 7-tuple (the common case) makes no dispatch at all.
+        Crafts the exact int32[14] lut7_step_stream verdict."""
+        from .. import native
+
+        g = st.num_gates
+        total7 = comb.n_choose_k(g, 7)
+        chunk7 = pick_chunk(max(total7, 1), STREAM_CHUNK[7])
+        solve7 = LUT7_HEAD_SOLVE_ROWS
+        seed = self.next_seed()
+        with self.prof.phase("lut7_stage_a_native"):
+            nfeas, ranks, r1, r0 = native.lut7_stage_a(
+                native.tables32_to_64(st.live_tables()),
+                g,
+                native.tables32_to_64(np.asarray(target)),
+                native.tables32_to_64(np.asarray(mask)),
+                self.excl_array(inbits),
+                total7,
+                chunk7,
+                solve7,
+                seed,
+            )
+        v = np.zeros(14, dtype=np.int32)
+        v[4] = min(total7, chunk7)  # ex7
+        if nfeas:
+            take = ranks.shape[0]
+            sr1 = np.full((solve7, 4), 0xFFFFFFFF, dtype=np.uint32)
+            sr0 = np.full((solve7, 4), 0xFFFFFFFF, dtype=np.uint32)
+            sr1[:take] = r1
+            sr0[:take] = r0
+            if take <= NATIVE_LUT7_SOLVE_MAX:
+                # Small hit list: solve on the host, no dispatch at all.
+                idx_tab, _ = sweeps.lut7_pair_tables()
+                with self.prof.phase("lut7_solve_native"):
+                    sol = native.lut7_solve_small(
+                        r1, r0, solve7, idx_tab, seed ^ 0x77A1
+                    )
+            else:
+                jidx, jpp = self._lut7_tabs()
+                with self.prof.phase("lut7_step"):
+                    sol = self._dispatch(
+                        ("l7solve", solve7),
+                        sweeps.lut7_solve,
+                        (
+                            self.place_replicated(sr1),
+                            self.place_replicated(sr0),
+                            jidx,
+                            jpp,
+                            seed ^ 0x77A1,
+                        ),
+                        shared=(2, 3),
+                    )
+            found, best_t, sigma, flat = (int(x) for x in sol)
+            overflow = nfeas > solve7 and not found
+            v[0] = 1 if found else (2 if overflow else 0)
+            v[1] = int(ranks[best_t]) if best_t < take else 0
+            v[2] = sigma
+            v[3] = flat
+            v[5] = min(nfeas, solve7)
+            v[6:10] = sr1[best_t].view(np.int32)
+            v[10:14] = sr0[best_t].view(np.int32)
+        self.stats["lut7_candidates"] += int(v[4])
+        self.stats["lut7_solved"] += int(v[5])
+        return v
+
     def lut7_step(self, st: State, target, mask, inbits) -> np.ndarray:
         """Whole single-chunk 7-LUT search as ONE dispatch
         (sweeps.lut7_step_stream); only valid when ``lut_head_has7(g)``.
-        Returns the packed int32[14] verdict."""
+        Returns the packed int32[14] verdict.
+
+        With the native runtime, stage A runs on the host and the device
+        is dispatched only when hits exist (:meth:`_lut7_step_native`)."""
+        if self.uses_native_step(st):
+            return self._lut7_step_native(st, target, mask, inbits)
         g = st.num_gates
         total7 = comb.n_choose_k(g, 7)
         chunk7 = pick_chunk(max(total7, 1), STREAM_CHUNK[7])
         tables, _ = self.device_tables(st)
-        if self._lut7_tabs is None:
-            idx_tab, pp_tab = sweeps.lut7_pair_tables()
-            self._lut7_tabs = (
-                self.place_replicated(idx_tab),
-                self.place_replicated(pp_tab),
-            )
-        jidx, jpp = self._lut7_tabs
+        jidx, jpp = self._lut7_tabs()
         with self.prof.phase("lut7_step"):
             v = self._dispatch(
                 ("l7step", tables.shape[0], chunk7),
                 functools.partial(
-                    sweeps.lut7_step_stream, chunk7=chunk7
+                    sweeps.lut7_step_stream, chunk7=chunk7,
+                    solve7=LUT7_HEAD_SOLVE_ROWS,
                 ),
                 (
                     tables,

@@ -513,3 +513,97 @@ def test_lut_step_native_overflow_parity():
         # resume point and examined counters must agree exactly
         assert int(got_n[1]) == int(got_d[1])
         assert tuple(got_n[6:]) == tuple(got_d[6:])
+
+
+@pytest.mark.parametrize("randomize", [False, True])
+def test_lut7_step_native_matches_kernel(randomize):
+    """The hybrid 7-LUT step (native stage A + device solve on hits only)
+    must craft the kernel's exact verdict: same status, same selected
+    tuple/decomposition on hits, same examined/solved counters always."""
+    from sboxgates_tpu.search.context import lut_head_has7
+
+    rng = np.random.default_rng(23)
+    statuses = set()
+    for case in range(12):
+        num_inputs = int(rng.integers(4, 8))
+        extra = int(rng.integers(3, 8))
+        st = _rand_gate_state(rng, num_inputs, extra)
+        if not lut_head_has7(st.num_gates):
+            continue
+        mask = np.asarray(tt.mask_table(num_inputs))
+        inbits = [int(rng.integers(0, num_inputs))] if case % 3 == 2 else []
+        if case % 2 == 0:  # plant a 7-LUT decomposition
+            gids = rng.choice(st.num_gates, size=7, replace=False)
+            t = [st.table(int(x)) for x in gids]
+            outer = tt.eval_lut(int(rng.integers(1, 255)), t[0], t[1], t[2])
+            middle = tt.eval_lut(int(rng.integers(1, 255)), t[3], t[4], outer)
+            target = np.asarray(
+                tt.eval_lut(int(rng.integers(1, 255)), middle, t[5], t[6])
+            ) & mask
+        else:
+            target = np.asarray(
+                rng.integers(0, 2**32, size=8, dtype=np.uint32)
+            ) & mask
+        seed = int(rng.integers(0, 2**31)) if randomize else None
+        ctx_n, ctx_d = _step_contexts(
+            seed, randomize=randomize, lut_graph=True
+        )
+        got_n = tuple(int(x) for x in ctx_n.lut7_step(st, target, mask, inbits))
+        got_d = tuple(int(x) for x in ctx_d.lut7_step(st, target, mask, inbits))
+        # full verdict parity — on misses too (the top feasible row's
+        # rank/constraints and sigma=-1 are reproduced exactly)
+        assert got_n == got_d, f"case {case}: {got_n} vs {got_d}"
+        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        statuses.add(got_d[0])
+    assert {0, 1}.issubset(statuses), statuses
+
+
+@pytest.mark.parametrize("seed", [-1, 991])
+def test_lut7_solve_small_matches_device_solver(seed):
+    """Direct stage-B parity: the host pair-matrix solver must reproduce
+    sweeps.lut7_solve's exact verdict (found/best_t/sigma/flat) on the
+    same rows — including constraint rows derived from real tuples."""
+    import jax.numpy as jnp
+
+    from sboxgates_tpu.ops import sweeps
+
+    rng = np.random.default_rng(5)
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    solve7 = 256
+    hits = 0
+    for case in range(6):
+        take = int(rng.integers(1, 9))
+        if case < 3:
+            # random sparse constraints: usually decomposable
+            density = 0.01 + 0.02 * case
+            r1 = (rng.random((take, 128)) < density)
+            r0 = (rng.random((take, 128)) < density) & ~r1
+            # packing: bit c of word w = cell w*32+c
+            def pack2(b):
+                out = np.zeros((take, 4), np.uint32)
+                for t in range(take):
+                    for c in range(128):
+                        if b[t, c]:
+                            out[t, c // 32] |= np.uint32(1 << (c % 32))
+                return out
+            sr1, sr0 = pack2(r1), pack2(r0)
+        else:
+            # all-conflict rows (never decomposable) mixed with one
+            # moderately-constrained row
+            sr1 = np.full((take, 4), 0xFFFFFFFF, np.uint32)
+            sr0 = np.full((take, 4), 0xFFFFFFFF, np.uint32)
+            sr1[0] = rng.integers(0, 2**32, 4, dtype=np.uint32)
+            sr0[0] = ~sr1[0]
+        pad1 = np.full((solve7, 4), 0xFFFFFFFF, np.uint32); pad1[:take] = sr1
+        pad0 = np.full((solve7, 4), 0xFFFFFFFF, np.uint32); pad0[:take] = sr0
+        dev = np.asarray(sweeps.lut7_solve(
+            jnp.asarray(pad1), jnp.asarray(pad0),
+            jnp.asarray(idx_tab), jnp.asarray(pp_tab), seed,
+        ))
+        nat = native.lut7_solve_small(sr1, sr0, solve7, idx_tab, seed)
+        # full verdict parity including the miss encoding (sigma = -1)
+        assert tuple(int(x) for x in nat) == tuple(int(x) for x in dev), (
+            case, nat, dev,
+        )
+        hits += int(dev[0])
+    assert hits >= 2
