@@ -143,70 +143,70 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.sbg_lut5_search_cpu.restype = ctypes.c_int64
 
         lib.sbg_gate_step.argtypes = [
-            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_int16),
-            ctypes.POINTER(ctypes.c_int16),
-            ctypes.POINTER(ctypes.c_int16),
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
             ctypes.c_int64,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_void_p,
         ]
         lib.sbg_gate_step.restype = None
 
         lib.sbg_lut_step.argtypes = [
-            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_int16),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32,
-            ctypes.c_int64,
-            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int64,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint32),
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_void_p,
         ]
         lib.sbg_lut_step.restype = None
 
         lib.sbg_lut7_stage_a.argtypes = [
-            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_void_p,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int64,
             ctypes.c_int32,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_uint32),
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
         ]
         lib.sbg_lut7_stage_a.restype = ctypes.c_int64
 
         lib.sbg_lut7_solve_small.argtypes = [
-            ctypes.POINTER(ctypes.c_uint32),
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_void_p,
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_void_p,
         ]
         lib.sbg_lut7_solve_small.restype = None
 
@@ -232,6 +232,29 @@ def _require() -> ctypes.CDLL:
 
 def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _buf(arr: np.ndarray, dtype) -> np.ndarray:
+    """Contiguous buffer of exactly ``dtype`` (no-op on the fast path).
+    For the hot per-search-node entry points, operands are passed as raw
+    addresses (c_void_p argtypes): building typed POINTERs costs ~3.5 us
+    per operand and the node steps run tens of thousands of times per
+    search."""
+    if arr.dtype != dtype or not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr, dtype=dtype)
+    return arr
+
+
+def _words(arr: np.ndarray) -> np.ndarray:
+    """256-bit truth-table operand: accepts the uint32[..., 8] layout or
+    its uint64[..., 4] view — identical bytes on the little-endian hosts
+    this targets (the tables32_to_64 assumption).  Never converts values:
+    a dtype cast here would silently corrupt the tables."""
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    if arr.dtype != np.uint32 and arr.dtype != np.uint64:
+        raise TypeError(f"table operand must be uint32/uint64, got {arr.dtype}")
+    return arr
 
 
 # -- wrappers -------------------------------------------------------------
@@ -345,41 +368,32 @@ def gate_step(
     Same int32[4] verdict encoding and bit-identical candidate selection
     as ``sweeps.gate_step_stream`` — see the C entry point's docs.  Match
     tables are int16 arrays from ``SearchContext`` (None disables the
-    NOT-pair / triple stages)."""
+    NOT-pair / triple stages).  Table operands accept the uint32[..., 8]
+    layout or its uint64[..., 4] view (same bytes)."""
     lib = _require()
-    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
-    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
-    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
-    pair_table = np.ascontiguousarray(pair_table, dtype=np.int16)
-    # Hold materialized copies in locals so the buffers outlive the call.
-    not_table = (
-        None if not_table is None
-        else np.ascontiguousarray(not_table, dtype=np.int16)
-    )
+    tables64 = _words(tables64)
+    target64 = _words(target64)
+    mask64 = _words(mask64)
+    pair_table = _buf(pair_table, np.int16)
+    # Hold materialized buffers in locals so they outlive the call.
+    not_table = None if not_table is None else _buf(not_table, np.int16)
     triple_table = (
-        None if triple_table is None
-        else np.ascontiguousarray(triple_table, dtype=np.int16)
+        None if triple_table is None else _buf(triple_table, np.int16)
     )
-
-    def tab_ptr(t):
-        if t is None:
-            return ctypes.POINTER(ctypes.c_int16)()
-        return _ptr(t, ctypes.c_int16)
-
     out = np.zeros(4, dtype=np.int32)
     lib.sbg_gate_step(
-        _ptr(tables64, ctypes.c_uint64),
+        tables64.ctypes.data,
         g,
         bucket,
-        _ptr(target64, ctypes.c_uint64),
-        _ptr(mask64, ctypes.c_uint64),
-        _ptr(pair_table, ctypes.c_int16),
-        tab_ptr(not_table),
-        tab_ptr(triple_table),
+        target64.ctypes.data,
+        mask64.ctypes.data,
+        pair_table.ctypes.data,
+        None if not_table is None else not_table.ctypes.data,
+        None if triple_table is None else triple_table.ctypes.data,
         total3,
         chunk3,
         seed,
-        _ptr(out, ctypes.c_int32),
+        out.ctypes.data,
     )
     return out
 
@@ -407,22 +421,22 @@ def lut_step(
     selection as ``sweeps.lut_step_stream``.  ``excl`` is the list of
     mux-used input bit gate ids (applied by the 5-LUT stream only)."""
     lib = _require()
-    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
-    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
-    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
-    pair_table = np.ascontiguousarray(pair_table, dtype=np.int16)
-    excl = np.ascontiguousarray(excl, dtype=np.int32)
-    w_tab = np.ascontiguousarray(w_tab, dtype=np.uint32)
-    m_tab = np.ascontiguousarray(m_tab, dtype=np.uint32)
+    tables64 = _words(tables64)
+    target64 = _words(target64)
+    mask64 = _words(mask64)
+    pair_table = _buf(pair_table, np.int16)
+    excl = _buf(excl, np.int32)
+    w_tab = _buf(w_tab, np.uint32)
+    m_tab = _buf(m_tab, np.uint32)
     out = np.zeros(8, dtype=np.int32)
     lib.sbg_lut_step(
-        _ptr(tables64, ctypes.c_uint64),
+        tables64.ctypes.data,
         g,
         bucket,
-        _ptr(target64, ctypes.c_uint64),
-        _ptr(mask64, ctypes.c_uint64),
-        _ptr(pair_table, ctypes.c_int16),
-        _ptr(excl, ctypes.c_int32),
+        target64.ctypes.data,
+        mask64.ctypes.data,
+        pair_table.ctypes.data,
+        excl.ctypes.data,
         excl.shape[0],
         total3,
         chunk3,
@@ -430,10 +444,10 @@ def lut_step(
         total5,
         chunk5,
         solve_rows,
-        _ptr(w_tab, ctypes.c_uint32),
-        _ptr(m_tab, ctypes.c_uint32),
+        w_tab.ctypes.data,
+        m_tab.ctypes.data,
         seed,
-        _ptr(out, ctypes.c_int32),
+        out.ctypes.data,
     )
     return out
 
@@ -453,29 +467,29 @@ def lut7_stage_a(
     the kernel's exact top-``solve7`` compaction order.  Returns
     (nfeas, ranks[int32, take], req1[uint32, take, 4], req0[...])."""
     lib = _require()
-    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
-    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
-    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
-    excl = np.ascontiguousarray(excl, dtype=np.int32)
+    tables64 = _words(tables64)
+    target64 = _words(target64)
+    mask64 = _words(mask64)
+    excl = _buf(excl, np.int32)
     nfeas = np.zeros(1, dtype=np.int64)
     ranks = np.zeros(solve7, dtype=np.int32)
     req1 = np.zeros((solve7, 4), dtype=np.uint32)
     req0 = np.zeros((solve7, 4), dtype=np.uint32)
     take = lib.sbg_lut7_stage_a(
-        _ptr(tables64, ctypes.c_uint64),
+        tables64.ctypes.data,
         g,
-        _ptr(target64, ctypes.c_uint64),
-        _ptr(mask64, ctypes.c_uint64),
-        _ptr(excl, ctypes.c_int32),
+        target64.ctypes.data,
+        mask64.ctypes.data,
+        excl.ctypes.data,
         excl.shape[0],
         total7,
         chunk7,
         solve7,
         seed,
-        _ptr(nfeas, ctypes.c_int64),
-        _ptr(ranks, ctypes.c_int32),
-        _ptr(req1, ctypes.c_uint32),
-        _ptr(req0, ctypes.c_uint32),
+        nfeas.ctypes.data,
+        ranks.ctypes.data,
+        req1.ctypes.data,
+        req0.ctypes.data,
     )
     return int(nfeas[0]), ranks[:take], req1[:take], req0[:take]
 
@@ -492,21 +506,21 @@ def lut7_solve_small(
     ``sweeps.lut7_solve`` on the same rows (pass the already-xored solver
     seed)."""
     lib = _require()
-    req1 = np.ascontiguousarray(req1, dtype=np.uint32)
-    req0 = np.ascontiguousarray(req0, dtype=np.uint32)
+    req1 = _buf(req1, np.uint32)
+    req0 = _buf(req0, np.uint32)
     if req1.shape[0] > 256:
         raise ValueError(f"at most 256 rows, got {req1.shape[0]}")
-    idx_tab = np.ascontiguousarray(idx_tab, dtype=np.int32)
+    idx_tab = _buf(idx_tab, np.int32)
     out = np.zeros(4, dtype=np.int32)
     lib.sbg_lut7_solve_small(
-        _ptr(req1, ctypes.c_uint32),
-        _ptr(req0, ctypes.c_uint32),
+        req1.ctypes.data,
+        req0.ctypes.data,
         req1.shape[0],
         solve7,
-        _ptr(idx_tab, ctypes.c_int32),
+        idx_tab.ctypes.data,
         idx_tab.shape[0],
         seed,
-        _ptr(out, ctypes.c_int32),
+        out.ctypes.data,
     )
     return out
 

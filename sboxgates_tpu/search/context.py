@@ -495,11 +495,11 @@ class SearchContext:
         chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
         with self.prof.phase("gate_step_native"):
             v = native.gate_step(
-                native.tables32_to_64(st.live_tables()),
+                st.live_tables(),
                 g,
                 bucket_size(g),
-                native.tables32_to_64(np.asarray(target)),
-                native.tables32_to_64(np.asarray(mask)),
+                np.asarray(target),
+                np.asarray(mask),
                 self.pair_table_np,
                 self.not_table_np if has_not else None,
                 self.triple_table_np if has_triple else None,
@@ -585,11 +585,11 @@ class SearchContext:
         _, w_tab, m_tab = sweeps.lut5_split_tables()
         with self.prof.phase("lut_step_native"):
             v = native.lut_step(
-                native.tables32_to_64(st.live_tables()),
+                st.live_tables(),
                 g,
                 bucket_size(g),
-                native.tables32_to_64(np.asarray(target)),
-                native.tables32_to_64(np.asarray(mask)),
+                np.asarray(target),
+                np.asarray(mask),
                 self.pair_table_np,
                 self.excl_array(inbits),
                 total3,
@@ -696,10 +696,10 @@ class SearchContext:
         seed = self.next_seed()
         with self.prof.phase("lut7_stage_a_native"):
             nfeas, ranks, r1, r0 = native.lut7_stage_a(
-                native.tables32_to_64(st.live_tables()),
+                st.live_tables(),
                 g,
-                native.tables32_to_64(np.asarray(target)),
-                native.tables32_to_64(np.asarray(mask)),
+                np.asarray(target),
+                np.asarray(mask),
                 self.excl_array(inbits),
                 total7,
                 chunk7,
