@@ -339,27 +339,6 @@ inline bool tt_eq_mask(const TT& a, const TT& b, const TT& m) {
   return !tt_any(tt_and(tt_xor(a, b), m));
 }
 
-// Per-tuple cell constraints: bit c of req1/req0 set when cell c contains
-// a required-1 / required-0 position.  Cell index bit (k-1-i) is input i's
-// value (input 0 on the MSB) — the sweeps._cell_constraints convention.
-inline void cell_constraints(const TT* tabs, int k, const TT& need1,
-                             const TT& need0, uint32_t* req1,
-                             uint32_t* req0) {
-  const int cells = 1 << k;
-  uint32_t r1 = 0, r0 = 0;
-  for (int c = 0; c < cells; c++) {
-    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
-    for (int i = 0; i < k; i++) {
-      const TT& t = tabs[i];
-      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
-    }
-    if (tt_any(tt_and(m, need1))) r1 |= 1u << c;
-    if (tt_any(tt_and(m, need0))) r0 |= 1u << c;
-  }
-  *req1 = r1;
-  *req0 = r0;
-}
-
 // Shared operands of one search node (either mode).
 struct NodeCtx {
   const TT* T;
@@ -406,6 +385,32 @@ inline int32_t scan_stage(const NodeCtx& n, int32_t* x0) {
   return 0;
 }
 
+// Feasibility + packed cell constraints with early conflict exit (the
+// reference's check_n_lut_possible shape, lut.c:34-66): returns false as
+// soon as a cell holds both a required-1 and a required-0 position.
+// Cell index bit (k-1-i) is input i's value (input 0 on the MSB) — the
+// sweeps._cell_constraints convention.
+inline bool feasible_constraints(const NodeCtx& n, const int32_t* combo,
+                                 int k, uint32_t* r1, uint32_t* r0) {
+  const int cells = 1 << k;
+  uint32_t a1 = 0, a0 = 0;
+  for (int c = 0; c < cells; c++) {
+    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+    for (int i = 0; i < k; i++) {
+      const TT& t = n.T[combo[i]];
+      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
+    }
+    bool h1 = tt_any(tt_and(m, n.need1));
+    bool h0 = tt_any(tt_and(m, n.need0));
+    if (h1 && h0) return false;
+    if (h1) a1 |= 1u << c;
+    if (h0) a0 |= 1u << c;
+  }
+  *r1 = a1;
+  *r0 = a0;
+  return true;
+}
+
 // Steps 3 / 4a: one function over all gate pairs, via the 4-cell
 // constraint key and a match table (sboxgates.c:323-350, 366-386).  Pair
 // index runs over the bucket-row upper-triangular grid in np.triu_indices
@@ -426,10 +431,9 @@ inline bool pair_stage(const NodeCtx& n, const int16_t* mt, uint32_t sx,
         (int64_t)i * n.bucket - (int64_t)i * (i + 1) / 2 - i - 1;
     for (int32_t j = i + 1; j < n.g; j++) {
       const int64_t idx = row0 + j;
-      TT tabs[2] = {n.T[i], n.T[j]};
+      const int32_t combo[2] = {i, j};
       uint32_t r1, r0;
-      cell_constraints(tabs, 2, n.need1, n.need0, &r1, &r0);
-      if (r1 & r0) continue;
+      if (!feasible_constraints(n, combo, 2, &r1, &r0)) continue;
       int16_t slot = mt[r1 | ((r1 | r0) << 4)];
       if (slot < 0) continue;
       uint32_t prio = s < 0 ? (uint32_t)(N - idx)
@@ -459,30 +463,6 @@ struct ComboIter {
     for (int32_t j = i + 1; j < k; j++) c[j] = c[j - 1] + 1;
   }
 };
-
-// Feasibility + packed cell constraints with early conflict exit (the
-// reference's check_n_lut_possible shape, lut.c:34-66): returns false as
-// soon as a cell holds both a required-1 and a required-0 position.
-inline bool feasible_constraints(const NodeCtx& n, const int32_t* combo,
-                                 int k, uint32_t* r1, uint32_t* r0) {
-  const int cells = 1 << k;
-  uint32_t a1 = 0, a0 = 0;
-  for (int c = 0; c < cells; c++) {
-    TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
-    for (int i = 0; i < k; i++) {
-      const TT& t = n.T[combo[i]];
-      m = tt_and(m, ((c >> (k - 1 - i)) & 1) ? t : tt_not(t));
-    }
-    bool h1 = tt_any(tt_and(m, n.need1));
-    bool h0 = tt_any(tt_and(m, n.need0));
-    if (h1 && h0) return false;
-    if (h1) a1 |= 1u << c;
-    if (h0) a0 |= 1u << c;
-  }
-  *r1 = a1;
-  *r0 = a0;
-  return true;
-}
 
 // Wide (k > 5) variant of feasible_constraints: packed cell constraints
 // in uint32 words, bit j of word w = cell w*32 + j (the _pack_bits_t
@@ -842,22 +822,25 @@ namespace {
 
 // agree64[f] bit (q1*8 + q0) set iff bits q1, q0 of f are equal — the
 // native form of the kernel's PP table (sweeps.lut7_pair_tables).
+// Magic-static init: thread-safe under concurrent native calls (ctypes
+// releases the GIL; restart threads may race here).
 const uint64_t* agree64_table() {
-  static uint64_t tab[256];
-  static bool init = false;
-  if (!init) {
-    for (int f = 0; f < 256; f++) {
-      uint64_t m = 0;
-      for (int a = 0; a < 8; a++) {
-        for (int b = 0; b < 8; b++) {
-          if (((f >> a) & 1) == ((f >> b) & 1)) m |= 1ULL << (a * 8 + b);
+  struct Tab {
+    uint64_t v[256];
+    Tab() {
+      for (int f = 0; f < 256; f++) {
+        uint64_t m = 0;
+        for (int a = 0; a < 8; a++) {
+          for (int b = 0; b < 8; b++) {
+            if (((f >> a) & 1) == ((f >> b) & 1)) m |= 1ULL << (a * 8 + b);
+          }
         }
+        v[f] = m;
       }
-      tab[f] = m;
     }
-    init = true;
-  }
-  return tab;
+  };
+  static const Tab tab;
+  return tab.v;
 }
 
 // Conflict-pair bitmatrix for one (row, ordering): B bit index

@@ -441,21 +441,25 @@ class SearchContext:
         (orders of magnitude slower on network-attached hardware), which
         should never happen silently."""
         if self._native_probe is None:
+            why = None
             try:
                 from .. import native
 
                 self._native_probe = native.available()
-                if not self._native_probe and self.opt.host_small_steps:
-                    import warnings
-
-                    warnings.warn(
-                        "native host runtime unavailable "
-                        f"({native.build_error()}); small-state search "
-                        "nodes will fall back to device dispatches",
-                        RuntimeWarning,
-                    )
-            except Exception:
+                if not self._native_probe:
+                    why = str(native.build_error())
+            except Exception as e:  # import/ABI failure — still warn
                 self._native_probe = False
+                why = repr(e)
+            if why is not None and self.opt.host_small_steps:
+                import warnings
+
+                warnings.warn(
+                    f"native host runtime unavailable ({why}); "
+                    "small-state search nodes will fall back to device "
+                    "dispatches",
+                    RuntimeWarning,
+                )
         return self._native_probe
 
     def uses_native_step(self, st: State) -> bool:

@@ -1,0 +1,28 @@
+"""Packaging hook: ship the native runtime source inside the package.
+
+``csrc/runtime.cpp`` is the canonical source, built on demand by
+``sboxgates_tpu.native`` with the host's C++ compiler.  Installed
+environments don't have the repo's ``csrc/`` directory, so ``build_py``
+drops a copy at ``sboxgates_tpu/native/runtime.cpp`` — the loader's
+second candidate path (see ``native._SRC_CANDIDATES``).  Metadata lives
+in pyproject.toml.
+"""
+
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_runtime(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "csrc", "runtime.cpp")
+        dst_dir = os.path.join(self.build_lib, "sboxgates_tpu", "native")
+        if os.path.exists(src) and os.path.isdir(dst_dir):
+            shutil.copy(src, os.path.join(dst_dir, "runtime.cpp"))
+
+
+setup(cmdclass={"build_py": build_py_with_runtime})
