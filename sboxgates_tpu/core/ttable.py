@@ -111,6 +111,32 @@ def eq_mask(a, b, mask):
     return is_zero((a ^ b) & mask)
 
 
+# Direct expressions per gate nibble (enum value = truth table with
+# f(1,1)=bit0, f(1,0)=bit1, f(0,1)=bit2, f(0,0)=bit3): 1-2 elementwise
+# ops instead of the 11-op minterm sum — the host search engine evaluates
+# one gate at a time, where numpy per-op overhead dominates.  Entries for
+# the pass-through functions (A, B) return the input array itself; no
+# caller mutates gate tables in place.
+_GATE2_DIRECT = {
+    0b0000: lambda a, b: a & ~a,
+    0b0001: lambda a, b: a & b,
+    0b0010: lambda a, b: a & ~b,
+    0b0011: lambda a, b: a,
+    0b0100: lambda a, b: ~a & b,
+    0b0101: lambda a, b: b,
+    0b0110: lambda a, b: a ^ b,
+    0b0111: lambda a, b: a | b,
+    0b1000: lambda a, b: ~(a | b),
+    0b1001: lambda a, b: ~(a ^ b),
+    0b1010: lambda a, b: ~b,
+    0b1011: lambda a, b: a | ~b,
+    0b1100: lambda a, b: ~a,
+    0b1101: lambda a, b: ~a | b,
+    0b1110: lambda a, b: ~(a & b),
+    0b1111: lambda a, b: ~(a & ~a),
+}
+
+
 def eval_gate2(fun, a, b):
     """Evaluates a 2-input gate given its 4-bit function value.
 
@@ -120,18 +146,18 @@ def eval_gate2(fun, a, b):
         f(1,1) = bit0,  f(1,0) = bit1,  f(0,1) = bit2,  f(0,0) = bit3
 
     ``fun`` may be scalar or an array broadcastable against ``a``/``b``.
-    Implemented as a sum of minterms — four fused elementwise ops on the VPU
-    instead of the reference's 16-way switch (boolfunc.c:136-157).
+    Scalar functions dispatch to direct 1-2-op expressions; array
+    functions use the sum-of-minterms form (four fused elementwise ops on
+    the VPU instead of the reference's 16-way switch, boolfunc.c:136-157).
     """
     f = fun
+    if isinstance(f, (int, np.integer)):
+        return _GATE2_DIRECT[int(f) & 0xF](a, b)
     b0 = -((f >> 0) & 1)  # all-ones where bit set (two's complement trick)
     b1 = -((f >> 1) & 1)
     b2 = -((f >> 2) & 1)
     b3 = -((f >> 3) & 1)
-    if isinstance(f, (int, np.integer)):
-        b0, b1, b2, b3 = (np.uint32(x & 0xFFFFFFFF) for x in (b0, b1, b2, b3))
-    else:
-        b0, b1, b2, b3 = (x.astype(a.dtype) for x in (b0, b1, b2, b3))
+    b0, b1, b2, b3 = (x.astype(a.dtype) for x in (b0, b1, b2, b3))
     return (b0 & a & b) | (b1 & a & ~b) | (b2 & ~a & b) | (b3 & ~a & ~b)
 
 

@@ -219,9 +219,11 @@ class RestartContext(SearchContext):
 
     def __init__(self, base: SearchContext, seed: int, rdv: Rendezvous):
         # Share every derived structure (match tables, combo caches, binom);
-        # only the PRNG and counters are per-thread.
+        # only the PRNG (and its seed batch buffer) and counters are
+        # per-thread.
         self.__dict__.update(base.__dict__)
         self.rng = np.random.default_rng(seed)
+        self._seed_buf = (np.empty(0, dtype=np.int64), 0)
         self.stats = dict.fromkeys(base.stats, 0)
         self.rdv = rdv
 

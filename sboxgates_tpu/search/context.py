@@ -67,7 +67,20 @@ LUT7_HEAD_SOLVE_ROWS = 256
 # no-decomposition row costs ~2.6 ms natively (full 70-ordering scan;
 # hits exit at the first valid ordering, microseconds) vs ~75 ms for a
 # dispatch through the network-attached chip — break-even near 28 rows.
+# On a CPU backend the "dispatch" is itself slow host compute (the
+# pair-matmul solver without an MXU), so the native solver takes every
+# list it can hold.
 NATIVE_LUT7_SOLVE_MAX = 24
+
+
+@functools.lru_cache(maxsize=None)
+def _native_lut7_solve_max() -> int:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # capped at the native solver's 256-row limit (lut7_solve_small)
+        return min(LUT7_HEAD_SOLVE_ROWS, 256)
+    return NATIVE_LUT7_SOLVE_MAX
 
 # Gate-mode nodes at or below this many gates run on the host via the
 # native runtime (Options.host_small_steps).  Measured through the
@@ -252,6 +265,7 @@ class SearchContext:
         self.triple_table = jnp.asarray(self.triple_table_np)
         self._pair_combo_cache = {}
         self._pair_combo_np_cache = {}
+        self._seed_buf = (np.empty(0, dtype=np.int64), 0)
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
@@ -290,10 +304,19 @@ class SearchContext:
     def next_seed(self) -> int:
         """Per-dispatch kernel seed.  Negative when not randomizing: the
         kernels then select deterministically in scan order instead of by
-        hashed priority (the reference's unshuffled scan)."""
-        if self.opt.randomize:
-            return int(self.rng.integers(0, 2**31))
-        return -1
+        hashed priority (the reference's unshuffled scan).
+
+        Seeds are drawn from the context PRNG in batches — a search makes
+        tens of thousands of draws and per-call ``rng.integers`` overhead
+        is measurable on the native node path."""
+        if not self.opt.randomize:
+            return -1
+        buf, pos = self._seed_buf
+        if pos >= len(buf):
+            buf = self.rng.integers(0, 2**31, size=256)
+            pos = 0
+        self._seed_buf = (buf, pos + 1)
+        return int(buf[pos])
 
     def device_tables(self, st: State):
         """Zero-padded [bucket, 8] live tables (replicated across the mesh)."""
@@ -718,7 +741,7 @@ class SearchContext:
             sr0 = np.full((solve7, 4), 0xFFFFFFFF, dtype=np.uint32)
             sr1[:take] = r1
             sr0[:take] = r0
-            if take <= NATIVE_LUT7_SOLVE_MAX:
+            if take <= _native_lut7_solve_max():
                 # Small hit list: solve on the host, no dispatch at all.
                 idx_tab, _ = sweeps.lut7_pair_tables()
                 with self.prof.phase("lut7_solve_native"):
