@@ -350,6 +350,58 @@ def lut5_search_cpu(
     }
 
 
+class GateStepCaller:
+    """Per-context fast path for :func:`gate_step`: pre-resolves the match
+    tables' raw addresses once (holding the buffers alive) so each node
+    call only touches the three per-call operands.  The caller must pass
+    contiguous uint32/uint64 table operands (``State.live_tables`` slices
+    and numpy target/mask arrays are)."""
+
+    __slots__ = ("_fn", "_bufs", "pair_a", "not_a", "triple_a")
+
+    def __init__(
+        self,
+        pair_table: np.ndarray,
+        not_table: Optional[np.ndarray],
+        triple_table: Optional[np.ndarray],
+    ):
+        self._fn = _require().sbg_gate_step
+        pair_table = _buf(pair_table, np.int16)
+        not_table = (
+            None if not_table is None else _buf(not_table, np.int16)
+        )
+        triple_table = (
+            None if triple_table is None else _buf(triple_table, np.int16)
+        )
+        self._bufs = (pair_table, not_table, triple_table)  # keep alive
+        self.pair_a = pair_table.ctypes.data
+        self.not_a = None if not_table is None else not_table.ctypes.data
+        self.triple_a = (
+            None if triple_table is None else triple_table.ctypes.data
+        )
+
+    def __call__(
+        self, tables, g, bucket, target, mask, use_not, use_triple,
+        total3, chunk3, seed,
+    ) -> np.ndarray:
+        out = np.zeros(4, dtype=np.int32)
+        self._fn(
+            tables.ctypes.data,
+            g,
+            bucket,
+            target.ctypes.data,
+            mask.ctypes.data,
+            self.pair_a,
+            self.not_a if use_not else None,
+            self.triple_a if use_triple else None,
+            total3,
+            chunk3,
+            seed,
+            out.ctypes.data,
+        )
+        return out
+
+
 def gate_step(
     tables64: np.ndarray,
     g: int,
@@ -369,33 +421,23 @@ def gate_step(
     as ``sweeps.gate_step_stream`` — see the C entry point's docs.  Match
     tables are int16 arrays from ``SearchContext`` (None disables the
     NOT-pair / triple stages).  Table operands accept the uint32[..., 8]
-    layout or its uint64[..., 4] view (same bytes)."""
-    lib = _require()
-    tables64 = _words(tables64)
-    target64 = _words(target64)
-    mask64 = _words(mask64)
-    pair_table = _buf(pair_table, np.int16)
-    # Hold materialized buffers in locals so they outlive the call.
-    not_table = None if not_table is None else _buf(not_table, np.int16)
-    triple_table = (
-        None if triple_table is None else _buf(triple_table, np.int16)
-    )
-    out = np.zeros(4, dtype=np.int32)
-    lib.sbg_gate_step(
-        tables64.ctypes.data,
+    layout or its uint64[..., 4] view (same bytes).
+
+    One-shot form of :class:`GateStepCaller` (which encodes the C ABI
+    exactly once); hot per-node loops should hold a caller instead."""
+    caller = GateStepCaller(pair_table, not_table, triple_table)
+    return caller(
+        _words(tables64),
         g,
         bucket,
-        target64.ctypes.data,
-        mask64.ctypes.data,
-        pair_table.ctypes.data,
-        None if not_table is None else not_table.ctypes.data,
-        None if triple_table is None else triple_table.ctypes.data,
+        _words(target64),
+        _words(mask64),
+        not_table is not None,
+        triple_table is not None,
         total3,
         chunk3,
         seed,
-        out.ctypes.data,
     )
-    return out
 
 
 def lut_step(

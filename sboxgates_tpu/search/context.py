@@ -266,6 +266,7 @@ class SearchContext:
         self._pair_combo_cache = {}
         self._pair_combo_np_cache = {}
         self._seed_buf = (np.empty(0, dtype=np.int64), 0)
+        self._gate_step_caller = None
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
@@ -513,23 +514,26 @@ class SearchContext:
     def _gate_step_native(self, st: State, target, mask):
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical
         verdict to the device kernel, without the dispatch."""
-        from .. import native
-
         g = st.num_gates
         has_not = bool(self.not_entries) and not self.opt.lut_graph
         has_triple = not self.opt.lut_graph and g >= 3
         total3 = comb.n_choose_k(g, 3) if has_triple else 0
         chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
+        if self._gate_step_caller is None:
+            from .. import native
+
+            self._gate_step_caller = native.GateStepCaller(
+                self.pair_table_np, self.not_table_np, self.triple_table_np
+            )
         with self.prof.phase("gate_step_native"):
-            v = native.gate_step(
+            v = self._gate_step_caller(
                 st.live_tables(),
                 g,
                 bucket_size(g),
                 np.asarray(target),
                 np.asarray(mask),
-                self.pair_table_np,
-                self.not_table_np if has_not else None,
-                self.triple_table_np if has_triple else None,
+                has_not,
+                has_triple,
                 total3,
                 chunk3,
                 self.next_seed(),
