@@ -33,7 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
             "target S-box. TPU-native reimplementation of dansarie/sboxgates."
         ),
     )
-    p.add_argument("input", nargs="?", help="S-box table file (or XML state for -c/-d)")
+    p.add_argument("input", nargs="*",
+                   help="S-box table file (or XML state for -c/-d); several "
+                        "files run as one batched multi-S-box search")
     p.add_argument("-a", "--available-gates", type=int, default=None, metavar="NUM",
                    help="bitfield of available 2-input gate types (default AND+OR+XOR = 194)")
     p.add_argument("-c", "--convert-c", action="store_true",
@@ -64,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the -i restarts as one device batch "
                         "(independent restarts, vmapped sweeps) instead of "
                         "a serial loop")
+    p.add_argument("--permute-sweep", action="store_true",
+                   help="search every input permutation (all 2^n -p values) "
+                        "as one batched sweep; states land in pXX/ "
+                        "subdirectories of --output-dir")
+    p.add_argument("--serial-jobs", action="store_true",
+                   help="run multi-S-box / permute-sweep jobs serially "
+                        "instead of as a rendezvous batch (automatic under "
+                        "--mesh)")
     p.add_argument("--serial-mux", action="store_true",
                    help="disable concurrent exploration of mux select bits "
                         "(single in-flight device sweep at a time)")
@@ -103,15 +113,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _err("Cannot combine c and d options.")
     if args.lut and args.sat_metric:
         return _err("SAT metric can not be combined with LUT graph generation.")
-    if args.input is None:
+    if not args.input:
         return _err("Input file name argument missing.")
+    multibox = len(args.input) > 1
+    if multibox and (args.convert_c or args.convert_dot):
+        return _err("Cannot convert more than one file.")
+    if multibox and args.graph is not None:
+        return _err("Cannot combine -g with multiple S-box files.")
+    if args.permute_sweep and (multibox or args.graph is not None):
+        return _err("--permute-sweep takes a single S-box file and no -g.")
+    if args.permute_sweep and args.permute:
+        return _err("--permute-sweep replaces -p; do not combine them.")
 
     # Conversion mode: deserialize -> emit, no search (sboxgates.c:1097-1114).
     if args.convert_c or args.convert_dot:
         from .codegen import c_function_text, digraph_text
 
         try:
-            st = load_state(args.input)
+            st = load_state(args.input[0])
         except (OSError, StateLoadError) as e:
             return _err(f"Error when reading state file. ({e})")
         if args.convert_c:
@@ -155,7 +174,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             log = lambda s: None  # noqa: E731
 
     try:
-        sbox, num_inputs = load_sbox(args.input, args.permute)
+        sbox, num_inputs = load_sbox(args.input[0], args.permute)
     except OSError:
         return _err("Error when opening target S-box file.")
     except SboxError as e:
@@ -201,6 +220,43 @@ def main(argv: Optional[List[str]] = None) -> int:
             bf.GATE_NAMES[f.fun] + " " for f in ctx.avail_not))
         log("Generated 3-input gates: " + "".join(
             "%02x " % f.fun for f in ctx.avail_3))
+
+    if multibox or args.permute_sweep:
+        # BASELINE configs 4-5: the sweep is the batch axis (multibox.py).
+        from .search.multibox import (
+            load_box_jobs,
+            permute_sweep_jobs,
+            search_boxes_all_outputs,
+            search_boxes_one_output,
+        )
+
+        try:
+            if multibox:
+                boxes = load_box_jobs(args.input, args.permute)
+            else:
+                boxes = permute_sweep_jobs(sbox, num_inputs)
+        except OSError:
+            return _err("Error when opening target S-box file.")
+        except SboxError as e:
+            return _err(str(e))
+        batched = False if (args.serial_jobs or args.mesh) else None
+        try:
+            if args.single_output != -1:
+                search_boxes_one_output(
+                    ctx, boxes, args.single_output,
+                    save_dir=args.output_dir, log=log, batched=batched,
+                )
+            else:
+                search_boxes_all_outputs(
+                    ctx, boxes, save_dir=args.output_dir, log=log,
+                    batched=batched,
+                )
+        except ValueError as e:
+            return _err(f"Error: {e}")
+        if args.verbose >= 2:
+            log("")
+            log(ctx.prof.report(ctx.stats))
+        return 0
 
     if args.graph is None:
         st = State.init_inputs(num_inputs)

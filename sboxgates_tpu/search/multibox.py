@@ -1,0 +1,271 @@
+"""Batched multi-S-box and permutation-sweep drivers (BASELINE configs
+4-5).
+
+The reference searches one S-box per process invocation and applies one
+``--permute`` value at load time (sboxgates.c:661-688, 1021-1031) —
+sweeping boxes or permutations means re-running the binary.  Here the
+sweep itself is the batch axis: every (box | permutation) x iteration
+attempt is an independent ``create_circuit`` job, and when batching is on
+their device sweeps rendezvous into vmapped dispatches
+(:mod:`sboxgates_tpu.search.batched`) — one device round trip per search
+round across the whole sweep instead of one per job.
+
+Execution modes:
+
+- ``batched=True`` (default off a mesh): all jobs of a round run
+  concurrently through :func:`run_batched_circuits`.  Jobs are
+  independent — no cross-job budget ratchet, the same semantics as the
+  reference run once per (box, permutation) in parallel processes.
+- ``batched=False`` (forced under a mesh, where GSPMD owns the devices):
+  jobs run serially in job order.
+
+Both modes fold results through the same per-box :class:`BeamFold`, so
+the kept states are identical given identical per-job outcomes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import ttable as tt
+from ..graph.state import GATES, NO_GATE, State
+from ..graph.xmlio import save_state
+from .context import SearchContext
+from .kwan import create_circuit
+from .orchestrator import BeamFold, make_targets, sbox_num_outputs
+
+
+@dataclass
+class BoxJob:
+    """One S-box (or one permutation of one) in a batched sweep."""
+
+    name: str
+    sbox: np.ndarray  # uint8[256]
+    num_inputs: int
+    targets: List = field(default_factory=list)
+    n_out: int = 0
+    beam: Optional[BeamFold] = None
+    done: bool = False
+
+    def __post_init__(self):
+        if not self.targets:
+            self.targets = make_targets(self.sbox)
+        self.n_out = sbox_num_outputs(self.targets)
+        self.mask = tt.mask_table(self.num_inputs)
+
+
+# Re-exported for driver callers; the transform lives with the loader so
+# the sweep and the single -p path can never diverge.
+from ..utils.sbox import permuted_box  # noqa: E402,F401
+
+
+# Concurrent-thread cap per rendezvous wave: run_batched_circuits spawns
+# one OS thread per job and the rendezvous needs every live thread
+# resident at once, so unbounded sweeps (256 permutations x 8 outputs =
+# 2048 jobs) would thrash the GIL and memory.  32 matches the largest
+# vmap bucket, so a full wave still merges into at most one dispatch per
+# sweep kind.
+MAX_WAVE_JOBS = 32
+
+
+def _run_jobs(
+    ctx: SearchContext,
+    jobs: List[Tuple[State, np.ndarray, np.ndarray]],
+    batched: bool,
+) -> List[Tuple[State, int]]:
+    if batched and len(jobs) > 1:
+        from .batched import run_batched_circuits
+
+        out = []
+        for lo in range(0, len(jobs), MAX_WAVE_JOBS):
+            out.extend(run_batched_circuits(ctx, jobs[lo : lo + MAX_WAVE_JOBS]))
+        return out
+    out = []
+    for nst, target, mask in jobs:
+        out.append((nst, create_circuit(ctx, nst, target, mask, [])))
+    return out
+
+
+def _auto_batched(ctx: SearchContext, batched: Optional[bool]) -> bool:
+    if batched is None:
+        return ctx.mesh_plan is None
+    if batched and ctx.mesh_plan is not None:
+        raise ValueError(
+            "batched multi-box execution is host-threaded and cannot run "
+            "under a mesh (GSPMD owns the devices); pass batched=False"
+        )
+    return batched
+
+
+def _save_dir_for(save_dir: Optional[str], name: str) -> Optional[str]:
+    """Per-box subdirectory so the reference-format filenames (which do
+    not encode the box) stay unambiguous."""
+    if save_dir is None:
+        return None
+    d = os.path.join(save_dir, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def search_boxes_one_output(
+    ctx: SearchContext,
+    boxes: Sequence[BoxJob],
+    output: int,
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+    batched: Optional[bool] = None,
+) -> dict:
+    """Single-output search across every box: ``iterations`` attempts per
+    box, all attempts of all boxes as one batch round.  Returns
+    {box.name: [successful states, best last]}.
+
+    Unlike the serial single-box driver, attempts are independent (no
+    budget ratchet between a box's iterations) — parallel-restart
+    semantics, reference-equivalent to one process per attempt.
+    """
+    batched = _auto_batched(ctx, batched)
+    r = ctx.opt.iterations
+    jobs, meta = [], []
+    for box in boxes:
+        if output >= box.n_out:
+            raise ValueError(
+                f"{box.name}: can't generate output bit {output}; "
+                f"box only has {box.n_out} outputs"
+            )
+        for _ in range(r):
+            jobs.append(
+                (State.init_inputs(box.num_inputs), box.targets[output], box.mask)
+            )
+            meta.append(box)
+    log(
+        f"Searching output {output} of {len(boxes)} S-boxes, "
+        f"{r} iteration{'s' if r != 1 else ''} each "
+        f"({len(jobs)} {'batched' if batched else 'serial'} jobs)..."
+    )
+    results: dict = {box.name: [] for box in boxes}
+    for box, (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):
+        if out == NO_GATE:
+            log(f"{box.name}: not found.")
+            continue
+        nst.outputs[output] = out
+        log(
+            f"{box.name}: {nst.num_gates - nst.num_inputs} gates. "
+            f"SAT metric: {nst.sat_metric}"
+        )
+        d = _save_dir_for(save_dir, box.name)
+        if d is not None:
+            save_state(nst, d)
+        results[box.name].append(nst)
+    for states in results.values():
+        if ctx.opt.metric == GATES:
+            states.sort(key=lambda s: -s.num_gates)
+        else:
+            states.sort(key=lambda s: -s.sat_metric)
+    return results
+
+
+def search_boxes_all_outputs(
+    ctx: SearchContext,
+    boxes: Sequence[BoxJob],
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+    batched: Optional[bool] = None,
+) -> dict:
+    """Full-graph greedy beam search for every box, run in lockstep
+    rounds: each round gathers every (box x start-state x missing-output
+    x iteration) attempt across ALL boxes into one batch, then folds
+    results through each box's own beam (identical beam semantics to the
+    single-box driver, sboxgates.c:701-788).  Boxes whose graphs complete
+    drop out of later rounds.  Returns {box.name: final beam states}.
+    """
+    batched = _auto_batched(ctx, batched)
+    opt = ctx.opt
+    beams = {box.name: [State.init_inputs(box.num_inputs)] for box in boxes}
+    final: dict = {box.name: [] for box in boxes}
+    live = list(boxes)
+    rnd = 0
+    while live:
+        rnd += 1
+        jobs, meta = [], []
+        for box in live:
+            box.beam = BeamFold(opt.metric, log)
+            for _ in range(opt.iterations):
+                for start in beams[box.name]:
+                    for output in range(box.n_out):
+                        if start.outputs[output] != NO_GATE:
+                            continue
+                        nst = start.copy()
+                        # Round-start budgets (the batched branch of the
+                        # single-box driver does the same: attempts in a
+                        # round are independent, no mid-round tightening).
+                        if opt.metric == GATES:
+                            nst.max_gates = box.beam.max_gates
+                        else:
+                            nst.max_sat_metric = box.beam.max_sat_metric
+                        jobs.append((nst, box.targets[output], box.mask))
+                        meta.append((box, output))
+        log(
+            f"Round {rnd}: {len(jobs)} "
+            f"{'batched' if batched else 'serial'} jobs over "
+            f"{len(live)} box{'es' if len(live) != 1 else ''}..."
+        )
+        for (box, output), (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):
+            nst.outputs[output] = out
+            # Checkpoint every solution, kept or not (sboxgates.c:746).
+            if box.beam.consider(nst, output):
+                d = _save_dir_for(save_dir, box.name)
+                if d is not None:
+                    save_state(nst, d)
+        still = []
+        for box in live:
+            if not box.beam.states:
+                log(f"{box.name}: no solution this round; giving up.")
+                beams[box.name] = []
+                continue
+            beams[box.name] = box.beam.states
+            n_done = sum(
+                1 for o in box.beam.states[0].outputs if o != NO_GATE
+            )
+            if n_done >= box.n_out:
+                final[box.name] = box.beam.states
+                log(
+                    f"{box.name}: complete, "
+                    f"{box.beam.states[0].num_gates - box.beam.states[0].num_inputs}"
+                    f" gates."
+                )
+            else:
+                still.append(box)
+        live = still
+    return final
+
+
+def load_box_jobs(paths: Sequence[str], permute: int = 0) -> List[BoxJob]:
+    """BoxJobs from S-box files, named by file stem.  Same-named files
+    from different directories are disambiguated with a ``~N`` suffix —
+    every driver keys its beams/results/save-dirs by name, so collisions
+    would silently merge two different boxes."""
+    from ..utils.sbox import load_sbox
+
+    jobs = []
+    seen: dict = {}
+    for p in paths:
+        sbox, n = load_sbox(p, permute)
+        stem = os.path.splitext(os.path.basename(p))[0]
+        seen[stem] = seen.get(stem, 0) + 1
+        name = stem if seen[stem] == 1 else f"{stem}~{seen[stem]}"
+        jobs.append(BoxJob(name, sbox, n))
+    return jobs
+
+
+def permute_sweep_jobs(sbox: np.ndarray, num_inputs: int) -> List[BoxJob]:
+    """One BoxJob per input permutation (all 2^n), named ``pXX`` (hex).
+    The driver-level analog of re-running the reference once per
+    ``--permute`` value."""
+    return [
+        BoxJob(f"p{p:02x}", permuted_box(sbox, num_inputs, p), num_inputs)
+        for p in range(1 << num_inputs)
+    ]

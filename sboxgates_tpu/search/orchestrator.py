@@ -17,6 +17,47 @@ from .kwan import create_circuit
 BEAM_WIDTH = 20  # reference: out_states[20], sboxgates.c:704,713
 
 
+class BeamFold:
+    """Beam insertion with the metric ratchet (sboxgates.c:748-771):
+    keeps up to BEAM_WIDTH states at the best metric seen, resetting the
+    buffer whenever a strictly better state arrives.  Shared by the
+    single-box driver below and the multi-box lockstep driver
+    (search.multibox)."""
+
+    def __init__(self, metric: int, log: Callable[[str], None] = print):
+        self.metric = metric
+        self.log = log
+        self.max_gates = MAX_GATES
+        self.max_sat_metric = INT_MAX
+        self.states: List[State] = []
+
+    def consider(self, nst: State, output: int) -> bool:
+        """Folds one finished attempt; returns False when it found
+        nothing."""
+        if nst.outputs[output] == NO_GATE:
+            self.log(f"No solution for output {output}.")
+            return False
+        if self.metric == GATES:
+            if self.max_gates > nst.num_gates:
+                self.max_gates = nst.num_gates
+                self.states = []
+            if nst.num_gates <= self.max_gates:
+                if len(self.states) < BEAM_WIDTH:
+                    self.states.append(nst)
+                else:
+                    self.log("Output state buffer full! Throwing away valid state.")
+        else:
+            if self.max_sat_metric > nst.sat_metric:
+                self.max_sat_metric = nst.sat_metric
+                self.states = []
+            if nst.sat_metric <= self.max_sat_metric:
+                if len(self.states) < BEAM_WIDTH:
+                    self.states.append(nst)
+                else:
+                    self.log("Output state buffer full! Throwing away valid state.")
+        return True
+
+
 def make_targets(sbox: np.ndarray) -> List[np.ndarray]:
     return [tt.target_table(sbox, bit) for bit in range(8)]
 
@@ -92,36 +133,12 @@ def generate_graph(
 
     while sum(1 for o in start_states[0].outputs if o != NO_GATE) < num_outputs:
         done = sum(1 for o in start_states[0].outputs if o != NO_GATE)
-        max_gates = MAX_GATES
-        max_sat_metric = INT_MAX
-        out_states: List[State] = []
+        beam = BeamFold(opt.metric, log)
 
         def consider(nst: State, output: int) -> None:
-            """Beam insertion with the metric ratchet (sboxgates.c:748-771)."""
-            nonlocal max_gates, max_sat_metric, out_states
-            if nst.outputs[output] == NO_GATE:
-                log(f"No solution for output {output}.")
-                return
-            if save_dir is not None:
+            # Checkpoint every solution, kept or not (sboxgates.c:746).
+            if beam.consider(nst, output) and save_dir is not None:
                 save_state(nst, save_dir)
-            if opt.metric == GATES:
-                if max_gates > nst.num_gates:
-                    max_gates = nst.num_gates
-                    out_states = []
-                if nst.num_gates <= max_gates:
-                    if len(out_states) < BEAM_WIDTH:
-                        out_states.append(nst)
-                    else:
-                        log("Output state buffer full! Throwing away valid state.")
-            else:
-                if max_sat_metric > nst.sat_metric:
-                    max_sat_metric = nst.sat_metric
-                    out_states = []
-                if nst.sat_metric <= max_sat_metric:
-                    if len(out_states) < BEAM_WIDTH:
-                        out_states.append(nst)
-                    else:
-                        log("Output state buffer full! Throwing away valid state.")
 
         if opt.batch_restarts:
             # One rendezvous-batched round: every (iteration x start x
@@ -139,9 +156,9 @@ def generate_graph(
                             continue
                         nst = start.copy()
                         if opt.metric == GATES:
-                            nst.max_gates = max_gates
+                            nst.max_gates = beam.max_gates
                         else:
-                            nst.max_sat_metric = max_sat_metric
+                            nst.max_sat_metric = beam.max_sat_metric
                         jobs.append((nst, targets[output], mask))
                         meta.append(output)
             log(
@@ -165,26 +182,26 @@ def generate_graph(
                         log(f"Generating circuit for output {output}...")
                         nst = start.copy()
                         if opt.metric == GATES:
-                            nst.max_gates = max_gates
+                            nst.max_gates = beam.max_gates
                         else:
-                            nst.max_sat_metric = max_sat_metric
+                            nst.max_sat_metric = beam.max_sat_metric
                         nst.outputs[output] = create_circuit(
                             ctx, nst, targets[output], mask, []
                         )
                         consider(nst, output)
-        if not out_states:
+        if not beam.states:
             return []
         if opt.metric == GATES:
             log(
-                f"Found {len(out_states)} state"
-                f"{'' if len(out_states) == 1 else 's'} with "
-                f"{max_gates - out_states[0].num_inputs} gates."
+                f"Found {len(beam.states)} state"
+                f"{'' if len(beam.states) == 1 else 's'} with "
+                f"{beam.max_gates - beam.states[0].num_inputs} gates."
             )
         else:
             log(
-                f"Found {len(out_states)} state"
-                f"{'' if len(out_states) == 1 else 's'} with SAT metric "
-                f"{max_sat_metric}."
+                f"Found {len(beam.states)} state"
+                f"{'' if len(beam.states) == 1 else 's'} with SAT metric "
+                f"{beam.max_sat_metric}."
             )
-        start_states = out_states
+        start_states = beam.states
     return start_states

@@ -39,15 +39,22 @@ def parse_sbox(text: str) -> Tuple[np.ndarray, int]:
     return sbox, num_inputs
 
 
+def permuted_box(sbox: np.ndarray, num_inputs: int, p: int) -> np.ndarray:
+    """The S-box with its input XOR-permuted by ``p`` — the single home
+    of the ``--permute`` transform (reference: sboxgates.c:1021-1031),
+    used both at load time and by the permutation-sweep driver."""
+    if p >= (1 << num_inputs):
+        raise SboxError(f"Bad permutation value: {p}")
+    return sbox[np.arange(256) ^ (p & 0xFF)]
+
+
 def load_sbox(path: str, permute: int = 0) -> Tuple[np.ndarray, int]:
     """Loads an S-box file, optionally XOR-permuting the input indices
     (reference: sboxgates.c:1021-1031)."""
     with open(path, "r", encoding="utf-8") as f:
         sbox, num_inputs = parse_sbox(f.read())
     if permute:
-        if permute >= (1 << num_inputs):
-            raise SboxError(f"Bad permutation value: {permute}")
-        sbox = sbox[np.arange(256) ^ (permute & 0xFF)]
+        sbox = permuted_box(sbox, num_inputs, permute)
     return sbox, num_inputs
 
 

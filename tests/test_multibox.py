@@ -1,0 +1,165 @@
+"""Multi-S-box and permutation-sweep driver tests (BASELINE configs 4-5;
+reference counterpart: one process per box / per -p value,
+sboxgates.c:661-688, 1021-1031)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import NO_GATE
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search.multibox import (
+    BoxJob,
+    load_box_jobs,
+    permute_sweep_jobs,
+    permuted_box,
+    search_boxes_all_outputs,
+    search_boxes_one_output,
+)
+from sboxgates_tpu.utils.sbox import load_sbox
+
+SBOXES = os.path.join(os.path.dirname(__file__), "..", "sboxes")
+
+
+def _boxes(names, permute=0):
+    return load_box_jobs(
+        [os.path.join(SBOXES, f"{n}.txt") for n in names], permute
+    )
+
+
+def _assert_realizes(box, st, output):
+    gid = st.outputs[output]
+    assert gid != NO_GATE
+    assert bool(
+        tt.eq_mask(st.table(gid), box.targets[output], box.mask)
+    ), f"{box.name} output {output} not realized"
+
+
+def test_permuted_box_is_input_xor():
+    sbox, n = load_sbox(os.path.join(SBOXES, "des_s1.txt"))
+    p = 0b101101
+    perm = permuted_box(sbox, n, p)
+    for i in range(1 << n):
+        assert perm[i] == sbox[i ^ p]
+    from sboxgates_tpu.utils.sbox import SboxError
+
+    with pytest.raises(SboxError):
+        permuted_box(sbox, n, 1 << n)
+
+
+def test_des_s2_s8_tables_are_standard():
+    """Every DES S-box row (row-major 4x16 layout, same as the
+    reference's des_s1.txt) must be a permutation of 0..15 — the FIPS
+    46-3 structural invariant."""
+    for i in range(1, 9):
+        sbox, n = load_sbox(os.path.join(SBOXES, f"des_s{i}.txt"))
+        assert n == 6
+        tab = sbox[:64].reshape(4, 16)
+        for row in tab:
+            assert sorted(row.tolist()) == list(range(16))
+
+
+@pytest.mark.parametrize("batched", [False, True])
+def test_multibox_one_output(batched):
+    """DES S1+S2+S3 LUT search, one output: every box gets a valid
+    circuit in both execution modes."""
+    boxes = _boxes(["des_s1", "des_s2", "des_s3"])
+    ctx = SearchContext(Options(seed=11, lut_graph=True))
+    res = search_boxes_one_output(
+        ctx, boxes, 0, save_dir=None, log=lambda s: None, batched=batched
+    )
+    for box in boxes:
+        states = res[box.name]
+        assert states, f"{box.name}: nothing found"
+        for st in states:
+            _assert_realizes(box, st, 0)
+
+
+def test_multibox_one_output_bad_bit():
+    boxes = _boxes(["des_s1"])  # 4 outputs
+    ctx = SearchContext(Options(seed=1))
+    with pytest.raises(ValueError):
+        search_boxes_one_output(
+            ctx, boxes, 7, save_dir=None, log=lambda s: None, batched=False
+        )
+
+
+def test_multibox_all_outputs_lockstep(tmp_path):
+    """Full-graph lockstep beam over two boxes with different output
+    counts and round depths (3-bit identity completes via step-1 reuse;
+    parity/majority needs real gates): all outputs of both realized,
+    checkpoints in per-box subdirectories, the faster box drops out of
+    later rounds.  Tiny 3-input boxes keep the per-round thread batches
+    small — the full-size regime is bench.py's job."""
+    ident = np.zeros(256, dtype=np.uint8)
+    ident[:8] = np.arange(8)
+    pm = np.zeros(256, dtype=np.uint8)
+    for i in range(8):
+        x0, x1, x2 = i & 1, (i >> 1) & 1, (i >> 2) & 1
+        parity = x0 ^ x1 ^ x2
+        major = (x0 + x1 + x2) >= 2
+        pm[i] = parity | (major << 1)
+    boxes = [BoxJob("ident3", ident, 3), BoxJob("parmaj3", pm, 3)]
+    ctx = SearchContext(Options(seed=7))
+    res = search_boxes_all_outputs(
+        ctx, boxes, save_dir=str(tmp_path), log=lambda s: None, batched=True
+    )
+    for box in boxes:
+        states = res[box.name]
+        assert states, f"{box.name}: incomplete"
+        for output in range(box.n_out):
+            _assert_realizes(box, states[0], output)
+        assert (tmp_path / box.name).is_dir()
+        assert list((tmp_path / box.name).glob("*.xml"))
+
+
+def test_permute_sweep_targets():
+    """Each sweep job's targets are the permuted box's targets, and a
+    circuit found for permutation p realizes the p-permuted function."""
+    sbox, n = load_sbox(os.path.join(SBOXES, "crypto1_fa.txt"))
+    jobs = permute_sweep_jobs(sbox, n)
+    assert len(jobs) == 1 << n
+    assert jobs[5].name == "p05"
+    ctx = SearchContext(Options(seed=3))
+    res = search_boxes_one_output(
+        ctx, jobs[:4], 0, save_dir=None, log=lambda s: None, batched=True
+    )
+    for box in jobs[:4]:
+        states = res[box.name]
+        assert states
+        _assert_realizes(box, states[0], 0)
+
+
+def test_multibox_mesh_guard():
+    """Explicit batched=True under a mesh is rejected (host threads
+    cannot share GSPMD-owned devices)."""
+    from sboxgates_tpu.parallel import MeshPlan, make_mesh
+
+    ctx = SearchContext(Options(seed=1), mesh_plan=MeshPlan(make_mesh()))
+    boxes = [BoxJob("id", np.arange(256, dtype=np.uint8), 8)]
+    with pytest.raises(ValueError):
+        search_boxes_one_output(
+            ctx, boxes, 0, save_dir=None, log=lambda s: None, batched=True
+        )
+
+
+def test_cli_multibox_contract(tmp_path, monkeypatch):
+    """CLI validation: multiple inputs reject -c/-g; --permute-sweep
+    rejects -p and multiple inputs; a real 2-box run writes per-box
+    subdirectories."""
+    from sboxgates_tpu.cli import main
+
+    s1 = os.path.join(SBOXES, "des_s1.txt")
+    s2 = os.path.join(SBOXES, "des_s2.txt")
+    assert main(["-c", s1, s2]) != 0
+    assert main(["-g", "x.xml", s1, s2]) != 0
+    assert main(["--permute-sweep", "-p", "3", s1]) != 0
+    assert main(["--permute-sweep", s1, s2]) != 0
+    monkeypatch.chdir(tmp_path)
+    rc = main(["-o", "0", "-i", "1", "-l", "--seed", "2",
+               "--output-dir", str(tmp_path), s1, s2])
+    assert rc == 0
+    assert list((tmp_path / "des_s1").glob("*.xml"))
+    assert list((tmp_path / "des_s2").glob("*.xml"))
