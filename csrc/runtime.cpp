@@ -21,11 +21,15 @@
 //                            comparisons (the reference binary itself needs
 //                            MPI + libxml2, unavailable in this image).
 //  - sbg_gate_step:          fused gate-mode search node (steps 1-4,
-//                            sboxgates.c:301-435) for SMALL states, where a
-//                            device dispatch is pure overhead: the whole
-//                            candidate space fits in microseconds of host
-//                            work while one accelerator round trip costs
-//                            tens of milliseconds.  Bit-identical selection
+//                            sboxgates.c:301-435).  POLICY: this is the
+//                            engine's gate-mode path at EVERY state size
+//                            (NATIVE_STEP_MAX_G = 512 > MAX_GATES = 500,
+//                            mesh or not) — the full C(G,2)+C(G,3) space
+//                            is microseconds-to-milliseconds of host work
+//                            while a device dispatch costs ~70 ms through
+//                            a network tunnel (and still dominates the
+//                            sweep co-located); see README "Execution
+//                            placement policy".  Bit-identical selection
 //                            semantics to the jitted kernel
 //                            (ops/sweeps.py:gate_step_stream) — same hashed
 //                            priorities, same chunk order — so routing a

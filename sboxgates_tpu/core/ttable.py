@@ -111,19 +111,26 @@ def eq_mask(a, b, mask):
     return is_zero((a ^ b) & mask)
 
 
+def _fresh(x):
+    """Pass-through result: numpy arrays are copied so callers can never
+    alias (and later mutate) a live gate table; jax values are immutable,
+    and tracers (e.g. Pallas, whose Mosaic lowering has no copy_p rule)
+    must pass through untouched."""
+    return np.copy(x) if isinstance(x, np.ndarray) else x
+
+
 # Direct expressions per gate nibble (enum value = truth table with
 # f(1,1)=bit0, f(1,0)=bit1, f(0,1)=bit2, f(0,0)=bit3): 1-2 elementwise
 # ops instead of the 11-op minterm sum — the host search engine evaluates
-# one gate at a time, where numpy per-op overhead dominates.  Entries for
-# the pass-through functions (A, B) return the input array itself; no
-# caller mutates gate tables in place.
+# one gate at a time, where numpy per-op overhead dominates.  The
+# pass-through functions (A, B) return via _fresh (copy for numpy only).
 _GATE2_DIRECT = {
     0b0000: lambda a, b: a & ~a,
     0b0001: lambda a, b: a & b,
     0b0010: lambda a, b: a & ~b,
-    0b0011: lambda a, b: a,
+    0b0011: lambda a, b: _fresh(a),
     0b0100: lambda a, b: ~a & b,
-    0b0101: lambda a, b: b,
+    0b0101: lambda a, b: _fresh(b),
     0b0110: lambda a, b: a ^ b,
     0b0111: lambda a, b: a | b,
     0b1000: lambda a, b: ~(a | b),

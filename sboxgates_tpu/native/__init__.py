@@ -83,6 +83,12 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None:
             return _lib
+        if os.environ.get("SBG_DISABLE_NATIVE"):
+            # Simulated-unavailable: never build or dlopen (tests drive
+            # the multi-host heterogeneous-availability agreement with
+            # this; users force the device kernels).  Not cached in
+            # _build_error so unsetting the variable re-enables loading.
+            return None
         if _build_error is not None:
             return None
         src_mtime = (
@@ -218,7 +224,16 @@ def available() -> bool:
     return _load() is not None
 
 
+def _disabled_reason() -> Optional[str]:
+    if os.environ.get("SBG_DISABLE_NATIVE"):
+        return "disabled via SBG_DISABLE_NATIVE"
+    return None
+
+
 def build_error() -> Optional[str]:
+    reason = _disabled_reason()
+    if reason is not None:
+        return reason
     _load()
     return _build_error
 
@@ -384,6 +399,27 @@ class GateStepCaller:
         self, tables, g, bucket, target, mask, use_not, use_triple,
         total3, chunk3, seed,
     ) -> np.ndarray:
+        # Raw-address ABI: a non-contiguous or wrong-dtype operand would
+        # make the C side read garbage silently, so check the contract
+        # here (assert: stripped under -O, negligible vs the C work).
+        assert (
+            tables.flags["C_CONTIGUOUS"]
+            and target.flags["C_CONTIGUOUS"]
+            and mask.flags["C_CONTIGUOUS"]
+        ), "gate_step operands must be C-contiguous"
+        assert (
+            tables.dtype in (np.uint32, np.uint64)
+            and target.dtype in (np.uint32, np.uint64)
+            and mask.dtype in (np.uint32, np.uint64)
+        ), "gate_step operands must be uint32/uint64"
+        # The C side reads g rows of 32 bytes from tables and one 32-byte
+        # table from each of target/mask.
+        assert (
+            tables.shape[0] >= g
+            and tables.shape[-1] * tables.itemsize == 32
+            and target.nbytes == 32
+            and mask.nbytes == 32
+        ), "gate_step operand shapes do not match the 32-byte-row ABI"
         out = np.zeros(4, dtype=np.int32)
         self._fn(
             tables.ctypes.data,

@@ -30,6 +30,7 @@ per-restart targets/seeds composes with the candidate sharding.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -45,6 +46,16 @@ from ..ops import sweeps
 
 CANDIDATES_AXIS = "candidates"
 RESTARTS_AXIS = "restarts"
+
+# Multi-host gather budget: the compacted feasible-stream gather ships at
+# most this many rows per device over DCN instead of the whole chunk
+# (~chunk x (1 + 2W) words).  The stream stops at the FIRST chunk holding
+# any feasible tuple, so the hit chunk rarely holds more than a handful;
+# when a device does exceed the budget, the driver re-drives that one
+# chunk through the full-gather fallback (counts travel in the verdict,
+# so the overflow is detected without an extra round trip).  Env override
+# for tests (SBG_GATHER_ROWS=1 forces the overflow path).
+GATHER_ROWS = int(os.environ.get("SBG_GATHER_ROWS", "256"))
 
 
 def make_mesh(
@@ -125,7 +136,7 @@ def lut5_fused_step(tables, combos, valid, target, mask, w_tab, m_tab, seed):
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
+def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int, compact: bool = False):
     """Compiled SPMD whole-space feasibility stream for one (mesh, k, chunk).
 
     Each device sweeps a contiguous `per`-rank sub-block of every chunk, so
@@ -134,9 +145,24 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
     :func:`sboxgates_tpu.ops.sweeps.feasible_stream`.  The found flag is a
     ``psum`` each iteration — the collective replacing the reference's
     Isend/Irecv first-hit protocol (lut.c:213-238).
+
+    Multi-host output contracts:
+
+    - ``compact=True`` (the default driver path): each device contributes
+      only its first ``min(GATHER_ROWS, per)`` feasible rows (rank order)
+      to the cross-host gather — payload O(solve rows), not O(chunk) —
+      and per-device feasible counts ride in the verdict so the driver
+      can detect and re-drive the rare overflow.  Returns
+      ``(verdict[3 + n], row_idx[n*K], feas[n*K], r1[n*K,...],
+      r0[n*K,...])`` with row_idx relative to the chunk.
+    - ``compact=False``: the full-chunk gather (overflow fallback).
+      Returns ``(verdict[3], feas[chunk], r1, r0)``.
+
+    Single-host runs ignore ``compact`` (outputs stay sharded; no gather).
     """
     n = mesh.shape[CANDIDATES_AXIS]
     per = -(-chunk // n)
+    K = min(GATHER_ROWS, per)
 
     def local(tables, binom, g, target, mask, excl, start, total):
         d = jax.lax.axis_index(CANDIDATES_AXIS).astype(jnp.int32)
@@ -166,11 +192,29 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
         )
         examined = jnp.minimum(nxt, total) - start
         verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
+        if multihost and compact:
+            # Top-K row compaction before the DCN gather: feasible rows
+            # first (rank order preserved — jnp.argsort is stable), then
+            # per-device counts appended to the verdict for overflow
+            # detection.
+            counts = jax.lax.all_gather(
+                feasible.sum().astype(jnp.int32), CANDIDATES_AXIS
+            )
+            order = jnp.argsort(~feasible)[:K].astype(jnp.int32)
+            row_idx = d * per + order
+            gath = lambda x: jax.lax.all_gather(x, CANDIDATES_AXIS, tiled=True)
+            return (
+                jnp.concatenate([verdict, counts]),
+                gath(row_idx),
+                gath(feasible[order]),
+                gath(r1[order]),
+                gath(r0[order]),
+            )
         if multihost:
-            # Gather the per-device blocks so every output is fully
-            # replicated: ranks concatenate to cstart + arange(chunk) in
-            # device order, and every process can fetch the whole array
-            # (sharded outputs are not fully addressable across hosts).
+            # Full-chunk gather so every output is fully replicated: ranks
+            # concatenate to cstart + arange(chunk) in device order, and
+            # every process can fetch the whole array (sharded outputs are
+            # not fully addressable across hosts).
             feasible = jax.lax.all_gather(feasible, CANDIDATES_AXIS, tiled=True)
             r1 = jax.lax.all_gather(r1, CANDIDATES_AXIS, tiled=True)
             r0 = jax.lax.all_gather(r0, CANDIDATES_AXIS, tiled=True)
@@ -178,20 +222,26 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int):
 
     multihost = jax.process_count() > 1
     big = P() if multihost else P(CANDIDATES_AXIS)
+    if multihost and compact:
+        out_specs = (P(), P(), P(), P(), P())
+    else:
+        out_specs = (P(), big, big, big)
     return _jit_shard_map(
         local,
         mesh=mesh,
         in_specs=(P(),) * 8,
-        out_specs=(P(), big, big, big),
+        out_specs=out_specs,
     )
 
 
 def sharded_feasible_stream(
     plan: "MeshPlan", tables, binom, g, target, mask, excl, start, total,
-    *, k: int, chunk: int
+    *, k: int, chunk: int, compact: bool = False
 ):
-    """Mesh-sharded counterpart of sweeps.feasible_stream (same contract)."""
-    fn = _sharded_stream_fn(plan.mesh, k, chunk)
+    """Mesh-sharded counterpart of sweeps.feasible_stream (same contract
+    single-host; see :func:`_sharded_stream_fn` for the multi-host
+    compact/full output contracts)."""
+    fn = _sharded_stream_fn(plan.mesh, k, chunk, compact)
     return fn(tables, binom, g, target, mask, excl, start, total)
 
 

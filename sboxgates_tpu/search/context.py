@@ -73,24 +73,37 @@ LUT7_HEAD_SOLVE_ROWS = 256
 NATIVE_LUT7_SOLVE_MAX = 24
 
 
-@functools.lru_cache(maxsize=None)
 def _native_lut7_solve_max() -> int:
+    # Keyed on the *current* backend (not lru_cached process-wide) so a
+    # process that re-initializes JAX on a different platform — e.g. a
+    # test harness switching cpu<->tpu — keeps the routing threshold
+    # fresh.  jax.default_backend() is itself cached by JAX; this adds
+    # one dict lookup per LUT7 node.
     import jax
 
-    if jax.default_backend() == "cpu":
+    return _native_lut7_solve_max_for(jax.default_backend())
+
+
+@functools.lru_cache(maxsize=None)
+def _native_lut7_solve_max_for(backend: str) -> int:
+    if backend == "cpu":
         # capped at the native solver's 256-row limit (lut7_solve_small)
         return min(LUT7_HEAD_SOLVE_ROWS, 256)
     return NATIVE_LUT7_SOLVE_MAX
 
-# Gate-mode nodes at or below this many gates run on the host via the
-# native runtime (Options.host_small_steps).  Measured through the
-# network-attached chip, the native step wins at EVERY gate-mode size —
-# 3 ms vs 42 ms at g=64, 215 ms vs 2.1 s at the g=500 cap (the device
-# triple stream is RTT- and gather-bound) — so the threshold covers all
-# states; the device kernels remain the path for mesh runs and the
-# host_small_steps=False opt-out.  This mirrors the reference's own
-# architecture: its gate-mode engine is serial C (sboxgates.c:282-616),
-# MPI parallelizes only the LUT search.
+# POLICY (README "Execution placement policy"): node-head sweeps at or
+# below this many gates run on the host via the native runtime
+# (Options.host_small_steps).  512 > MAX_GATES = 500, so this is ALL
+# states — gate-mode searches run entirely on the host, mesh or not,
+# and LUT-mode nodes run their head natively while the pivot/7-LUT
+# sweeps dispatch to the (sharded) chip.  Measured basis: the native
+# step wins at EVERY gate-mode size — 3 ms vs 42 ms at g=64, 215 ms vs
+# 2.1 s at the g=500 cap (the device triple stream is RTT- and
+# gather-bound; BENCH_DETAIL gate_mode_sweeps: device 0.24-9.9M cand/s
+# vs native 124.7M).  This mirrors the reference's own architecture:
+# its gate-mode engine is serial C on rank 0 (sboxgates.c:282-616), MPI
+# parallelizes only the LUT search.  The device kernels remain
+# available (host_small_steps=False) so the decision stays measurable.
 NATIVE_STEP_MAX_G = 512
 
 
@@ -271,6 +284,7 @@ class SearchContext:
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
         self._native_probe = None
+        self._native_agree = None
         # Per-phase wall-clock timers (SURVEY §5: the reference has none;
         # report via ``prof.report(stats)`` or the CLI's -vv summary).
         self.prof = PhaseProfiler()
@@ -411,6 +425,8 @@ class SearchContext:
         base_args, total, chunk = prebuilt
         args = (*base_args, start, total)
         if self.mesh_plan is not None:
+            import jax
+
             from ..parallel.mesh import sharded_feasible_stream
 
             # The sharded kernel rounds the chunk up to a device multiple and
@@ -418,6 +434,8 @@ class SearchContext:
             # resume at exactly the next unswept rank.
             n = self.mesh_plan.n_candidate_shards
             chunk = -(-chunk // n) * n
+            if jax.process_count() > 1:
+                return self._multihost_stream(args, k, chunk, n)
             verdict, feas, r1, r0 = sharded_feasible_stream(
                 self.mesh_plan, *args, k=k, chunk=chunk
             )
@@ -430,6 +448,45 @@ class SearchContext:
         # round trip).
         found, cstart, examined = (int(x) for x in np.asarray(verdict))
         return bool(found), cstart, feas, r1, r0, examined, chunk
+
+    def _multihost_stream(self, args, k: int, chunk: int, n: int):
+        """Multi-host branch of :meth:`feasible_stream_driver`: the
+        compacted gather ships O(GATHER_ROWS) rows per device over DCN
+        instead of the whole chunk; per-device feasible counts ride in the
+        verdict, and the rare over-budget chunk is re-driven through the
+        full gather so no feasible row is ever dropped (completeness is
+        identical to the single-host stream)."""
+        from ..parallel.mesh import GATHER_ROWS, sharded_feasible_stream
+
+        per = chunk // n
+        cap = min(GATHER_ROWS, per)
+        verdict, row_idx, feas_c, r1_c, r0_c = sharded_feasible_stream(
+            self.mesh_plan, *args, k=k, chunk=chunk, compact=True
+        )
+        vec = np.asarray(verdict)
+        found, cstart, examined = (int(x) for x in vec[:3])
+        counts = vec[3:]
+        if not found:
+            return False, cstart, None, None, None, examined, chunk
+        if counts.max() > cap:
+            # Overflow: fetch this exact chunk in full (start=cstart).
+            _, feas, r1, r0 = sharded_feasible_stream(
+                self.mesh_plan, *args[:-2], cstart, args[-1], k=k,
+                chunk=chunk, compact=False,
+            )
+            return True, cstart, feas, r1, r0, examined, chunk
+        # Reconstruct the dense per-chunk arrays from the compacted rows.
+        row_idx = np.asarray(row_idx)
+        feas_c = np.asarray(feas_c)
+        r1_c, r0_c = np.asarray(r1_c), np.asarray(r0_c)
+        feas = np.zeros(chunk, dtype=bool)
+        r1 = np.zeros((chunk,) + r1_c.shape[1:], dtype=r1_c.dtype)
+        r0 = np.zeros_like(r1)
+        sel = feas_c
+        feas[row_idx[sel]] = True
+        r1[row_idx[sel]] = r1_c[sel]
+        r0[row_idx[sel]] = r0_c[sel]
+        return True, cstart, feas, r1, r0, examined, chunk
 
     # -- sweep drivers ----------------------------------------------------
 
@@ -488,13 +545,65 @@ class SearchContext:
 
     def uses_native_step(self, st: State) -> bool:
         """True when this state's node head sweeps run on the host
-        (:meth:`_gate_step_native` / :meth:`_lut_step_native`)."""
-        return (
+        (:meth:`_gate_step_native` / :meth:`_lut_step_native`).
+
+        Mesh runs route the node head to the host too: gate-mode sweeps
+        (pairs + triples) are microseconds of host work that no measured
+        device kernel beats (BENCH_DETAIL gate_mode_sweeps: device
+        244K-9.9M cand/s vs native 124.7M), and the reference's own
+        architecture is the same — its gate-mode engine is serial C on
+        rank 0, MPI parallelizes only the LUT search
+        (sboxgates.c:282-616 vs lut.c).  Under a mesh the sharded LUT
+        streams (3/5/7-LUT) remain the distributed path; the head verdict
+        is bit-identical host or device, and with a shared seed every
+        process computes the same verdict, preserving multi-host
+        lockstep."""
+        # Guards up to here are process-consistent (replicated options and
+        # state); the locally-varying _native_ok() probe must stay INSIDE
+        # the multi-host agreement below, so every process joins the same
+        # collective regardless of its local probe result.
+        if not (
             self.opt.host_small_steps
-            and self.mesh_plan is None
             and st.num_gates <= NATIVE_STEP_MAX_G
-            and self._native_ok()
-        )
+        ):
+            return False
+        if self.mesh_plan is not None:
+            # Multi-host: every process must agree on the routing, or a
+            # native-less host would enter a device collective the others
+            # never join (and the seed streams would diverge).  One
+            # all-gather at first use, cached.
+            return self._native_all_procs()
+        return self._native_ok()
+
+    def _native_all_procs(self) -> bool:
+        """True when the native runtime is available on EVERY process of
+        a multi-host run (the local probe, single-process).  All
+        processes must call this at the same point — the callers' guards
+        are process-consistent, so they do."""
+        if self._native_agree is None:
+            import jax
+
+            if jax.process_count() <= 1:
+                self._native_agree = self._native_ok()
+            else:
+                from jax.experimental import multihost_utils
+
+                ok = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray(self._native_ok(), dtype=np.int32)
+                    )
+                )
+                self._native_agree = bool(ok.min() > 0)
+                if not self._native_agree and self._native_ok():
+                    import warnings
+
+                    warnings.warn(
+                        "native host runtime unavailable on some processes;"
+                        " routing every node head to the device kernels so"
+                        " all processes stay in lockstep",
+                        RuntimeWarning,
+                    )
+        return self._native_agree
 
     def node_host_only(self, st: State) -> bool:
         """True when a search node runs entirely on the host in the common

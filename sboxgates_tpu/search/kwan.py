@@ -47,8 +47,11 @@ def _create_circuit(
     # single-device additionally inlines the whole 3-LUT and small-space
     # 5-LUT sweeps into the same dispatch (sweeps.lut_step_stream) — one
     # device round trip per search node instead of up to four.
+    # Mesh runs get the fused head too when it routes to the native host
+    # runtime (bit-identical verdict, no dispatch); only the native-less
+    # mesh path falls back to per-stage sharded streams.
     head = None
-    if opt.lut_graph and ctx.mesh_plan is None:
+    if opt.lut_graph and (ctx.mesh_plan is None or ctx.uses_native_step(st)):
         head = ctx.lut_step(st, target, mask, inbits)
         step, x0, x1 = int(head[0]), int(head[1]), int(head[2])
         if step >= 4:

@@ -33,7 +33,7 @@ dist.initialize(f"127.0.0.1:{port}", 2, pid)
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8, len(jax.devices())
 
-from planted import build_planted_lut5  # noqa: E402
+from planted import build_planted_lut5, build_planted_lut5_small  # noqa: E402
 
 from sboxgates_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
 from sboxgates_tpu.search import Options, SearchContext  # noqa: E402
@@ -54,5 +54,77 @@ print(
         res["func_inner"],
         " ".join(str(g) for g in res["gates"]),
     ),
+    flush=True,
+)
+
+# Second leg: the chunked (non-pivot) mesh path, whose multi-host gather is
+# the compacted top-K one.  SBG_GATHER_ROWS=1 in the parent environment
+# forces the per-device row budget to overflow, exercising the full-gather
+# re-drive; both legs must agree across processes either way.
+st2, target2, mask2 = build_planted_lut5_small()
+ctx2 = SearchContext(Options(lut_graph=True, randomize=False), mesh_plan=plan)
+res2 = lut5_search(ctx2, st2, target2, mask2, [])
+assert res2 is not None, "distributed chunked search found nothing"
+print(
+    "RESULT2 %d %d %d %s"
+    % (
+        pid,
+        res2["func_outer"],
+        res2["func_inner"],
+        " ".join(str(g) for g in res2["gates"]),
+    ),
+    flush=True,
+)
+
+# Completeness proof for the compacted gather: the driver's reconstructed
+# dense chunk must equal the full-gather kernel's output row for row.
+import numpy as np  # noqa: E402
+
+from sboxgates_tpu.parallel.mesh import sharded_feasible_stream  # noqa: E402
+
+prebuilt = ctx2.stream_args(st2, target2, mask2, [], 5)
+base_args, total, chunk0 = prebuilt
+n = plan.n_candidate_shards
+chunk = -(-chunk0 // n) * n
+found, cstart, feas, r1, r0, _, _ = ctx2.feasible_stream_driver(
+    st2, target2, mask2, [], k=5, prebuilt=prebuilt
+)
+assert found, "planted chunk must contain feasible rows"
+_, feas_f, r1_f, r0_f = sharded_feasible_stream(
+    plan, *base_args, cstart, total, k=5, chunk=chunk, compact=False
+)
+feas_f, r1_f, r0_f = (np.asarray(x) for x in (feas_f, r1_f, r0_f))
+feas, r1, r0 = (np.asarray(x) for x in (feas, r1, r0))
+assert (feas == feas_f).all(), "compacted feasibility diverges"
+assert (r1[feas_f] == r1_f[feas_f]).all(), "compacted req1 diverges"
+assert (r0[feas_f] == r0_f[feas_f]).all(), "compacted req0 diverges"
+print("STREAMCHECK %d ok rows=%d" % (pid, int(feas_f.sum())), flush=True)
+
+# Third leg: the full search engine under the multi-host mesh, driving the
+# node-head routing agreement (SearchContext._native_all_procs — an
+# all-gather every process must join).  The parent may set
+# SBG_DISABLE_NATIVE on ONE process to make availability heterogeneous;
+# the agreement must then route both processes to the device kernels and
+# the searches must still agree bit-for-bit.
+from sboxgates_tpu.core import ttable as tt  # noqa: E402
+from sboxgates_tpu.graph.state import State  # noqa: E402
+from sboxgates_tpu.search import make_targets  # noqa: E402
+from sboxgates_tpu.search.kwan import create_circuit  # noqa: E402
+from sboxgates_tpu.utils.sbox import load_sbox  # noqa: E402
+
+sbox, n_in = load_sbox(
+    os.path.join(os.path.dirname(__file__), "..", "sboxes", "crypto1_fa.txt")
+)
+ctx3 = SearchContext(
+    Options(lut_graph=True, randomize=False, seed=3), mesh_plan=plan
+)
+st3 = State.init_inputs(n_in)
+out = create_circuit(
+    ctx3, st3, make_targets(sbox)[0], tt.mask_table(n_in), []
+)
+assert out != 0xFFFF, "mesh engine search found nothing"
+print(
+    "ENGINE %d out=%d gates=%d native=%s"
+    % (pid, out, st3.num_gates, ctx3.uses_native_step(st3)),
     flush=True,
 )

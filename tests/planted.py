@@ -33,6 +33,55 @@ def build_planted_lut5():
     return st, target, tt.mask_table(8)
 
 
+def build_planted_lut5_small(g: int = 24):
+    """Like :func:`build_planted_lut5` but below the pivot threshold, so a
+    mesh search takes the chunked feasible-stream path (the multi-host
+    compacted-gather code) instead of the pivot tiles."""
+    rng = np.random.default_rng(5)
+    st = State.init_inputs(8)
+    while st.num_gates < g:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    a, b, c = 9, 12, 17
+    d, e = 10, 20
+    outer = tt.eval_lut(PLANT_OUTER, st.table(a), st.table(b), st.table(c))
+    target = tt.eval_lut(PLANT_INNER, outer, st.table(d), st.table(e))
+    return st, target, tt.mask_table(8)
+
+
+PLANT7_OUTER = 0x96
+PLANT7_MIDDLE = 0xE8
+PLANT7_INNER = 0xCA
+
+
+def build_planted_lut7():
+    """(state, target, mask): 24 mixed-gate state (8 inputs) with a target
+    realizable as LUT(LUT(9,12,17), LUT(10,15,21), 19).  C(24,7) = 346k
+    exceeds the fused-head single-chunk limit, so the search takes the
+    staged path, and stage A collects ~1.5k feasible tuples — past every
+    host-solve threshold, forcing the sharded stage-B device solver."""
+    rng = np.random.default_rng(3)
+    st = State.init_inputs(8)
+    funs = [bf.AND, bf.OR, bf.XOR, bf.A_AND_NOT_B]
+    while st.num_gates < 24:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(funs[rng.integers(len(funs))], int(a), int(b), GATES)
+    outer = tt.eval_lut(PLANT7_OUTER, st.table(9), st.table(12), st.table(17))
+    middle = tt.eval_lut(PLANT7_MIDDLE, st.table(10), st.table(15), st.table(21))
+    target = tt.eval_lut(PLANT7_INNER, outer, middle, st.table(19))
+    return st, target, tt.mask_table(8)
+
+
+def verify_lut7_result(st, target, mask, res) -> bool:
+    """True iff res = {func_outer, func_middle, func_inner, gates(7)}
+    realizes the target."""
+    gs = [int(g) for g in res["gates"]]
+    o = tt.eval_lut(int(res["func_outer"]), st.table(gs[0]), st.table(gs[1]), st.table(gs[2]))
+    m = tt.eval_lut(int(res["func_middle"]), st.table(gs[3]), st.table(gs[4]), st.table(gs[5]))
+    got = tt.eval_lut(int(res["func_inner"]), o, m, st.table(gs[6]))
+    return bool(tt.eq_mask(got, target, mask))
+
+
 def verify_lut5_result(st, target, mask, res) -> bool:
     """True iff res = {func_outer, func_inner, gates} realizes the target."""
     a, b, c, d, e = (int(g) for g in res["gates"])

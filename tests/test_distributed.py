@@ -26,43 +26,79 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_pivot_search_agrees():
+@pytest.mark.parametrize(
+    "gather_rows,het_native",
+    [(None, False), ("1", False), (None, True)],
+    ids=["default", "gather-overflow", "heterogeneous-native"],
+)
+def test_two_process_pivot_search_agrees(gather_rows, het_native):
     """Both processes of a 2-process run must select the identical planted
     5-LUT decomposition through the sharded pivot path, and it must be a
-    correct decomposition."""
+    correct decomposition.  The second leg (RESULT2/STREAMCHECK) drives
+    the chunked path whose multi-host gather is compacted; with
+    SBG_GATHER_ROWS=1 the per-device row budget overflows and the
+    full-gather re-drive must restore completeness.  The third leg
+    (ENGINE) drives the full engine incl. the node-head routing
+    agreement; with het_native the native runtime is disabled on process
+    1 only, and the agreement must route BOTH processes identically."""
     env = {
         k: v
         for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
     env["PYTHONPATH"] = REPO
+    if gather_rows is not None:
+        env["SBG_GATHER_ROWS"] = gather_rows
     port = str(_free_port())
     worker = os.path.join(REPO, "tests", "distributed_worker.py")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), port],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
+    procs = []
+    for i in range(2):
+        penv = dict(env)
+        if het_native and i == 1:
+            penv["SBG_DISABLE_NATIVE"] = "1"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker, str(i), port],
+                env=penv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
         )
-        for i in range(2)
-    ]
     outs = [p.communicate(timeout=570)[0] for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
-    results = []
+    results, results2, engines = [], [], []
     for out in outs:
-        lines = [l for l in out.splitlines() if l.startswith("RESULT")]
-        assert lines, out
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        lines2 = [l for l in out.splitlines() if l.startswith("RESULT2 ")]
+        eng = [l for l in out.splitlines() if l.startswith("ENGINE ")]
+        assert lines and lines2 and eng, out
+        assert any(l.startswith("STREAMCHECK") for l in out.splitlines()), out
         results.append(lines[0].split()[2:])  # drop "RESULT <pid>"
+        results2.append(lines2[0].split()[2:])
+        engines.append(eng[0].split()[2:])
     assert results[0] == results[1], outs
+    assert results2[0] == results2[1], outs
+    assert engines[0] == engines[1], outs
+    if het_native:
+        # The agreement must have routed both processes OFF the native
+        # head (process 1 has no native runtime).
+        assert "native=False" in " ".join(engines[0]), outs
 
-    # Independently verify the decomposition against the planted target.
-    from planted import build_planted_lut5, verify_lut5_result
-
-    st, target, mask = build_planted_lut5()
-    fo, fi, a, b, c, d, e = (int(x) for x in results[0])
-    assert verify_lut5_result(
-        st, target, mask,
-        {"func_outer": fo, "func_inner": fi, "gates": (a, b, c, d, e)},
+    # Independently verify both decompositions against the planted targets.
+    from planted import (
+        build_planted_lut5,
+        build_planted_lut5_small,
+        verify_lut5_result,
     )
+
+    for build, res in (
+        (build_planted_lut5, results[0]),
+        (build_planted_lut5_small, results2[0]),
+    ):
+        st, target, mask = build()
+        fo, fi, a, b, c, d, e = (int(x) for x in res)
+        assert verify_lut5_result(
+            st, target, mask,
+            {"func_outer": fo, "func_inner": fi, "gates": (a, b, c, d, e)},
+        )
