@@ -11,10 +11,14 @@ reference's serial loop, lut.c:116-249; the reference binary itself needs
 MPI + libxml2, not in this image).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The full benchmark detail (G=500 sweep slice, pair/triple gate-mode sweep
-rates, DES S1 end-to-end wall times + solution quality on the reference's
-CI configs (.travis.yml:40-48), 7-LUT phase, and Pallas circuit-execution
-throughput) is written to BENCH_DETAIL.json next to this file.
+The full benchmark detail (G=500 sweep slice, gate-mode sweep rates
+native vs device, DES S1 end-to-end wall times + solution quality on the
+reference's CI configs (.travis.yml:40-48), the capped 7-LUT search, the
+batch axis at pivot size, the BASELINE config-4/5 drivers (8-box DES
+batch, 64-permutation sweep), and Pallas circuit-execution throughput)
+is written to BENCH_DETAIL.json next to this file.  Rate entries carry
+{value: median, min, max} spreads so tuning signal is distinguishable
+from the link's throttle noise.
 """
 
 from __future__ import annotations
@@ -37,6 +41,27 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 G_HEAD = 200    # headline state size: C(200,5) = 2,535,650,040
 CPU_COMBOS = 1 << 16
 REPEATS = 3
+# The reference is always run with many MPI ranks (.travis.yml:40-48); a
+# modern 2-socket node commonly exposes 64+ cores.  vs_baseline is
+# per-core (the honest unit we can measure on this 1-core host); the
+# detail entry also reports the rate scaled to this many cores as the
+# whole-node yardstick, assuming linear MPI scaling (the reference's
+# sweep is embarrassingly parallel with no cross-rank traffic until a
+# hit, so linear is the right model).
+SOCKET_CORES = 64
+
+
+def _spread(fn, n=REPEATS):
+    """n timed reps -> {value: median, min, max} (throttle diagnostics:
+    the tunnel chip varies ~2x between bursts; medians with spread make
+    tuning signal distinguishable from noise)."""
+    vals = sorted(fn() for _ in range(n))
+    return {
+        "value": vals[n // 2],
+        "min": vals[0],
+        "max": vals[-1],
+        "reps": n,
+    }
 
 
 def build_state(g):
@@ -69,14 +94,18 @@ def bench_lut5_device(g) -> dict:
             raise RuntimeError("unexpected 5-LUT hit in bench state")
 
     run()  # warmup/compile
-    base = ctx.stats["lut5_candidates"]
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
+
+    def one():
+        base = ctx.stats["lut5_candidates"]
+        t0 = time.perf_counter()
         run()
-    dt = time.perf_counter() - t0
-    rate = (ctx.stats["lut5_candidates"] - base) / dt
-    return {"metric": f"lut5_sweep_g{g}", "value": rate, "unit": "cand/s",
-            "space": math.comb(g, 5), "seconds_per_sweep": dt / REPEATS}
+        dt = time.perf_counter() - t0
+        return (ctx.stats["lut5_candidates"] - base) / dt
+
+    s = _spread(one)
+    return {"metric": f"lut5_sweep_g{g}", **s, "unit": "cand/s",
+            "space": math.comb(g, 5),
+            "seconds_per_sweep": math.comb(g, 5) / s["value"]}
 
 
 def bench_lut5_g500_slice(n_tiles=1500) -> dict:
@@ -125,33 +154,50 @@ def bench_lut5_g500_slice(n_tiles=1500) -> dict:
 
 
 def bench_cpu_baseline() -> dict:
-    """Reference-shaped serial C++ loop, candidates/sec on one core."""
+    """Reference-shaped serial C++ loop, candidates/sec on one core —
+    measured on the SAME G=200 state as the headline device sweep (the
+    per-candidate cost depends on the state's feasibility rate, so a
+    different G would not be apples-to-apples) over a uniform random
+    sample of the C(200,5) space (a contiguous prefix would
+    over-represent low-index gates)."""
     from sboxgates_tpu import native
-    from sboxgates_tpu.ops import combinatorics as comb
 
-    st, target, mask = build_state(80)
+    st, target, mask = build_state(G_HEAD)
     if not native.available():
         return {"metric": "cpu_core_lut5", "value": float("nan"),
                 "unit": "cand/s"}
-    combos = comb.CombinationStream(80, 5).next_chunk(CPU_COMBOS)
+    rng = np.random.default_rng(1)
+    picks = np.stack(
+        [rng.choice(G_HEAD, size=5, replace=False) for _ in range(CPU_COMBOS)]
+    )
+    combos = np.ascontiguousarray(np.sort(picks, axis=1).astype(np.int32))
     t64 = native.tables32_to_64(st.live_tables())
     tg64 = native.tables32_to_64(np.asarray(target))
     mk64 = native.tables32_to_64(np.asarray(mask))
     native.lut5_search_cpu(t64, tg64, mk64, combos[:1024])  # warmup
-    t0 = time.perf_counter()
-    idx, _ = native.lut5_search_cpu(t64, tg64, mk64, combos)
-    dt = time.perf_counter() - t0
-    if idx != -1:
-        raise RuntimeError("unexpected 5-LUT hit in CPU baseline state")
-    return {"metric": "cpu_core_lut5", "value": combos.shape[0] / dt,
-            "unit": "cand/s"}
+
+    def one():
+        t0 = time.perf_counter()
+        idx, _ = native.lut5_search_cpu(t64, tg64, mk64, combos)
+        dt = time.perf_counter() - t0
+        if idx != -1:
+            raise RuntimeError("unexpected 5-LUT hit in CPU baseline state")
+        return combos.shape[0] / dt
+
+    s = _spread(one)
+    return {"metric": "cpu_core_lut5", **s, "unit": "cand/s",
+            "state_g": G_HEAD, "sampled_combos": int(combos.shape[0]),
+            "socket_cores_assumed": SOCKET_CORES,
+            "socket_scaled_cand_per_sec": s["value"] * SOCKET_CORES}
 
 
 def bench_gate_mode_sweeps() -> dict:
     """Gate-mode (non-LUT) throughput: the native fused node step (the
-    engine's actual path for single-process gate mode at every state
-    size) and the device pair/triple kernels (the mesh-run path), at
-    G=200 (reference hot loops sboxgates.c:323-435)."""
+    engine's actual path for gate mode at every state size, mesh or not
+    — README "Execution placement policy") and the device kernels (the
+    ``host_small_steps=False`` opt-out: per-stage pair/triple sweeps and
+    the fused single-dispatch step), at G=200 (reference hot loops
+    sboxgates.c:323-435)."""
     from sboxgates_tpu.search import Options, SearchContext
 
     st, target, mask = build_state(G_HEAD)
@@ -159,38 +205,76 @@ def bench_gate_mode_sweeps() -> dict:
     # Engine path: one full-miss native node = C(G,2) pairs + C(G,3)
     # triples swept on the host.
     nctx = SearchContext(Options(seed=1))
-    native_rate = float("nan")
+    native = {"value": float("nan")}
     if nctx.uses_native_step(st):
         nctx._gate_step_native(st, target, mask)  # warm
-        base = nctx.stats["triple_candidates"]
-        t0 = time.perf_counter()
-        for _ in range(REPEATS):
+
+        def one_native():
+            base = nctx.stats["triple_candidates"]
+            t0 = time.perf_counter()
             nctx._gate_step_native(st, target, mask)
-        dt = time.perf_counter() - t0
-        native_rate = (nctx.stats["triple_candidates"] - base) / dt
+            return (nctx.stats["triple_candidates"] - base) / (
+                time.perf_counter() - t0
+            )
+
+        native = _spread(one_native)
 
     ctx = SearchContext(Options(seed=1, host_small_steps=False))
 
     ctx.pair_search(st, target, mask, use_not_table=False)  # warmup
-    base = ctx.stats["pair_candidates"]
-    t0 = time.perf_counter()
-    for _ in range(10):
-        ctx.pair_search(st, target, mask, use_not_table=False)
-    dt_pair = time.perf_counter() - t0
-    pair_rate = (ctx.stats["pair_candidates"] - base) / dt_pair
+
+    def one_pair():
+        base = ctx.stats["pair_candidates"]
+        t0 = time.perf_counter()
+        for _ in range(10):
+            ctx.pair_search(st, target, mask, use_not_table=False)
+        return (ctx.stats["pair_candidates"] - base) / (
+            time.perf_counter() - t0
+        )
+
+    pair = _spread(one_pair)
 
     ctx.triple_search(st, target, mask)  # warmup
-    base = ctx.stats["triple_candidates"]
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
+
+    def one_triple():
+        base = ctx.stats["triple_candidates"]
+        t0 = time.perf_counter()
         ctx.triple_search(st, target, mask)
-    dt_tri = time.perf_counter() - t0
-    tri_rate = (ctx.stats["triple_candidates"] - base) / dt_tri
+        return (ctx.stats["triple_candidates"] - base) / (
+            time.perf_counter() - t0
+        )
+
+    tri = _spread(one_triple)
+
+    # The fused single-dispatch node step (gate_step_stream) — what a
+    # host_small_steps=False run actually pays per gate-mode node, and
+    # the honest device-side comparison point for the README placement
+    # policy (the per-stage kernels above pay one dispatch per stage).
+    ctx.gate_step(st, target, mask)  # warmup
+
+    def one_fused():
+        base = ctx.stats["triple_candidates"]
+        t0 = time.perf_counter()
+        ctx.gate_step(st, target, mask)
+        return (ctx.stats["triple_candidates"] - base) / (
+            time.perf_counter() - t0
+        )
+
+    fused = _spread(one_fused)
+
+    def span(s):
+        return [s.get("min"), s.get("max")]
+
     return {
         "metric": "gate_mode_sweeps",
-        "native_node_triples_per_sec": native_rate,
-        "device_pair_candidates_per_sec": pair_rate,
-        "device_triple_candidates_per_sec": tri_rate,
+        "native_node_triples_per_sec": native["value"],
+        "native_spread": span(native),
+        "device_pair_candidates_per_sec": pair["value"],
+        "device_pair_spread": span(pair),
+        "device_triple_candidates_per_sec": tri["value"],
+        "device_triple_spread": span(tri),
+        "device_fused_step_triples_per_sec": fused["value"],
+        "device_fused_step_spread": [fused["min"], fused["max"]],
         "unit": "cand/s",
     }
 
@@ -371,6 +455,186 @@ def bench_des_s1_outputs_batched() -> dict:
     }
 
 
+def bench_lut7_capped_search() -> dict:
+    """An actual capped 7-LUT search end to end (VERDICT r2 item 5): a
+    planted LUT(LUT,LUT,g) target over a G=40 XOR state floods stage A —
+    the 100k hit cap (reference: lut.c:291,316) binds after ~3% of
+    C(40,7) — and stage B sweeps the capped list to the first solving
+    chunk.  Reports wall time and the stage split."""
+    import time as _t
+
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.context import LUT7_CAP
+    from sboxgates_tpu.search.lut import lut7_search
+
+    rng = np.random.default_rng(5)
+    st = State.init_inputs(8)
+    while st.num_gates < 40:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x96, st.table(9), st.table(17), st.table(25))
+    middle = tt.eval_lut(0xE8, st.table(12), st.table(21), st.table(33))
+    target = tt.eval_lut(0xCA, outer, middle, st.table(30))
+    mask = tt.mask_table(8)
+
+    def run():
+        ctx = SearchContext(Options(seed=1, lut_graph=True, randomize=False))
+        t0 = _t.perf_counter()
+        res = lut7_search(ctx, st, target, mask, [])
+        dt = _t.perf_counter() - t0
+        if res is None:
+            raise RuntimeError("capped 7-LUT search found nothing")
+        return dt, ctx
+
+    run()  # warm
+    dt, ctx = run()
+    prof = {
+        name: round(secs, 3)
+        for name, (secs, _calls) in ctx.prof.snapshot().items()
+        if name.startswith("lut7")
+    }
+    return {
+        "metric": "lut7_capped_search_g40",
+        "value": dt, "unit": "s",
+        "cap": LUT7_CAP,
+        "stage_a_candidates": ctx.stats["lut7_candidates"],
+        "stage_b_rows_solved": ctx.stats["lut7_solved"],
+        "phases": prof,
+    }
+
+
+def bench_batch_axis_pivot() -> dict:
+    """The batch axis in its claimed win regime (VERDICT r2 item 4):
+    pivot-sized states (G=140, C(140,5)=416M — every node makes real
+    device dispatches) searched as R=4 concurrent restarts
+    (run_batched_circuits: threads overlapping device round trips;
+    variable-shape pivot sweeps run per-thread) vs the same 4 jobs
+    serially.  Budgets are capped at G+2 so each attempt sweeps its
+    pivot space, muxes shallowly, and fails — a bounded worst-case node
+    workload, identical across modes."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.batched import run_batched_circuits
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    g = 140
+    st, target, mask = build_state(g)
+
+    def make_jobs():
+        jobs = []
+        for _ in range(4):
+            nst = st.copy()
+            nst.max_gates = g + 2
+            jobs.append((nst, target, mask))
+        return jobs
+
+    def batched_run():
+        ctx = SearchContext(Options(seed=5, lut_graph=True))
+        t0 = time.perf_counter()
+        run_batched_circuits(ctx, make_jobs())
+        return time.perf_counter() - t0
+
+    def serial_run():
+        ctx = SearchContext(Options(seed=5, lut_graph=True))
+        t0 = time.perf_counter()
+        for nst, tg, mk in make_jobs():
+            create_circuit(ctx, nst, tg, mk, [])
+        return time.perf_counter() - t0
+
+    batched_run()  # warm both paths' kernel shapes
+    serial_run()
+    b = _spread(batched_run)
+    s = _spread(serial_run)
+    return {
+        "metric": "batch_axis_pivot_g140_r4",
+        "value": b["value"], "unit": "s",
+        "batched_spread": [b["min"], b["max"]],
+        "serial_s": s["value"], "serial_spread": [s["min"], s["max"]],
+        "batched_wins": b["value"] < s["value"],
+    }
+
+
+def bench_multibox_des() -> dict:
+    """BASELINE config 4: all eight DES S-boxes, output bit 0, LUT mode —
+    one rendezvous batch vs the reference-shaped serial loop (one box at
+    a time)."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.multibox import (
+        load_box_jobs,
+        search_boxes_one_output,
+    )
+
+    paths = [os.path.join(HERE, f"sboxes/des_s{i}.txt") for i in range(1, 9)]
+
+    def run(batched):
+        boxes = load_box_jobs(paths)
+        ctx = SearchContext(Options(seed=7, lut_graph=True))
+        t0 = time.perf_counter()
+        res = search_boxes_one_output(
+            ctx, boxes, 0, save_dir=None, log=lambda s: None, batched=batched
+        )
+        dt = time.perf_counter() - t0
+        gates = {
+            n: (min(s.num_gates - s.num_inputs for s in sts) if sts else None)
+            for n, sts in res.items()
+        }
+        return dt, gates
+
+    run(True)  # warm
+    run(False)
+    bdt, bgates = run(True)
+    sdt, sgates = run(False)
+    return {
+        "metric": "des_s1_s8_batched_lut",
+        "value": bdt, "unit": "s",
+        "serial_s": sdt,
+        "batched_wins": bdt < sdt,
+        "batched_gates": bgates, "serial_gates": sgates,
+    }
+
+
+def bench_permute_sweep() -> dict:
+    """BASELINE config 5: the full --permute sweep of DES S1 (all 64 input
+    permutations), output bit 0, LUT mode, batched vs serial."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.multibox import (
+        permute_sweep_jobs,
+        search_boxes_one_output,
+    )
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    sbox, n = load_sbox(os.path.join(HERE, "sboxes/des_s1.txt"))
+
+    def run(batched):
+        boxes = permute_sweep_jobs(sbox, n)
+        ctx = SearchContext(Options(seed=7, lut_graph=True))
+        t0 = time.perf_counter()
+        res = search_boxes_one_output(
+            ctx, boxes, 0, save_dir=None, log=lambda s: None, batched=batched
+        )
+        dt = time.perf_counter() - t0
+        best = min(
+            (min(s.num_gates - s.num_inputs for s in sts), name)
+            for name, sts in res.items() if sts
+        )
+        return dt, best
+
+    run(True)  # warm both paths' kernel shapes
+    run(False)
+    bdt, bbest = run(True)
+    sdt, sbest = run(False)
+    return {
+        "metric": "permute_sweep_des_s1_p64",
+        "value": bdt, "unit": "s",
+        "serial_s": sdt,
+        "batched_wins": bdt < sdt,
+        "best_gates_batched": bbest, "best_gates_serial": sbest,
+        "permutations": 1 << n,
+    }
+
+
 def bench_pallas_exec(best) -> dict:
     """Circuit-execution throughput of the Pallas kernel backend on a
     searched DES S1 LUT circuit (the reference's CUDA-LOP3 counterpart,
@@ -520,6 +784,10 @@ def main() -> None:
         detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
     run(bench_des_s1_sat_not)
     run(bench_des_s1_outputs_batched)
+    run(bench_lut7_capped_search)
+    run(bench_batch_axis_pivot)
+    run(bench_multibox_des)
+    run(bench_permute_sweep)
     run(bench_pallas_exec, best)
 
     with open(os.path.join(HERE, "BENCH_DETAIL.json"), "w") as f:
