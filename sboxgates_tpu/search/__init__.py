@@ -1,6 +1,13 @@
 from .context import Options, SearchContext  # noqa: F401
 from .kwan import create_circuit  # noqa: F401
 from .lut import lut_search  # noqa: F401
+from .multibox import (  # noqa: F401
+    BoxJob,
+    load_box_jobs,
+    permute_sweep_jobs,
+    search_boxes_all_outputs,
+    search_boxes_one_output,
+)
 from .orchestrator import (  # noqa: F401
     generate_graph,
     generate_graph_one_output,

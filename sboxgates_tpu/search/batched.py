@@ -299,10 +299,44 @@ def run_batched_circuits(
 
     jobs: list of (state, target, mask) — each state is owned by its job
     (mutated in place).  Returns [(state, out_gid)] in job order.
-    """
+
+    Gating (measured): GATE-MODE batches on a single-core host execute
+    sequentially.  Gate-mode nodes route to the native host at every
+    reachable size (NATIVE_STEP_MAX_G covers MAX_GATES, so the property
+    is stable as states grow — unlike LUT mode, whose nodes start
+    host-only and cross into pivot dispatches), which means the threads
+    have nothing to overlap: native C calls release the GIL, but one
+    core has nowhere to run them, and the measured cost is ~1.4x
+    (BENCH_DETAIL des_s1 batched runs).  The sequential path uses the
+    identical per-job seeds, so results are bit-identical to the
+    threaded run; multi-core hosts keep the threads (the GIL-released
+    native steps genuinely parallelize there), and LUT-mode batches
+    always do (their later nodes make real dispatches worth merging —
+    bench_batch_axis_pivot measures that regime)."""
+    import os
+
     n = len(jobs)
     rdv = Rendezvous(n)
     seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
+
+    if (
+        (os.cpu_count() or 2) <= 1
+        and not ctx.opt.lut_graph
+        and all(ctx.node_host_only(st) for st, _, _ in jobs)
+    ):
+        results = []
+        for i, (nst, target, mask) in enumerate(jobs):
+            rctx = RestartContext(ctx, seeds[i], Rendezvous(1))
+            out = create_circuit(rctx, nst, target, mask, [])
+            rctx.merge_stats_into(ctx, rdv.cv)
+            results.append((nst, out))
+        ctx.stats["restart_batch_dispatches"] = (
+            ctx.stats.get("restart_batch_dispatches", 0) + 0
+        )
+        ctx.stats["restart_batch_submits"] = (
+            ctx.stats.get("restart_batch_submits", 0) + 0
+        )
+        return results
     results: List[Optional[tuple]] = [None] * n
     errors: List[BaseException] = []
 

@@ -67,9 +67,12 @@ LUT7_HEAD_SOLVE_ROWS = 256
 # no-decomposition row costs ~2.6 ms natively (full 70-ordering scan;
 # hits exit at the first valid ordering, microseconds) vs ~75 ms for a
 # dispatch through the network-attached chip — break-even near 28 rows.
-# On a CPU backend the "dispatch" is itself slow host compute (the
-# pair-matmul solver without an MXU), so the native solver takes every
-# list it can hold.
+# Re-measured with spread every bench run: BENCH_DETAIL.json
+# `lut7_break_even` (value = implied break-even rows on the current
+# link; host/device medians with min/max).  On a CPU backend the
+# "dispatch" is itself slow host compute (the pair-matmul solver
+# without an MXU, measured ~500-row break-even), so the native solver
+# takes every list it can hold.
 NATIVE_LUT7_SOLVE_MAX = 24
 
 

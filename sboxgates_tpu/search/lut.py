@@ -222,6 +222,17 @@ def pivot_tile_shape(g: int) -> Tuple[int, int]:
     return 512, 512
 
 
+def pivot_tile_batch() -> int:
+    """Tiles per pivot-stream loop iteration (SBG_PIVOT_TILE_BATCH,
+    default 1).  >1 batches the per-tile matmuls to amortize MXU
+    pipeline fill and loop overhead — an A/B lever for on-chip tuning
+    (ROOFLINE.md, levers); results are order-identical for every value
+    when not randomizing."""
+    import os
+
+    return max(1, int(os.environ.get("SBG_PIVOT_TILE_BATCH", "1")))
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
@@ -396,6 +407,7 @@ def _lut5_search_pivot(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
                 jw, jm, ctx.next_seed(), tl=tl, th=th,
+                tile_batch=pivot_tile_batch(),
             )
         )
         status, next_t = int(v[0]), int(v[8])

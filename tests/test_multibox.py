@@ -132,6 +132,25 @@ def test_permute_sweep_targets():
         _assert_realizes(box, states[0], 0)
 
 
+def test_multibox_under_mesh_serial():
+    """Config 4 under --mesh: jobs run serially through the mesh-sharded
+    engine (auto batched=False); every box still gets a verified
+    circuit."""
+    from sboxgates_tpu.parallel import MeshPlan, make_mesh
+
+    boxes = _boxes(["crypto1_fa", "crypto1_fb"])
+    ctx = SearchContext(
+        Options(seed=5, lut_graph=True), mesh_plan=MeshPlan(make_mesh())
+    )
+    res = search_boxes_one_output(
+        ctx, boxes, 0, save_dir=None, log=lambda s: None
+    )
+    for box in boxes:
+        states = res[box.name]
+        assert states, f"{box.name}: nothing found"
+        _assert_realizes(box, states[0], 0)
+
+
 def test_multibox_mesh_guard():
     """Explicit batched=True under a mesh is rejected (host threads
     cannot share GSPMD-owned devices)."""

@@ -230,3 +230,38 @@ def test_lut7_capped_overflow_sharded():
         LUT7_HEAD_SOLVE_ROWS, _native_lut7_solve_max()
     )
     assert ctx.stats["lut7_candidates"] > 0
+
+
+def test_pivot_tile_batch_parity(monkeypatch):
+    """tile_batch=2 must return the identical decomposition (and a
+    genuine miss, exercising the batched exhaustion path) as
+    tile_batch=1 — selection is tile-order resolved, so non-randomized
+    runs are bit-identical for every batch size."""
+    from functools import reduce
+
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search.lut import lut5_search
+
+    st, target, mask = build_planted_lut5()
+    # AND of all 8 inputs is 1 at exactly one point; the state's gates
+    # are all linear (IN/XOR), and any 5 linear forms partition the cube
+    # into cells of >= 8 points, so the single-1 cell always mixes
+    # required values: infeasible for EVERY tuple — a guaranteed miss.
+    miss_target = reduce(
+        lambda a, b: np.asarray(a) & np.asarray(b),
+        [st.table(i) for i in range(8)],
+    )
+
+    def run():
+        ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+        hit = lut5_search(ctx, st, target, mask, [])
+        miss = lut5_search(ctx, st, miss_target, mask, [])
+        return hit, miss
+
+    base_hit, base_miss = run()
+    assert base_hit is not None and base_miss is None
+    monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "2")
+    b2_hit, b2_miss = run()
+    assert base_hit == b2_hit
+    assert b2_miss is None
