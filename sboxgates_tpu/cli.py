@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run multi-S-box / permute-sweep jobs serially "
                         "instead of as a rendezvous batch (automatic under "
                         "--mesh)")
+    p.add_argument("--shard-sweep", action="store_true",
+                   help="multi-host: partition the multi-box / permute "
+                        "sweep across processes (each process searches its "
+                        "own slice on a local-device mesh) instead of "
+                        "running every search as one pod-wide collective")
     p.add_argument("--serial-mux", action="store_true",
                    help="disable concurrent exploration of mux select bits "
                         "(single in-flight device sweep at a time)")
@@ -124,6 +129,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _err("--permute-sweep takes a single S-box file and no -g.")
     if args.permute_sweep and args.permute:
         return _err("--permute-sweep replaces -p; do not combine them.")
+    if args.shard_sweep and not (multibox or args.permute_sweep):
+        return _err(
+            "--shard-sweep requires a sweep to shard: multiple S-box "
+            "files or --permute-sweep."
+        )
 
     # Conversion mode: deserialize -> emit, no search (sboxgates.c:1097-1114).
     if args.convert_c or args.convert_dot:
@@ -167,7 +177,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         dist.initialize(args.coordinator, args.num_processes, args.process_id)
         args.mesh = True
         args.seed = dist.shared_seed(args.seed)
-        if not dist.is_primary():
+        if args.shard_sweep:
+            # Job sharding: every process owns its slice's side effects;
+            # logs are rank-tagged (the reference's per-rank find lines).
+            import jax as _jax
+
+            _rank = _jax.process_index()
+            log = lambda s: print(f"[{_rank:4d}] {s}")  # noqa: E731
+        elif not dist.is_primary():
             # Side effects belong to process 0 (reference: rank-0-gated
             # printing and save_state).
             args.output_dir = None
@@ -205,7 +222,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         parallel_mux=False if args.serial_mux else None,
     )
     mesh_plan = None
-    if args.mesh:
+    if args.shard_sweep:
+        # Job-sharded sweeps run each process's slice on a mesh of its
+        # LOCAL devices — no pod-wide collectives.
+        import jax
+
+        from .parallel import MeshPlan, make_mesh
+
+        mesh_plan = MeshPlan(make_mesh(jax.local_devices()))
+    elif args.mesh:
         from .parallel import MeshPlan, make_mesh
 
         mesh_plan = MeshPlan(make_mesh())
@@ -226,6 +251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .search.multibox import (
             load_box_jobs,
             permute_sweep_jobs,
+            process_slice,
             search_boxes_all_outputs,
             search_boxes_one_output,
         )
@@ -239,6 +265,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _err("Error when opening target S-box file.")
         except SboxError as e:
             return _err(str(e))
+        if args.shard_sweep:
+            # Pod-scale mode: this process searches only its slice (the
+            # ctx already holds the local-device mesh).
+            try:
+                boxes = process_slice(boxes)
+            except ValueError as e:
+                return _err(f"Error: {e}")
         batched = False if (args.serial_jobs or args.mesh) else None
         try:
             if args.single_output != -1:

@@ -58,6 +58,18 @@ RESTARTS_AXIS = "restarts"
 GATHER_ROWS = int(os.environ.get("SBG_GATHER_ROWS", "256"))
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when collectives on this mesh cross process boundaries.  A
+    LOCAL mesh (job-sharded sweeps build one per process from
+    jax.local_devices()) keeps every gather addressable and needs none
+    of the multi-host output/agreement machinery even when the global
+    runtime has many processes."""
+    pi = jax.process_index()
+    return any(
+        d.process_index != pi for d in np.asarray(mesh.devices).flat
+    )
+
+
 def make_mesh(
     devices: Optional[Sequence] = None, restarts: int = 1
 ) -> Mesh:
@@ -82,6 +94,7 @@ class MeshPlan:
         self.n_candidate_shards = mesh.shape[CANDIDATES_AXIS]
         self._sharded = NamedSharding(mesh, P(CANDIDATES_AXIS))
         self._replicated = NamedSharding(mesh, P())
+        self.spans_processes = mesh_spans_processes(mesh)
 
     def shard_chunk(self, arr, fill=0):
         """Places a [N, ...] candidate array sharded along axis 0, padding
@@ -220,7 +233,7 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int, compact: bool = False):
             r0 = jax.lax.all_gather(r0, CANDIDATES_AXIS, tiled=True)
         return verdict, feasible, r1, r0
 
-    multihost = jax.process_count() > 1
+    multihost = mesh_spans_processes(mesh)
     big = P() if multihost else P(CANDIDATES_AXIS)
     if multihost and compact:
         out_specs = (P(), P(), P(), P(), P())

@@ -428,8 +428,6 @@ class SearchContext:
         base_args, total, chunk = prebuilt
         args = (*base_args, start, total)
         if self.mesh_plan is not None:
-            import jax
-
             from ..parallel.mesh import sharded_feasible_stream
 
             # The sharded kernel rounds the chunk up to a device multiple and
@@ -437,7 +435,7 @@ class SearchContext:
             # resume at exactly the next unswept rank.
             n = self.mesh_plan.n_candidate_shards
             chunk = -(-chunk // n) * n
-            if jax.process_count() > 1:
+            if self.mesh_plan.spans_processes:
                 return self._multihost_stream(args, k, chunk, n)
             verdict, feas, r1, r0 = sharded_feasible_stream(
                 self.mesh_plan, *args, k=k, chunk=chunk
@@ -570,11 +568,14 @@ class SearchContext:
             and st.num_gates <= NATIVE_STEP_MAX_G
         ):
             return False
-        if self.mesh_plan is not None:
-            # Multi-host: every process must agree on the routing, or a
-            # native-less host would enter a device collective the others
-            # never join (and the seed streams would diverge).  One
-            # all-gather at first use, cached.
+        if self.mesh_plan is not None and self.mesh_plan.spans_processes:
+            # Process-spanning mesh: every process must agree on the
+            # routing, or a native-less host would enter a device
+            # collective the others never join (and the seed streams
+            # would diverge).  One all-gather at first use, cached.
+            # Local meshes (job-sharded sweeps) skip this: their
+            # collectives never cross processes, so divergent routing
+            # between processes is harmless.
             return self._native_all_procs()
         return self._native_ok()
 

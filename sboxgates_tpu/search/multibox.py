@@ -63,6 +63,30 @@ class BoxJob:
 from ..utils.sbox import permuted_box  # noqa: E402,F401
 
 
+def process_slice(boxes: Sequence[BoxJob]) -> List[BoxJob]:
+    """This process's slice of a job-sharded sweep (round-robin by
+    process index): the pod-scale execution mode for configs 4-5, where
+    each host searches its own boxes/permutations on a LOCAL device mesh
+    instead of every search being one pod-wide collective — the analog
+    of launching the reference binary once per -p value across a
+    cluster, automated.  Round-robin keeps slice sizes within one of
+    each other, bounding the idle tail.
+
+    Requires len(boxes) >= process_count (an empty slice would leave a
+    process with no work while others may expect its collectives)."""
+    import jax
+
+    n = jax.process_count()
+    if n <= 1:
+        return list(boxes)
+    if len(boxes) < n:
+        raise ValueError(
+            f"job sharding needs >= {n} jobs for {n} processes; "
+            f"got {len(boxes)}"
+        )
+    return list(boxes)[jax.process_index()::n]
+
+
 # Concurrent-thread cap per rendezvous wave: run_batched_circuits spawns
 # one OS thread per job and the rendezvous needs every live thread
 # resident at once, so unbounded sweeps (256 permutations x 8 outputs =

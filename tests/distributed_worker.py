@@ -128,3 +128,27 @@ print(
     % (pid, out, st3.num_gates, ctx3.uses_native_step(st3)),
     flush=True,
 )
+
+# Fourth leg: job-sharded sweep (the pod-scale config-5 mode) — each
+# process searches its own slice of the 16-permutation sweep on a mesh of
+# its LOCAL devices (no cross-process collectives).  The parent asserts
+# the two slices are disjoint and cover all permutations.
+from sboxgates_tpu.search.multibox import (  # noqa: E402
+    permute_sweep_jobs,
+    process_slice,
+    search_boxes_one_output,
+)
+
+boxes = permute_sweep_jobs(sbox, n_in)
+mine = process_slice(boxes)
+ctx4 = SearchContext(
+    Options(lut_graph=True, randomize=False, seed=9),
+    mesh_plan=MeshPlan(make_mesh(jax.local_devices())),
+)
+assert not ctx4.mesh_plan.spans_processes
+res4 = search_boxes_one_output(
+    ctx4, mine, 0, save_dir=None, log=lambda s: None, batched=False
+)
+solved = sorted(name for name, sts in res4.items() if sts)
+assert len(solved) == len(mine), (solved, [b.name for b in mine])
+print("SWEEP %d %s" % (pid, ",".join(solved)), flush=True)

@@ -85,6 +85,16 @@ def test_two_process_pivot_search_agrees(gather_rows, het_native):
         # head (process 1 has no native runtime).
         assert "native=False" in " ".join(engines[0]), outs
 
+    # Job-sharded sweep (SWEEP lines): the two processes' permutation
+    # slices must be disjoint and cover all 16 permutations.
+    slices = []
+    for out in outs:
+        sw = [l for l in out.splitlines() if l.startswith("SWEEP ")]
+        assert sw, out
+        slices.append(set(sw[0].split()[2].split(",")))
+    assert not (slices[0] & slices[1]), outs
+    assert slices[0] | slices[1] == {f"p{p:02x}" for p in range(16)}, outs
+
     # Independently verify both decompositions against the planted targets.
     from planted import (
         build_planted_lut5,
