@@ -222,18 +222,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         parallel_mux=False if args.serial_mux else None,
     )
     mesh_plan = None
-    if args.shard_sweep:
-        # Job-sharded sweeps run each process's slice on a mesh of its
-        # LOCAL devices — no pod-wide collectives.
+    if args.shard_sweep or args.mesh:
         import jax
 
         from .parallel import MeshPlan, make_mesh
 
-        mesh_plan = MeshPlan(make_mesh(jax.local_devices()))
-    elif args.mesh:
-        from .parallel import MeshPlan, make_mesh
-
-        mesh_plan = MeshPlan(make_mesh())
+        # Job-sharded sweeps run each process's slice on a mesh of its
+        # LOCAL devices (no pod-wide collectives); plain --mesh spans
+        # every visible device.
+        devices = jax.local_devices() if args.shard_sweep else None
+        mesh_plan = MeshPlan(make_mesh(devices))
     ctx = SearchContext(opt, mesh_plan=mesh_plan)
 
     if args.verbose >= 1:

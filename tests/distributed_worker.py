@@ -129,6 +129,33 @@ print(
     flush=True,
 )
 
+# k=7 compacted-stream shape coverage: the 7-LUT constraints are packed
+# as [rows, 4] words (vs scalar words for k<=5); the compact gather and
+# dense reconstruction must agree with the full gather for that shape
+# too.
+from planted import build_planted_lut7  # noqa: E402
+
+st7, target7, mask7 = build_planted_lut7()
+ctx7 = SearchContext(
+    Options(lut_graph=True, randomize=False), mesh_plan=plan
+)
+pre7 = ctx7.stream_args(st7, target7, mask7, [], 7)
+found7, c7, feas7, r17, r07, _, _ = ctx7.feasible_stream_driver(
+    st7, target7, mask7, [], k=7, prebuilt=pre7
+)
+assert found7, "planted 7-LUT chunk must contain feasible rows"
+base7, total7, chunk70 = pre7
+chunk7 = -(-chunk70 // n) * n
+_, feas7f, r17f, r07f = sharded_feasible_stream(
+    plan, *base7, c7, total7, k=7, chunk=chunk7, compact=False
+)
+feas7f, r17f, r07f = (np.asarray(x) for x in (feas7f, r17f, r07f))
+feas7, r17, r07 = (np.asarray(x) for x in (feas7, r17, r07))
+assert (feas7 == feas7f).all()
+assert (r17[feas7f] == r17f[feas7f]).all()
+assert (r07[feas7f] == r07f[feas7f]).all()
+print("STREAMCHECK7 %d ok rows=%d" % (pid, int(feas7f.sum())), flush=True)
+
 # Fourth leg: job-sharded sweep (the pod-scale config-5 mode) — each
 # process searches its own slice of the 16-permutation sweep on a mesh of
 # its LOCAL devices (no cross-process collectives).  The parent asserts

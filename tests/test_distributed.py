@@ -73,7 +73,8 @@ def test_two_process_pivot_search_agrees(gather_rows, het_native):
         lines2 = [l for l in out.splitlines() if l.startswith("RESULT2 ")]
         eng = [l for l in out.splitlines() if l.startswith("ENGINE ")]
         assert lines and lines2 and eng, out
-        assert any(l.startswith("STREAMCHECK") for l in out.splitlines()), out
+        assert any(l.startswith("STREAMCHECK ") for l in out.splitlines()), out
+        assert any(l.startswith("STREAMCHECK7 ") for l in out.splitlines()), out
         results.append(lines[0].split()[2:])  # drop "RESULT <pid>"
         results2.append(lines2[0].split()[2:])
         engines.append(eng[0].split()[2:])

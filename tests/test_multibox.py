@@ -176,9 +176,18 @@ def test_cli_multibox_contract(tmp_path, monkeypatch):
     assert main(["-g", "x.xml", s1, s2]) != 0
     assert main(["--permute-sweep", "-p", "3", s1]) != 0
     assert main(["--permute-sweep", s1, s2]) != 0
+    assert main(["--shard-sweep", "-o", "0", s1]) != 0  # nothing to shard
     monkeypatch.chdir(tmp_path)
     rc = main(["-o", "0", "-i", "1", "-l", "--seed", "2",
                "--output-dir", str(tmp_path), s1, s2])
     assert rc == 0
     assert list((tmp_path / "des_s1").glob("*.xml"))
     assert list((tmp_path / "des_s2").glob("*.xml"))
+
+
+def test_process_slice_single_process():
+    """Single process: the slice is the whole list (identity)."""
+    from sboxgates_tpu.search.multibox import process_slice
+
+    boxes = _boxes(["des_s1", "des_s2"])
+    assert [b.name for b in process_slice(boxes)] == ["des_s1", "des_s2"]
