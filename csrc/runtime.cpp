@@ -48,7 +48,10 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -957,6 +960,441 @@ void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
   out4[1] = best_t;
   out4[2] = s;
   out4[3] = flat_sel;
+}
+
+// ---------------------------------------------------------------------
+// Native gate-mode search ENGINE: the whole create_circuit recursion for
+// gate-mode (non-LUT) searches, host-side.  Per-node profiling showed
+// ~64% of gate-mode wall time in the Python recursion (state copies,
+// mux fold, bookkeeping) around the native step; running the recursion
+// itself natively removes that overhead.  Semantics mirror
+// search/kwan.py step for step (which mirrors sboxgates.c:282-616);
+// with randomize off the engine's result is BIT-IDENTICAL to the
+// Python engine's (enforced by tests/test_native.py), with randomize on
+// it draws from its own splitmix64 stream (documented divergence: numpy
+// PCG64 is not replicated), staying deterministic per seed.
+// ---------------------------------------------------------------------
+
+}  // extern "C"
+
+namespace {
+
+constexpr int32_t ENG_NO_GATE = 0xFFFF;
+enum { EGT_AND = 1, EGT_XOR = 6, EGT_OR = 7 };
+
+// SAT/CNF weights per gate type (graph/state.py SAT_METRIC; reference
+// get_sat_metric, state.c:168-191).  Indexed by gate-type enum value.
+static const int32_t SAT_W[18] = {1, 7, 4, 4, 7, 4, 12, 7, 7,
+                                  12, 4, 7, 4, 7, 7, 1, 4, 0};
+
+inline uint64_t sm64_next(uint64_t& s) {
+  s += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = s;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+struct EngGate {
+  int32_t type, in1, in2, in3;
+};
+
+// Value-copied search state (the copy semantics are load-bearing for
+// the mux backtracking, exactly as in graph/state.py / state.h:81-88).
+struct EngState {
+  int32_t max_gates;
+  int64_t sat, max_sat;
+  std::vector<TT> tabs;
+  std::vector<EngGate> gd;
+  int32_t ng() const { return (int32_t)gd.size(); }
+};
+
+struct EngCfg {
+  const int16_t* pair_mt;
+  const int16_t* not_mt;
+  const int16_t* triple_mt;
+  const int32_t* pair_ops;  // [n][8]: n_in, fun1, fun2, na, nb, nc, nout, perm
+  const int32_t* not_ops;
+  const int32_t* tri_ops;
+  int32_t metric;  // 0 = gates, 1 = SAT
+  int32_t num_inputs;
+  bool randomize;
+  uint64_t rng;
+  int64_t nodes, pair_cand, triple_cand;
+};
+
+inline int32_t eng_bucket(int32_t g) { return g <= 64 ? 64 : 512; }
+
+// graph/state.py add_gate semantics, exactly (incl. check order).
+int32_t eng_add_gate(EngState& st, const EngCfg& C, int32_t type,
+                     int32_t g1, int32_t g2) {
+  if (g1 == ENG_NO_GATE || (g2 == ENG_NO_GATE && type != GT_NOT))
+    return ENG_NO_GATE;
+  if (st.ng() > st.max_gates) return ENG_NO_GATE;
+  if (C.metric == 1 && st.sat > st.max_sat) return ENG_NO_GATE;
+  st.sat += SAT_W[type];
+  TT t;
+  if (type == GT_NOT) {
+    t = tt_not(st.tabs[g1]);
+    g2 = ENG_NO_GATE;
+  } else {
+    t = tt_gate2(type, st.tabs[g1], st.tabs[g2]);
+  }
+  st.tabs.push_back(t);
+  st.gd.push_back({type, g1, g2, ENG_NO_GATE});
+  return st.ng() - 1;
+}
+
+inline int32_t eng_add_not(EngState& st, const EngCfg& C, int32_t g1) {
+  if (g1 == ENG_NO_GATE) return ENG_NO_GATE;
+  return eng_add_gate(st, C, GT_NOT, g1, ENG_NO_GATE);
+}
+
+inline int32_t eng_add_and(EngState& st, const EngCfg& C, int32_t g1,
+                           int32_t g2) {
+  if (g1 == ENG_NO_GATE || g2 == ENG_NO_GATE) return ENG_NO_GATE;
+  if (g1 == g2) return g1;
+  return eng_add_gate(st, C, EGT_AND, g1, g2);
+}
+
+inline int32_t eng_add_or(EngState& st, const EngCfg& C, int32_t g1,
+                          int32_t g2) {
+  if (g1 == ENG_NO_GATE || g2 == ENG_NO_GATE) return ENG_NO_GATE;
+  if (g1 == g2) return g1;
+  return eng_add_gate(st, C, EGT_OR, g1, g2);
+}
+
+inline int32_t eng_add_xor(EngState& st, const EngCfg& C, int32_t g1,
+                           int32_t g2) {
+  if (g1 == ENG_NO_GATE || g2 == ENG_NO_GATE) return ENG_NO_GATE;
+  return eng_add_gate(st, C, EGT_XOR, g1, g2);
+}
+
+// Materialize a match-table entry (state.py add_boolfunc_2/3; reference
+// sboxgates.c:184-229).  gids: the tuple's gate ids in combination
+// order; the op row's perm reorders them into operand slots.
+int32_t eng_apply_op(EngState& st, const EngCfg& C, const int32_t* op,
+                     const int32_t* gids) {
+  const int32_t n_in = op[0], fun1 = op[1], fun2 = op[2];
+  const int32_t na = op[3], nb = op[4], nc = op[5], nout = op[6];
+  const int32_t perm = op[7];
+  int32_t g1 = gids[perm & 3];
+  int32_t g2 = gids[(perm >> 2) & 3];
+  if (st.ng() > st.max_gates) return ENG_NO_GATE;
+  if (C.metric == 1 && st.sat > st.max_sat) return ENG_NO_GATE;
+  if (n_in == 2) {
+    if (na) g1 = eng_add_not(st, C, g1);
+    if (nb) g2 = eng_add_not(st, C, g2);
+    int32_t out = eng_add_gate(st, C, fun1, g1, g2);
+    if (nout) out = eng_add_not(st, C, out);
+    return out;
+  }
+  int32_t g3 = gids[(perm >> 4) & 3];
+  if (na) g1 = eng_add_not(st, C, g1);
+  if (nb) g2 = eng_add_not(st, C, g2);
+  if (nc) g3 = eng_add_not(st, C, g3);
+  int32_t out1 = eng_add_gate(st, C, fun1, g1, g2);
+  int32_t out = eng_add_gate(st, C, fun2, out1, g3);
+  if (nout) out = eng_add_not(st, C, out);
+  return out;
+}
+
+inline bool eng_check_possible(const EngState& st, const EngCfg& C,
+                               int32_t add, int32_t add_sat) {
+  if (C.metric == 1 && st.sat + add_sat > st.max_sat) return false;
+  if (st.ng() + add > st.max_gates) return false;
+  return true;
+}
+
+inline void eng_verify(const EngState& st, int32_t gid, const TT& target,
+                       const TT& mask) {
+  if (gid == ENG_NO_GATE) return;
+  if (!tt_eq_mask(st.tabs[gid], target, mask)) {
+    std::fprintf(stderr,
+                 "sbg_gate_engine: gate %d does not realize target\n", gid);
+    std::abort();  // the reference's ASSERT_AND_RETURN (sboxgates.h:31-44)
+  }
+}
+
+// Pair index over the bucket-row triangular grid -> (i, j)
+// (np.triu_indices order; inverse of pair_stage's row0 + j).
+inline void eng_decode_pair(int64_t idx, int32_t bucket, int32_t* i,
+                            int32_t* j) {
+  int32_t a = 0;
+  while (true) {
+    const int64_t base_next =
+        (int64_t)(a + 1) * bucket - (int64_t)(a + 1) * (a + 2) / 2;
+    if (base_next > idx) break;
+    a++;
+  }
+  const int64_t base = (int64_t)a * bucket - (int64_t)a * (a + 1) / 2;
+  *i = a;
+  *j = (int32_t)(idx - base) + a + 1;
+}
+
+// Lexicographic rank -> 3-combination over g (ops/combinatorics
+// unrank_combination semantics).
+inline void eng_unrank3(int64_t rank, int32_t g, int32_t* out) {
+  int32_t a = 0;
+  int32_t prev = -1;
+  for (int32_t slot = 0; slot < 3; slot++) {
+    for (int32_t v = prev + 1; v < g; v++) {
+      const int64_t block = n_choose_k(g - 1 - v, 2 - slot);
+      if (rank < block) {
+        out[slot] = v;
+        prev = v;
+        break;
+      }
+      rank -= block;
+    }
+    (void)a;
+  }
+}
+
+int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
+                   const int32_t* inbits, int32_t n_inbits);
+
+// One select bit of the step-5 multiplexer (kwan._mux_try_bit gate-mode
+// branch; sboxgates.c:516-567).  Returns true with *out_state/*out_gid.
+bool eng_mux_try_bit(const EngState& st, EngCfg& C, const TT& target,
+                     const TT& mask, int32_t bit, const int32_t* tracked,
+                     int32_t n_tracked, EngState* out_state,
+                     int32_t* out_gid) {
+  int32_t next_inbits[8];
+  for (int32_t i = 0; i < n_tracked; i++) next_inbits[i] = tracked[i];
+  next_inbits[n_tracked] = bit;
+  const int32_t n_next = n_tracked + 1;
+  const TT fsel = st.tabs[bit];
+
+  // AND-based mux: out = fb ^ (sel & fc')  (sboxgates.c:516-537)
+  EngState na = st;
+  na.max_gates -= 2;
+  na.max_sat -= SAT_W[EGT_AND] + SAT_W[EGT_XOR];
+  const int32_t fb = eng_search(na, C, tt_and(target, tt_not(fsel)),
+                                tt_and(mask, tt_not(fsel)), next_inbits,
+                                n_next);
+  int32_t mux_and = ENG_NO_GATE;
+  if (fb != ENG_NO_GATE) {
+    const int32_t fc =
+        eng_search(na, C, tt_xor(na.tabs[fb], target), tt_and(mask, fsel),
+                   next_inbits, n_next);
+    na.max_gates += 2;
+    na.max_sat += SAT_W[EGT_AND] + SAT_W[EGT_XOR];
+    const int32_t andg = eng_add_and(na, C, fc, bit);
+    mux_and = eng_add_xor(na, C, fb, andg);
+    if (mux_and != ENG_NO_GATE) eng_verify(na, mux_and, target, mask);
+  }
+
+  // OR-based mux: out = fd ^ (sel | fe)  (sboxgates.c:539-567), budget
+  // tightened to beat the AND result (sboxgates.c:540-543).
+  EngState no = st;
+  if (mux_and != ENG_NO_GATE) {
+    no.max_gates = na.ng();
+    no.max_sat = na.sat;
+  }
+  no.max_gates -= 2;
+  no.max_sat -= SAT_W[EGT_OR] + SAT_W[EGT_XOR];
+  const int32_t fd = eng_search(no, C, tt_and(tt_not(target), fsel),
+                                tt_and(mask, fsel), next_inbits, n_next);
+  int32_t mux_or = ENG_NO_GATE;
+  if (fd != ENG_NO_GATE) {
+    const int32_t fe =
+        eng_search(no, C, tt_xor(no.tabs[fd], target),
+                   tt_and(mask, tt_not(fsel)), next_inbits, n_next);
+    no.max_gates += 2;
+    no.max_sat += SAT_W[EGT_OR] + SAT_W[EGT_XOR];
+    const int32_t org = eng_add_or(no, C, fe, bit);
+    mux_or = eng_add_xor(no, C, fd, org);
+    if (mux_or != ENG_NO_GATE) eng_verify(no, mux_or, target, mask);
+    no.max_gates = st.max_gates;
+    no.max_sat = st.max_sat;
+  }
+
+  if (mux_and == ENG_NO_GATE && mux_or == ENG_NO_GATE) return false;
+  bool use_and;
+  if (C.metric == 0) {
+    use_and = mux_or == ENG_NO_GATE ||
+              (mux_and != ENG_NO_GATE && na.ng() < no.ng());
+  } else {
+    use_and = mux_or == ENG_NO_GATE ||
+              (mux_and != ENG_NO_GATE && na.sat < no.sat);
+  }
+  if (use_and) {
+    *out_state = std::move(na);
+    *out_gid = mux_and;
+  } else {
+    *out_state = std::move(no);
+    *out_gid = mux_or;
+  }
+  return true;
+}
+
+// The gate-mode create_circuit recursion (kwan._create_circuit without
+// the LUT branches; sboxgates.c:282-616).
+int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
+                   const int32_t* inbits, int32_t n_inbits) {
+  C.nodes++;
+  const int32_t g = st.ng();
+  const bool has_not = C.not_mt != nullptr;
+  const bool has_triple = g >= 3 && C.triple_mt != nullptr;
+  const int64_t total3 = has_triple ? (int64_t)n_choose_k(g, 3) : 0;
+  const int32_t chunk3 = total3 <= 1024 ? 1024 : 32768;
+  const int32_t seed =
+      C.randomize ? (int32_t)(sm64_next(C.rng) & 0x7FFFFFFF) : -1;
+
+  int32_t out4[4];
+  sbg_gate_step(reinterpret_cast<const uint64_t*>(st.tabs.data()), g,
+                eng_bucket(g), target.w, mask.w, C.pair_mt,
+                has_not ? C.not_mt : nullptr,
+                has_triple ? C.triple_mt : nullptr, total3, chunk3, seed,
+                out4);
+  const int32_t step = out4[0];
+  // Stats exactly as context._gate_step_native counts them.
+  if (step == 0 || step >= 3) C.pair_cand += (int64_t)g * (g - 1) / 2;
+  if (has_triple && (step == 0 || step == 5)) C.triple_cand += out4[3];
+
+  if (step == 1) {
+    eng_verify(st, out4[1], target, mask);
+    return out4[1];
+  }
+  if (!eng_check_possible(st, C, 1, SAT_W[GT_NOT])) return ENG_NO_GATE;
+  if (step == 2) {
+    const int32_t ret = eng_add_not(st, C, out4[1]);
+    eng_verify(st, ret, target, mask);
+    return ret;
+  }
+  if (!eng_check_possible(st, C, 1, SAT_W[EGT_AND])) return ENG_NO_GATE;
+  if (step == 3) {
+    int32_t i, j;
+    eng_decode_pair(out4[1], eng_bucket(g), &i, &j);
+    const int32_t gids[3] = {i, j, 0};
+    const int32_t ret = eng_apply_op(st, C, C.pair_ops + out4[2] * 8, gids);
+    eng_verify(st, ret, target, mask);
+    return ret;
+  }
+  if (!eng_check_possible(st, C, 2, SAT_W[EGT_AND] + SAT_W[GT_NOT]))
+    return ENG_NO_GATE;
+  if (step == 4) {
+    int32_t i, j;
+    eng_decode_pair(out4[1], eng_bucket(g), &i, &j);
+    const int32_t gids[3] = {i, j, 0};
+    const int32_t ret = eng_apply_op(st, C, C.not_ops + out4[2] * 8, gids);
+    eng_verify(st, ret, target, mask);
+    return ret;
+  }
+  if (!eng_check_possible(st, C, 3, 2 * SAT_W[EGT_AND] + SAT_W[GT_NOT]))
+    return ENG_NO_GATE;
+  if (step == 5) {
+    int32_t trip[3];
+    eng_unrank3(out4[1], g, trip);
+    const int32_t ret = eng_apply_op(st, C, C.tri_ops + out4[2] * 8, trip);
+    eng_verify(st, ret, target, mask);
+    return ret;
+  }
+
+  // Step 5 (Kwan): multiplex over an unused input bit
+  // (sboxgates.c:438-607).  Only the first six used bits are tracked.
+  const int32_t n_tracked = n_inbits < 6 ? n_inbits : 6;
+  int32_t bit_order[8];
+  int32_t n_bits = 0;
+  for (int32_t b = 0; b < C.num_inputs; b++) {
+    bool used = false;
+    for (int32_t i = 0; i < n_tracked; i++) used |= (inbits[i] == b);
+    if (!used) bit_order[n_bits++] = b;
+  }
+  if (n_bits == 0) return ENG_NO_GATE;
+  if (C.randomize) {
+    for (int32_t i = n_bits - 1; i > 0; i--) {
+      const int32_t j = (int32_t)(sm64_next(C.rng) % (uint64_t)(i + 1));
+      std::swap(bit_order[i], bit_order[j]);
+    }
+  }
+
+  EngState best;
+  int32_t best_out = ENG_NO_GATE;
+  bool have = false;
+  for (int32_t bi = 0; bi < n_bits; bi++) {
+    EngState cand;
+    int32_t cand_out;
+    if (!eng_mux_try_bit(st, C, target, mask, bit_order[bi], inbits,
+                         n_tracked, &cand, &cand_out)) {
+      continue;
+    }
+    bool better;
+    if (!have) {
+      better = true;
+    } else if (C.metric == 0) {
+      better = cand.ng() < best.ng();
+    } else {
+      better = cand.sat < best.sat;
+    }
+    if (better) {
+      best = std::move(cand);
+      best_out = cand_out;
+      have = true;
+    }
+  }
+  if (!have) return ENG_NO_GATE;
+  eng_verify(best, best_out, target, mask);
+  st = std::move(best);  // adopt (the reference's *st = best)
+  return best_out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Entry: runs the whole gate-mode search natively; returns the number of
+// gates appended to the input state (replayed by the Python caller onto
+// its State, which re-verifies), or -1 when nothing was found.
+// added: int32[(max_gates + 8) * 4] rows [type, in1, in2, in3];
+// stats out: int64[3] = [nodes, pair_candidates, triple_candidates].
+int64_t sbg_gate_engine(
+    const uint64_t* tables, int32_t g, int32_t num_inputs, int32_t max_gates,
+    int64_t sat_metric, int64_t max_sat_metric, int32_t metric,
+    const uint64_t* target, const uint64_t* mask, const int16_t* pair_mt,
+    const int32_t* pair_ops, const int16_t* not_mt, const int32_t* not_ops,
+    const int16_t* triple_mt, const int32_t* tri_ops, const int32_t* inbits,
+    int32_t n_inbits, int32_t randomize, uint64_t rng_seed, int32_t* out_gid,
+    int32_t* added, int64_t* stats) {
+  EngState st;
+  st.max_gates = max_gates;
+  st.sat = sat_metric;
+  st.max_sat = max_sat_metric;
+  st.tabs.assign(reinterpret_cast<const TT*>(tables),
+                 reinterpret_cast<const TT*>(tables) + g);
+  st.gd.resize(g);  // types of existing gates are irrelevant to the search
+  EngCfg C;
+  C.pair_mt = pair_mt;
+  C.not_mt = not_mt;
+  C.triple_mt = triple_mt;
+  C.pair_ops = pair_ops;
+  C.not_ops = not_ops;
+  C.tri_ops = tri_ops;
+  C.metric = metric;
+  C.num_inputs = num_inputs;
+  C.randomize = randomize != 0;
+  C.rng = rng_seed;
+  C.nodes = C.pair_cand = C.triple_cand = 0;
+
+  TT tgt, msk;
+  std::memcpy(tgt.w, target, sizeof(TT));
+  std::memcpy(msk.w, mask, sizeof(TT));
+  const int32_t gid = eng_search(st, C, tgt, msk, inbits, n_inbits);
+  stats[0] = C.nodes;
+  stats[1] = C.pair_cand;
+  stats[2] = C.triple_cand;
+  if (gid == ENG_NO_GATE) return -1;
+  const int32_t n_added = st.ng() - g;
+  for (int32_t i = 0; i < n_added; i++) {
+    const EngGate& e = st.gd[g + i];
+    added[i * 4 + 0] = e.type;
+    added[i * 4 + 1] = e.in1;
+    added[i * 4 + 2] = e.in2;
+    added[i * 4 + 3] = e.in3;
+  }
+  *out_gid = gid;
+  return n_added;
 }
 
 }  // extern "C"

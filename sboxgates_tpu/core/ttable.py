@@ -200,3 +200,16 @@ def table_as_hex(tt) -> str:
     """Debug representation: 64 hex chars, most significant position first."""
     words = np.asarray(tt, dtype=np.uint32)
     return "".join(f"{int(w):08x}" for w in words[::-1])
+
+
+def ttable_text(tt) -> str:
+    """Byte-format parity with the reference's debug ttable dump
+    (print_ttable, convert_graph.c:28-45): 256 bits as 16 rows of 16
+    '0'/'1' characters, position 0 first, trailing newline."""
+    words = np.asarray(tt, dtype=np.uint32).reshape(8)
+    bits = ((words[:, None] >> np.arange(32)[None, :]) & 1).reshape(256)
+    rows = [
+        "".join(str(int(b)) for b in bits[r : r + 16])
+        for r in range(0, 256, 16)
+    ]
+    return "\n".join(rows) + "\n"

@@ -239,6 +239,25 @@ class State:
             out = self.add_not_gate(out, metric)
         return out
 
+    def replay_gate(self, gate_type: int, gid1: int, gid2: int) -> int:
+        """Appends a gate WITHOUT budget checks: the replay path for
+        results computed by the native engine, which already enforced
+        the add_gate budget rules during its search.  Re-checking here
+        would wrongly reject legal results — the mux recursion
+        temporarily raises budgets (the OR branch runs under the AND
+        branch's achieved size, sboxgates.c:539-543), so an adopted
+        circuit may exceed the ORIGINAL budgets by design, exactly as in
+        the Python engine.  Tables and the SAT metric are recomputed
+        here, never trusted from the engine."""
+        assert gate_type not in (bf.IN, bf.LUT)
+        self.sat_metric += get_sat_metric(gate_type)
+        if gate_type == bf.NOT:
+            table = ~self.tables[gid1]
+            gid2 = NO_GATE
+        else:
+            table = tt.eval_gate2(gate_type, self.tables[gid1], self.tables[gid2])
+        return self._append(Gate(gate_type, gid1, gid2), table)
+
     # -- verification -----------------------------------------------------
 
     def verify_gate(self, gid: int, target: np.ndarray, mask: np.ndarray) -> None:
@@ -250,7 +269,9 @@ class State:
             raise AssertionError(
                 f"gate {gid} does not match target under mask "
                 f"(table {tt.table_as_hex(self.tables[gid])}, "
-                f"target {tt.table_as_hex(target)})"
+                f"target {tt.table_as_hex(target)})\n"
+                "gate table:\n" + tt.ttable_text(self.tables[gid])
+                + "target:\n" + tt.ttable_text(np.asarray(target))
             )
 
 

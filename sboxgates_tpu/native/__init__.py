@@ -216,6 +216,32 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.sbg_lut7_solve_small.restype = None
 
+        lib.sbg_gate_engine.argtypes = [
+            ctypes.c_void_p,  # tables
+            ctypes.c_int32,   # g
+            ctypes.c_int32,   # num_inputs
+            ctypes.c_int32,   # max_gates
+            ctypes.c_int64,   # sat_metric
+            ctypes.c_int64,   # max_sat_metric
+            ctypes.c_int32,   # metric
+            ctypes.c_void_p,  # target
+            ctypes.c_void_p,  # mask
+            ctypes.c_void_p,  # pair_mt
+            ctypes.c_void_p,  # pair_ops
+            ctypes.c_void_p,  # not_mt
+            ctypes.c_void_p,  # not_ops
+            ctypes.c_void_p,  # triple_mt
+            ctypes.c_void_p,  # tri_ops
+            ctypes.c_void_p,  # inbits
+            ctypes.c_int32,   # n_inbits
+            ctypes.c_int32,   # randomize
+            ctypes.c_uint64,  # rng_seed
+            ctypes.c_void_p,  # out_gid
+            ctypes.c_void_p,  # added
+            ctypes.c_void_p,  # stats
+        ]
+        lib.sbg_gate_engine.restype = ctypes.c_int64
+
         _lib = lib
         return lib
 
@@ -436,6 +462,100 @@ class GateStepCaller:
             out.ctypes.data,
         )
         return out
+
+
+class GateEngineCaller:
+    """Per-context entry to the native gate-mode search ENGINE
+    (csrc sbg_gate_engine): the whole create_circuit recursion for
+    non-LUT searches runs in C++, and only the final adopted gate
+    additions come back for the Python State to replay (re-verifying).
+    Caches the match tables and entry-materialization op rows once.
+
+    Op row encoding (int32[8], one per match-table slot):
+    [num_inputs, fun1, fun2, not_a, not_b, not_c, not_out, perm] with
+    perm packing the entry's operand order two bits per slot — exactly
+    what State.add_boolfunc_2/3 + decode_pair/triple_hit do in Python.
+    """
+
+    __slots__ = ("_fn", "_bufs", "pair_mt_a", "pair_ops_a", "not_mt_a",
+                 "not_ops_a", "tri_mt_a", "tri_ops_a")
+
+    @staticmethod
+    def _ops_array(entries) -> np.ndarray:
+        ops = np.zeros((max(len(entries), 1), 8), dtype=np.int32)
+        for i, e in enumerate(entries):
+            f = e.fun
+            perm = 0
+            for slot, p in enumerate(e.perm):
+                perm |= (p & 3) << (2 * slot)
+            ops[i] = (
+                f.num_inputs, f.fun1,
+                0 if f.fun2 is None else f.fun2,
+                int(f.not_a), int(f.not_b), int(f.not_c), int(f.not_out),
+                perm,
+            )
+        return ops
+
+    def __init__(self, pair_table, pair_entries, not_table, not_entries,
+                 triple_table, triple_entries):
+        self._fn = _require().sbg_gate_engine
+        pair_mt = _buf(pair_table, np.int16)
+        pair_ops = self._ops_array(pair_entries)
+        not_mt = None if not_table is None else _buf(not_table, np.int16)
+        not_ops = self._ops_array(not_entries)
+        tri_mt = (
+            None if triple_table is None else _buf(triple_table, np.int16)
+        )
+        tri_ops = self._ops_array(triple_entries)
+        self._bufs = (pair_mt, pair_ops, not_mt, not_ops, tri_mt, tri_ops)
+        self.pair_mt_a = pair_mt.ctypes.data
+        self.pair_ops_a = pair_ops.ctypes.data
+        self.not_mt_a = None if not_mt is None else not_mt.ctypes.data
+        self.not_ops_a = not_ops.ctypes.data
+        self.tri_mt_a = None if tri_mt is None else tri_mt.ctypes.data
+        self.tri_ops_a = tri_ops.ctypes.data
+
+    def __call__(
+        self, tables, g, num_inputs, max_gates, sat_metric, max_sat_metric,
+        metric, target, mask, inbits, randomize, rng_seed, use_not,
+    ):
+        """Returns (out_gid, added int32[n,4], stats int64[3]); out_gid is
+        NO_GATE (0xFFFF) when the search found nothing."""
+        assert tables.flags["C_CONTIGUOUS"] and tables.shape[0] >= g
+        assert tables.shape[-1] * tables.itemsize == 32
+        inb = np.ascontiguousarray(
+            np.asarray(list(inbits) or [0], dtype=np.int32)
+        )
+        out_gid = np.full(1, 0xFFFF, dtype=np.int32)
+        added = np.zeros((max_gates + 8, 4), dtype=np.int32)
+        stats = np.zeros(3, dtype=np.int64)
+        n = self._fn(
+            tables.ctypes.data,
+            g,
+            num_inputs,
+            max_gates,
+            sat_metric,
+            max_sat_metric,
+            metric,
+            target.ctypes.data,
+            mask.ctypes.data,
+            self.pair_mt_a,
+            self.pair_ops_a,
+            self.not_mt_a if use_not else None,
+            self.not_ops_a,
+            self.tri_mt_a,
+            self.tri_ops_a,
+            inb.ctypes.data,
+            len(inbits),
+            int(bool(randomize)),
+            rng_seed & 0xFFFFFFFFFFFFFFFF,
+            out_gid.ctypes.data,
+            added.ctypes.data,
+            stats.ctypes.data,
+        )
+        if n < 0:
+            return 0xFFFF, added[:0], stats
+        return int(out_gid[0]), added[: int(n)], stats
 
 
 def gate_step(

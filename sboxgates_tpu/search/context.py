@@ -160,6 +160,14 @@ class Options:
     # results do not depend on the routing.  Disabled automatically when
     # the native library is unavailable.
     host_small_steps: bool = True
+    # Run the WHOLE gate-mode (non-LUT) create_circuit recursion in the
+    # native engine (csrc sbg_gate_engine) instead of Python driving the
+    # per-node native steps: profiling shows ~64% of gate-mode wall time
+    # is the Python recursion (state copies, mux fold, bookkeeping).
+    # Results are bit-identical to the Python engine when not
+    # randomizing (tests enforce it); randomized runs stay seed-
+    # deterministic but draw from the engine's own PRNG stream.
+    native_engine: bool = True
 
 
 @dataclass(frozen=True)
@@ -283,6 +291,7 @@ class SearchContext:
         self._pair_combo_np_cache = {}
         self._seed_buf = (np.empty(0, dtype=np.int64), 0)
         self._gate_step_caller = None
+        self._gate_engine_caller = None
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
@@ -623,6 +632,30 @@ class SearchContext:
             return True
         g = st.num_gates
         return g < 5 or lut_head_has5(g)
+
+    def uses_native_engine(self, st: State) -> bool:
+        """True when the whole gate-mode recursion for this node runs in
+        the native engine (Options.native_engine; same availability /
+        multi-host agreement rules as the per-node native step)."""
+        return (
+            self.opt.native_engine
+            and not self.opt.lut_graph
+            and self.uses_native_step(st)
+        )
+
+    def gate_engine_caller(self):
+        if self._gate_engine_caller is None:
+            from .. import native
+
+            self._gate_engine_caller = native.GateEngineCaller(
+                self.pair_table_np,
+                self.pair_entries,
+                self.not_table_np,
+                self.not_entries,
+                self.triple_table_np,
+                self.triple_entries,
+            )
+        return self._gate_engine_caller
 
     def _gate_step_native(self, st: State, target, mask):
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical

@@ -42,6 +42,13 @@ def _create_circuit(
     opt = ctx.opt
     metric = opt.metric
 
+    # Gate mode: the whole recursion runs in the native engine when
+    # available (csrc sbg_gate_engine) — Python only replays the final
+    # adopted gate additions and re-verifies.  Bit-identical to the
+    # Python path below when not randomizing.
+    if ctx.uses_native_engine(st):
+        return _native_engine_search(ctx, st, target, mask, inbits)
+
     # Steps 1-4 in ONE fused device dispatch; budget gates are applied
     # host-side in the reference's order (sboxgates.c:301-435).  LUT mode
     # single-device additionally inlines the whole 3-LUT and small-space
@@ -175,6 +182,53 @@ def _create_circuit(
     st.outputs = best.outputs
     st.tables = best.tables
     return best_out
+
+
+def _native_engine_search(
+    ctx: SearchContext, st: State, target, mask, inbits: List[int]
+) -> int:
+    """Runs the gate-mode search in the native engine and replays the
+    final adopted gate additions onto ``st`` (recomputing tables and the
+    SAT metric through the ordinary mutators, then re-verifying — the
+    engine result is never trusted blindly)."""
+    import numpy as np
+
+    eng = ctx.gate_engine_caller()
+    rng_seed = (
+        int(ctx.rng.integers(0, 2**63)) if ctx.opt.randomize else 0
+    )
+    with ctx.prof.phase("gate_engine_native"):
+        out_gid, added, stats = eng(
+            st.live_tables(),
+            st.num_gates,
+            st.num_inputs,
+            st.max_gates,
+            st.sat_metric,
+            st.max_sat_metric,
+            ctx.opt.metric,
+            np.asarray(target),
+            np.asarray(mask),
+            list(inbits),
+            ctx.opt.randomize,
+            rng_seed,
+            use_not=bool(ctx.not_entries),
+        )
+    ctx.stats["pair_candidates"] += int(stats[1])
+    ctx.stats["triple_candidates"] += int(stats[2])
+    ctx.stats["engine_nodes"] = (
+        ctx.stats.get("engine_nodes", 0) + int(stats[0])
+    )
+    if out_gid == NO_GATE:
+        return NO_GATE
+    for row in added:
+        t, i1, i2, _ = (int(x) for x in row)
+        # replay_gate skips budget checks: the engine enforced them
+        # during the search, and the mux recursion's temporary budget
+        # raises mean a legal result can exceed the original budgets
+        # (exactly as the Python engine's can).
+        st.replay_gate(t, i1, i2 if t != bf.NOT else NO_GATE)
+    st.verify_gate(out_gid, target, mask)
+    return out_gid
 
 
 def _mux_try_bit(ctx: SearchContext, st: State, target, mask, bit, tracked):

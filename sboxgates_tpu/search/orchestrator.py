@@ -87,7 +87,12 @@ def generate_graph_one_output(
     cross-iteration budget ratchet, as if run in parallel processes)."""
     opt = ctx.opt
     log(f"Generating graphs for output {output}...")
-    if opt.batch_restarts and opt.iterations > 1:
+    # Batched restarts are host threads sharing rendezvous-merged
+    # dispatches; under a mesh GSPMD owns the devices (and multi-host
+    # runs require a deterministic cross-process collective order that
+    # threads cannot guarantee), so the flag degrades to the serial
+    # loop there, like the multibox drivers' _auto_batched.
+    if opt.batch_restarts and opt.iterations > 1 and ctx.mesh_plan is None:
         from .batched import generate_graph_one_output_batched
 
         return generate_graph_one_output_batched(
@@ -140,7 +145,7 @@ def generate_graph(
             if beam.consider(nst, output) and save_dir is not None:
                 save_state(nst, save_dir)
 
-        if opt.batch_restarts:
+        if opt.batch_restarts and ctx.mesh_plan is None:
             # One rendezvous-batched round: every (iteration x start x
             # missing output) job runs concurrently with round-start
             # budgets (parallel-restart semantics — the mid-round budget

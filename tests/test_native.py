@@ -1,6 +1,7 @@
 """Native runtime (csrc/runtime.cpp) vs. the pure-Python/JAX paths."""
 
 import itertools
+import os
 
 import numpy as np
 import pytest
@@ -12,6 +13,8 @@ from sboxgates_tpu.graph.state import GATES, State
 from sboxgates_tpu.graph import xmlio
 from sboxgates_tpu.ops import combinatorics as comb
 from sboxgates_tpu.utils.sbox import parse_sbox
+
+SBOXES = os.path.join(os.path.dirname(__file__), "..", "sboxes")
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason=f"native lib unavailable: {native.build_error()}"
@@ -607,3 +610,70 @@ def test_lut7_solve_small_matches_device_solver(seed):
         )
         hits += int(dev[0])
     assert hits >= 2
+
+
+def test_gate_engine_matches_python_engine():
+    """The native gate-mode ENGINE (csrc sbg_gate_engine) must produce
+    the bit-identical circuit to the Python recursion when not
+    randomizing — same gates, same order, same SAT metric — across
+    plain, SAT+NOT, and restricted-gate-set configs."""
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import Options, SearchContext, make_targets
+    from sboxgates_tpu.search.kwan import create_circuit
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    cases = [
+        ("crypto1_fa", 0, {}),
+        ("des_s1", 0, {}),
+        ("des_s1", 1, {"metric": 1, "try_nots": True}),
+        ("des_s1", 2, {"avail_gates_bitfield": 10694, "try_nots": True}),
+    ]
+    for box, bit, kw in cases:
+        sbox, n = load_sbox(os.path.join(SBOXES, f"{box}.txt"))
+        targets = make_targets(sbox)
+        mask = tt.mask_table(n)
+        res = {}
+        for engine in (True, False):
+            ctx = SearchContext(
+                Options(seed=1, randomize=False, native_engine=engine, **kw)
+            )
+            st = State.init_inputs(n)
+            out = create_circuit(ctx, st, targets[bit], mask, [])
+            res[engine] = (
+                out,
+                [(g.type, g.in1, g.in2) for g in st.gates],
+                st.sat_metric,
+            )
+            if out != 0xFFFF:
+                st.verify_gate(out, targets[bit], mask)
+        assert res[True] == res[False], (box, bit, kw)
+
+
+def test_gate_engine_randomized_valid_and_deterministic():
+    """Randomized engine runs: deterministic per seed, valid circuits,
+    and different seeds explore different circuits."""
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import Options, SearchContext, make_targets
+    from sboxgates_tpu.search.kwan import create_circuit
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    sbox, n = load_sbox(os.path.join(SBOXES, "des_s1.txt"))
+    targets = make_targets(sbox)
+    mask = tt.mask_table(n)
+
+    def run(seed):
+        ctx = SearchContext(Options(seed=seed))
+        st = State.init_inputs(n)
+        out = create_circuit(ctx, st, targets[0], mask, [])
+        assert out != 0xFFFF
+        st.verify_gate(out, targets[0], mask)
+        return [(g.type, g.in1, g.in2) for g in st.gates]
+
+    a1, a2, b = run(7), run(7), run(8)
+    assert a1 == a2, "same seed must reproduce the same circuit"
+    # The engine is deterministic per seed, so this comparison is stable:
+    # seeds 7 and 8 are known to explore different circuits here, and a
+    # broken rng_seed plumbing (constant stream) would make them equal.
+    assert a1 != b, "different seeds must explore different circuits"

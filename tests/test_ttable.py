@@ -135,3 +135,17 @@ def test_jnp_compat():
     assert np.array_equal(np.asarray(out), tt.input_table(0) ^ tt.input_table(1))
     eq = jax.jit(lambda x, y: tt.eq_mask(x, y, jnp.asarray(tt.mask_table(8))))(a, a)
     assert bool(eq)
+
+
+def test_ttable_text_matches_reference_format():
+    """ttable_text = the reference's print_ttable byte format
+    (convert_graph.c:28-45): 16x16 grid of bits, position 0 first."""
+    t = np.zeros(8, dtype=np.uint32)
+    t[0] = 0b1011  # positions 0,1,3
+    t[2] = 1 << 5  # position 64+5 = 69
+    s = tt.ttable_text(t)
+    rows = s.splitlines()
+    assert len(rows) == 16 and all(len(r) == 16 for r in rows)
+    assert s.endswith("\n")
+    flat = "".join(rows)
+    assert [i for i, c in enumerate(flat) if c == "1"] == [0, 1, 3, 69]
