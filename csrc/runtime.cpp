@@ -881,14 +881,79 @@ inline void build_pair_matrix(const uint32_t* r1, const uint32_t* r0,
   }
 }
 
-// Middle-pair conflict mask for one outer function over B.
-inline uint64_t outer_conflict_mask(const uint64_t B[64], uint64_t agree_fo) {
-  uint64_t m = 0;
-  for (int i = 0; i < 64; i++) {
-    if ((agree_fo >> i) & 1) m |= B[i];
+// Diagonal (q,q) bits of the agree matrices: EVERY agree[fm] contains the
+// full diagonal (a bit always equals itself), so a conflict mask with any
+// diagonal bit set admits no middle function at all — checking it first
+// skips the whole 256-fm scan with an identical outcome.
+constexpr uint64_t AGREE_DIAG = 0x8040201008040201ULL;
+
+// EXACT existence test for "some middle function fm avoids every
+// conflict in m": bit (q1,q0) of m conflicts iff fm maps middle
+// patterns q1 and q0 to the same output, so a valid fm is exactly a
+// 2-coloring of the requires-different graph on the 8 middle patterns —
+// fm exists iff that graph is bipartite (a self-loop = diagonal bit is
+// immediately infeasible).  O(64) worst case, replacing the 256-fm scan
+// with an outcome-identical test whose cost does NOT depend on how
+// prunable the row is.
+inline bool middle_exists(uint64_t m) {
+  if (m & AGREE_DIAG) return false;
+  uint8_t adj[8];
+  for (int q = 0; q < 8; q++) adj[q] = (uint8_t)((m >> (q * 8)) & 0xFF);
+  for (int q = 0; q < 8; q++) {
+    for (int r = 0; r < 8; r++) {
+      if ((adj[q] >> r) & 1) adj[r] |= (uint8_t)(1 << q);
+    }
   }
-  return m;
+  int8_t color[8] = {-1, -1, -1, -1, -1, -1, -1, -1};
+  for (int s = 0; s < 8; s++) {
+    if (color[s] >= 0 || adj[s] == 0) continue;
+    color[s] = 0;
+    uint8_t stack[8];
+    int top = 0;
+    stack[top++] = (uint8_t)s;
+    while (top) {
+      const int u = stack[--top];
+      uint8_t nb = adj[u];
+      while (nb) {
+        const int v = __builtin_ctz(nb);
+        nb &= (uint8_t)(nb - 1);
+        if (color[v] < 0) {
+          color[v] = (int8_t)(color[u] ^ 1);
+          stack[top++] = (uint8_t)v;
+        } else if (color[v] == color[u]) {
+          return false;
+        }
+      }
+    }
+  }
+  return true;
 }
+
+// Subset-OR decomposition of the fo sweep: sub[p1][S] = OR of B rows
+// (p1, p0) over p0 in subset S, built with the standard
+// sum-over-subsets DP (8 * 256 ORs).  Then for S1 = set bits of fo:
+// m(fo) = OR_{p1 in S1} sub[p1][S1] | OR_{p1 in ~S1} sub[p1][~S1]
+// — 16 ORs per fo instead of a 64-iteration scan.
+struct FoSweep {
+  uint64_t sub[8][256];
+  void build(const uint64_t B[64]) {
+    for (int p1 = 0; p1 < 8; p1++) {
+      sub[p1][0] = 0;
+      for (int s = 1; s < 256; s++) {
+        const int low = s & (-s);
+        sub[p1][s] = sub[p1][s ^ low] | B[p1 * 8 + __builtin_ctz(low)];
+      }
+    }
+  }
+  uint64_t mask(int fo) const {
+    const int s1 = fo & 0xFF, s0 = (~fo) & 0xFF;
+    uint64_t m = 0;
+    for (int p1 = 0; p1 < 8; p1++) {
+      m |= sub[p1][((s1 >> p1) & 1) ? s1 : s0];
+    }
+    return m;
+  }
+};
 
 }  // namespace
 
@@ -919,14 +984,20 @@ void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
     for (int32_t s = 0; s < n_sigma && !found_row[t]; s++) {
       uint64_t B[64];
       build_pair_matrix(req1 + t * 4, req0 + t * 4, idx_tab + s * 128, B);
-      for (int fo = 0; fo < 256 && !found_row[t]; fo++) {
-        uint64_t m = outer_conflict_mask(B, agree[fo]);
-        for (int fm = 0; fm < 256; fm++) {
-          if ((agree[fm] & m) == 0) {
-            found_row[t] = true;
-            sel_sigma[t] = s;
-            break;
-          }
+      uint64_t anyb = 0;
+      for (int i = 0; i < 64; i++) anyb |= B[i];
+      if (anyb == 0) {  // no conflict pairs: every (fo, fm) decomposes
+        found_row[t] = true;
+        sel_sigma[t] = s;
+        break;
+      }
+      FoSweep fs;
+      fs.build(B);
+      for (int fo = 0; fo < 256; fo++) {
+        if (middle_exists(fs.mask(fo))) {
+          found_row[t] = true;
+          sel_sigma[t] = s;
+          break;
         }
       }
     }
@@ -944,10 +1015,13 @@ void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
   uint64_t B[64];
   build_pair_matrix(req1 + best_t * 4, req0 + best_t * 4, idx_tab + s * 128,
                     B);
+  FoSweep fsel;
+  fsel.build(B);
   uint32_t fbest = 0;
   int32_t flat_sel = 0;
   for (int fo = 0; fo < 256; fo++) {
-    uint64_t m = outer_conflict_mask(B, agree[fo]);
+    const uint64_t m = fsel.mask(fo);
+    if (m & AGREE_DIAG) continue;  // no fm can pass (diagonal always set)
     for (int fm = 0; fm < 256; fm++) {
       if (agree[fm] & m) continue;
       int32_t flat = fo * 256 + fm;

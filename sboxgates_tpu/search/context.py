@@ -63,36 +63,20 @@ LUT5_HEAD_SOLVE_ROWS = 1024
 LUT7_HEAD_SOLVE_ROWS = 256
 
 # Hit lists at or below this many rows solve stage B on the host
-# (sbg_lut7_solve_small) instead of dispatching the MXU solver.  A
-# no-decomposition row costs ~2.6 ms natively (full 70-ordering scan;
-# hits exit at the first valid ordering, microseconds) vs ~75 ms for a
-# dispatch through the network-attached chip — break-even near 28 rows.
+# (sbg_lut7_solve_small) instead of dispatching the MXU solver.  The
+# solver's existence test is exact bipartiteness of the middle-conflict
+# graph (csrc middle_exists), so its cost is BOUNDED independent of the
+# row's prunability: worst observed 0.26 ms per undecomposable row
+# across constraint densities (hits exit far earlier; real-workload
+# rows average ~0.02-0.15 ms — the des_s1 solver phase dropped 22x,
+# 1.68 s -> 0.076 s).  A full 256-row undecomposable list therefore
+# costs ~67 ms, at or under one ~75 ms dispatch through the
+# network-attached chip, so the host takes every list it can hold on
+# every backend; larger lists go to the device pair-matmul solver.
 # Re-measured with spread every bench run: BENCH_DETAIL.json
-# `lut7_break_even` (value = implied break-even rows on the current
-# link; host/device medians with min/max).  On a CPU backend the
-# "dispatch" is itself slow host compute (the pair-matmul solver
-# without an MXU, measured ~500-row break-even), so the native solver
-# takes every list it can hold.
-NATIVE_LUT7_SOLVE_MAX = 24
+# `lut7_break_even`.
+NATIVE_LUT7_SOLVE_MAX = 256
 
-
-def _native_lut7_solve_max() -> int:
-    # Keyed on the *current* backend (not lru_cached process-wide) so a
-    # process that re-initializes JAX on a different platform — e.g. a
-    # test harness switching cpu<->tpu — keeps the routing threshold
-    # fresh.  jax.default_backend() is itself cached by JAX; this adds
-    # one dict lookup per LUT7 node.
-    import jax
-
-    return _native_lut7_solve_max_for(jax.default_backend())
-
-
-@functools.lru_cache(maxsize=None)
-def _native_lut7_solve_max_for(backend: str) -> int:
-    if backend == "cpu":
-        # capped at the native solver's 256-row limit (lut7_solve_small)
-        return min(LUT7_HEAD_SOLVE_ROWS, 256)
-    return NATIVE_LUT7_SOLVE_MAX
 
 # POLICY (README "Execution placement policy"): node-head sweeps at or
 # below this many gates run on the host via the native runtime
@@ -891,8 +875,12 @@ class SearchContext:
             sr0 = np.full((solve7, 4), 0xFFFFFFFF, dtype=np.uint32)
             sr1[:take] = r1
             sr0[:take] = r0
-            if take <= _native_lut7_solve_max():
-                # Small hit list: solve on the host, no dispatch at all.
+            if take <= NATIVE_LUT7_SOLVE_MAX:
+                # Host solve, no dispatch.  With the threshold at the
+                # solver's 256-row cap (= LUT7_HEAD_SOLVE_ROWS) this is
+                # currently every list stage A can return; the device
+                # branch below is the guard for configurations that
+                # raise LUT7_HEAD_SOLVE_ROWS past the host cap.
                 idx_tab, _ = sweeps.lut7_pair_tables()
                 with self.prof.phase("lut7_solve_native"):
                     sol = native.lut7_solve_small(

@@ -212,7 +212,7 @@ def test_lut7_capped_overflow_sharded():
 
     from sboxgates_tpu.search.context import (
         LUT7_HEAD_SOLVE_ROWS,
-        _native_lut7_solve_max,
+        NATIVE_LUT7_SOLVE_MAX,
     )
     from sboxgates_tpu.search.lut import lut7_search
 
@@ -227,7 +227,7 @@ def test_lut7_capped_overflow_sharded():
     # Overflow actually happened: more solve rows than any non-staged path
     # could have taken.
     assert ctx.stats["lut7_solved"] > max(
-        LUT7_HEAD_SOLVE_ROWS, _native_lut7_solve_max()
+        LUT7_HEAD_SOLVE_ROWS, NATIVE_LUT7_SOLVE_MAX
     )
     assert ctx.stats["lut7_candidates"] > 0
 
