@@ -1070,7 +1070,7 @@ inline uint64_t sm64_next(uint64_t& s) {
 }
 
 struct EngGate {
-  int32_t type, in1, in2, in3;
+  int32_t type, in1, in2, in3, func;
 };
 
 // Value-copied search state (the copy semantics are load-bearing for
@@ -1083,6 +1083,19 @@ struct EngState {
   int32_t ng() const { return (int32_t)gd.size(); }
 };
 
+// LUT-mode lookup tables (shapes from ops/sweeps.py: lut5_split_tables,
+// lut7_pair_tables, lut7_split_tables).
+struct LutTabs {
+  const uint32_t* w_tab;   // [10*256]
+  const uint32_t* m_tab;   // [10*4]
+  const int32_t* idx_tab;  // [70*128]
+  const int32_t* orders;   // [70*7]
+  const uint32_t* wo_tab;  // [70*256*4]
+  const uint32_t* wm_tab;  // [70*256*4]
+  const uint32_t* g_tab;   // [70*4]
+  int32_t n_sigma;         // 70
+};
+
 struct EngCfg {
   const int16_t* pair_mt;
   const int16_t* not_mt;
@@ -1090,11 +1103,17 @@ struct EngCfg {
   const int32_t* pair_ops;  // [n][8]: n_in, fun1, fun2, na, nb, nc, nout, perm
   const int32_t* not_ops;
   const int32_t* tri_ops;
+  const LutTabs* lut;  // non-null = LUT mode
   int32_t metric;  // 0 = gates, 1 = SAT
   int32_t num_inputs;
   bool randomize;
+  // A node that needs device work (pivot-sized 5-LUT space, staged
+  // 7-LUT, in-kernel 5-LUT solver overflow) sets this and unwinds; the
+  // Python caller reruns the whole call through its own engine.
+  bool bailed;
   uint64_t rng;
   int64_t nodes, pair_cand, triple_cand;
+  int64_t lut3_cand, lut5_cand, lut7_cand, lut7_solved;
 };
 
 inline int32_t eng_bucket(int32_t g) { return g <= 64 ? 64 : 512; }
@@ -1115,7 +1134,7 @@ int32_t eng_add_gate(EngState& st, const EngCfg& C, int32_t type,
     t = tt_gate2(type, st.tabs[g1], st.tabs[g2]);
   }
   st.tabs.push_back(t);
-  st.gd.push_back({type, g1, g2, ENG_NO_GATE});
+  st.gd.push_back({type, g1, g2, ENG_NO_GATE, 0});
   return st.ng() - 1;
 }
 
@@ -1206,14 +1225,13 @@ inline void eng_decode_pair(int64_t idx, int32_t bucket, int32_t* i,
   *j = (int32_t)(idx - base) + a + 1;
 }
 
-// Lexicographic rank -> 3-combination over g (ops/combinatorics
+// Lexicographic rank -> k-combination over g (ops/combinatorics
 // unrank_combination semantics).
-inline void eng_unrank3(int64_t rank, int32_t g, int32_t* out) {
-  int32_t a = 0;
+inline void eng_unrank(int64_t rank, int32_t g, int32_t k, int32_t* out) {
   int32_t prev = -1;
-  for (int32_t slot = 0; slot < 3; slot++) {
+  for (int32_t slot = 0; slot < k; slot++) {
     for (int32_t v = prev + 1; v < g; v++) {
-      const int64_t block = n_choose_k(g - 1 - v, 2 - slot);
+      const int64_t block = n_choose_k(g - 1 - v, k - 1 - slot);
       if (rank < block) {
         out[slot] = v;
         prev = v;
@@ -1221,12 +1239,248 @@ inline void eng_unrank3(int64_t rank, int32_t g, int32_t* out) {
       }
       rank -= block;
     }
-    (void)a;
   }
+}
+
+// pick_chunk (search/context.py CHUNK_SIZES) for the streaming sweeps.
+inline int32_t pick_chunk_c(int64_t n, int32_t cap) {
+  if (1024 >= cap) return cap;
+  if (n <= 1024) return 1024;
+  if (131072 >= cap) return cap;
+  if (n <= 131072) return 131072;
+  return cap;
+}
+
+// graph/state.py add_lut semantics (no SAT-metric change, no sat check).
+int32_t eng_add_lut(EngState& st, int32_t func, int32_t g1, int32_t g2,
+                    int32_t g3) {
+  if (g1 == ENG_NO_GATE || g2 == ENG_NO_GATE || g3 == ENG_NO_GATE)
+    return ENG_NO_GATE;
+  if (st.ng() > st.max_gates) return ENG_NO_GATE;
+  TT t = tt_lut(func, st.tabs[g1], st.tabs[g2], st.tabs[g3]);
+  st.tabs.push_back(t);
+  st.gd.push_back({GT_LUT, g1, g2, g3, func});
+  return st.ng() - 1;
+}
+
+// Inner-function solve for grouped packed cells (the host mirror of
+// sweeps.solve_inner_function; reference get_lut_function,
+// lut.c:79-109).  Returns -1 on conflict; don't-cares randomized from
+// the engine stream.
+int32_t eng_solve_inner(const uint32_t* r1, const uint32_t* r0,
+                        const uint32_t gm[8][4], int words, bool randomize,
+                        uint64_t& rng) {
+  int32_t func = 0, setm = 0;
+  for (int j = 0; j < 8; j++) {
+    bool h1 = false, h0 = false;
+    for (int w = 0; w < words; w++) {
+      if (r1[w] & gm[j][w]) h1 = true;
+      if (r0[w] & gm[j][w]) h0 = true;
+    }
+    if (h1 && h0) return -1;
+    if (h1) func |= 1 << j;
+    if (h1 || h0) setm |= 1 << j;
+  }
+  if (randomize) {
+    func |= (int32_t)(sm64_next(rng) & 0xFF) & ~setm & 0xFF;
+  }
+  return func;
 }
 
 int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
                    const int32_t* inbits, int32_t n_inbits);
+
+// Shared entry boilerplate of the two engine entry points: state init
+// from the caller's tables, zeroed config, and the run + stats/added
+// copy-out.  The added-row (5 x int32) and stats (8 x int64) layouts
+// are decoded by native/__init__.py and kwan.py — keeping them in ONE
+// place keeps both modes' replay in lockstep.
+void eng_init(EngState& st, EngCfg& C, const uint64_t* tables, int32_t g,
+              int32_t num_inputs, int32_t max_gates, int64_t sat_metric,
+              int64_t max_sat_metric, int32_t metric, int32_t randomize,
+              uint64_t rng_seed) {
+  st.max_gates = max_gates;
+  st.sat = sat_metric;
+  st.max_sat = max_sat_metric;
+  st.tabs.reserve((size_t)g + 16);  // non-null storage (quiets -Wnonnull)
+  st.tabs.insert(st.tabs.end(), reinterpret_cast<const TT*>(tables),
+                 reinterpret_cast<const TT*>(tables) + g);
+  st.gd.resize(g);  // types of existing gates are irrelevant to the search
+  C = EngCfg{};
+  C.metric = metric;
+  C.num_inputs = num_inputs;
+  C.randomize = randomize != 0;
+  C.rng = rng_seed;
+}
+
+int64_t eng_run(EngState& st, EngCfg& C, const uint64_t* target,
+                const uint64_t* mask, const int32_t* inbits,
+                int32_t n_inbits, int32_t g, int32_t* out_gid,
+                int32_t* added, int64_t* stats) {
+  TT tgt, msk;
+  std::memcpy(tgt.w, target, sizeof(TT));
+  std::memcpy(msk.w, mask, sizeof(TT));
+  const int32_t gid = eng_search(st, C, tgt, msk, inbits, n_inbits);
+  stats[0] = C.nodes;
+  stats[1] = C.pair_cand;
+  stats[2] = C.triple_cand;
+  stats[3] = C.lut3_cand;
+  stats[4] = C.lut5_cand;
+  stats[5] = C.lut7_cand;
+  stats[6] = C.lut7_solved;
+  stats[7] = 0;
+  if (C.bailed) return -2;
+  if (gid == ENG_NO_GATE) return -1;
+  const int32_t n_added = st.ng() - g;
+  for (int32_t i = 0; i < n_added; i++) {
+    const EngGate& e = st.gd[g + i];
+    added[i * 5 + 0] = e.type;
+    added[i * 5 + 1] = e.in1;
+    added[i * 5 + 2] = e.in2;
+    added[i * 5 + 3] = e.in3;
+    added[i * 5 + 4] = e.func;
+  }
+  *out_gid = gid;
+  return n_added;
+}
+
+// 5-LUT decode (search/lut.py _decode_lut5): materialize the selected
+// decomposition as two LUT gates.
+int32_t eng_decode5(EngState& st, EngCfg& C, int64_t rank, int32_t sigma,
+                    int32_t fo, uint32_t q1, uint32_t q0) {
+  int32_t combo[5];
+  eng_unrank(rank, st.ng(), 5, combo);
+  const int* sp = SPLITS5[sigma];
+  const int32_t A = combo[sp[0]], B2 = combo[sp[1]], C2 = combo[sp[2]];
+  const int32_t D = combo[sp[3]], E = combo[sp[4]];
+  uint32_t gm[8][4] = {};
+  const uint32_t w = C.lut->w_tab[sigma * 256 + fo];
+  for (int m = 0; m < 4; m++) {
+    const uint32_t mm = C.lut->m_tab[sigma * 4 + m];
+    gm[4 + m][0] = mm & w;
+    gm[m][0] = mm & ~w;
+  }
+  const int32_t fi = eng_solve_inner(&q1, &q0, gm, 1, C.randomize, C.rng);
+  if (fi < 0) {
+    std::fprintf(stderr, "sbg_lut_engine: spurious 5-LUT hit\n");
+    std::abort();
+  }
+  const int32_t outer = eng_add_lut(st, fo, A, B2, C2);
+  return eng_add_lut(st, fi, outer, D, E);
+}
+
+// 7-LUT decode (search/lut.py _decode_lut7): three LUT gates.
+int32_t eng_decode7(EngState& st, EngCfg& C, int64_t rank, int32_t sigma,
+                    int32_t fo, int32_t fm, const uint32_t* r1,
+                    const uint32_t* r0) {
+  int32_t combo[7];
+  eng_unrank(rank, st.ng(), 7, combo);
+  const int32_t* ord = C.lut->orders + sigma * 7;
+  const int32_t A = combo[ord[0]], B2 = combo[ord[1]], C2 = combo[ord[2]];
+  const int32_t D = combo[ord[3]], E = combo[ord[4]], F = combo[ord[5]];
+  const int32_t G2 = combo[ord[6]];
+  const uint32_t* wo = C.lut->wo_tab + ((size_t)sigma * 256 + fo) * 4;
+  const uint32_t* wm = C.lut->wm_tab + ((size_t)sigma * 256 + fm) * 4;
+  const uint32_t* gt = C.lut->g_tab + sigma * 4;
+  uint32_t gm[8][4];
+  for (int j = 0; j < 8; j++) {
+    for (int w = 0; w < 4; w++) {
+      uint32_t m = 0xFFFFFFFFu;
+      m &= (j & 4) ? wo[w] : ~wo[w];
+      m &= (j & 2) ? wm[w] : ~wm[w];
+      m &= (j & 1) ? gt[w] : ~gt[w];
+      gm[j][w] = m;
+    }
+  }
+  const int32_t fi = eng_solve_inner(r1, r0, gm, 4, C.randomize, C.rng);
+  if (fi < 0) {
+    std::fprintf(stderr, "sbg_lut_engine: spurious 7-LUT hit\n");
+    std::abort();
+  }
+  const int32_t outer = eng_add_lut(st, fo, A, B2, C2);
+  const int32_t mid = eng_add_lut(st, fm, D, E, F);
+  return eng_add_lut(st, fi, outer, mid, G2);
+}
+
+// The LUT continuation of one node (search/lut.py lut_search_from_head):
+// decode the head's 3/5-LUT verdict, then the single-chunk 7-LUT phase.
+// Returns the gate id, ENG_NO_GATE to continue into the mux, and sets
+// C.bailed for device-work nodes (pivot-sized 5-LUT spaces, in-kernel
+// solver overflows, staged 7-LUT).
+int32_t eng_lut_continue(EngState& st, EngCfg& C, const TT& target,
+                         const TT& mask, const int32_t* inbits,
+                         int32_t n_inbits, const int32_t* out8,
+                         bool has5) {
+  const int32_t g_before = st.ng();  // head verdict decodes at this g
+  const int32_t step = out8[0];
+  if (step == 4) {  // 3-LUT hit
+    int32_t trip[3];
+    eng_unrank(out8[1], g_before, 3, trip);
+    const int32_t pr1 = out8[2] & 0xFF, pr0 = out8[3] & 0xFF;
+    int32_t func = pr1;
+    if (C.randomize) {
+      func |= (int32_t)(sm64_next(C.rng) & 0xFF) & ~(pr1 | pr0) & 0xFF;
+    }
+    const int32_t gid = eng_add_lut(st, func, trip[0], trip[1], trip[2]);
+    eng_verify(st, gid, target, mask);
+    return gid;
+  }
+  if (!eng_check_possible(st, C, 2, 0)) return ENG_NO_GATE;
+  if (step == 5) {
+    const int32_t gid = eng_decode5(st, C, out8[1], out8[2], out8[3],
+                                    (uint32_t)out8[4], (uint32_t)out8[5]);
+    eng_verify(st, gid, target, mask);
+    return gid;
+  }
+  if (step == 6) {  // in-kernel 5-LUT solver overflow -> device re-drive
+    C.bailed = true;
+    return ENG_NO_GATE;
+  }
+  if (!has5 && g_before >= 5) {  // pivot-sized space -> device sweep
+    C.bailed = true;
+    return ENG_NO_GATE;
+  }
+
+  // 7-LUT phase (single-chunk only; search/context.py _lut7_step_native).
+  const int32_t g = st.ng();
+  if (g < 7) return ENG_NO_GATE;
+  if (!eng_check_possible(st, C, 3, 0)) return ENG_NO_GATE;
+  const int64_t total7 = (int64_t)n_choose_k(g, 7);
+  if (total7 > 32768) {  // staged path (stage A cap 100k + chunked B)
+    C.bailed = true;
+    return ENG_NO_GATE;
+  }
+  const int32_t chunk7 = pick_chunk_c(total7, 32768);
+  const int32_t solve7 = 256;  // LUT7_HEAD_SOLVE_ROWS
+  const int32_t seed7 =
+      C.randomize ? (int32_t)(sm64_next(C.rng) & 0x7FFFFFFF) : -1;
+  int64_t nfeas = 0;
+  int32_t ranks[256];
+  uint32_t r1[256 * 4], r0[256 * 4];
+  const int64_t take = sbg_lut7_stage_a(
+      reinterpret_cast<const uint64_t*>(st.tabs.data()), g, target.w, mask.w,
+      inbits, n_inbits, total7, chunk7, solve7, seed7, &nfeas, ranks, r1, r0);
+  C.lut7_cand += total7 < chunk7 ? total7 : chunk7;
+  if (take > 0) {
+    C.lut7_solved += nfeas < solve7 ? nfeas : solve7;
+    int32_t sol[4];
+    sbg_lut7_solve_small(r1, r0, (int32_t)take, solve7, C.lut->idx_tab,
+                         C.lut->n_sigma, (int32_t)(seed7 ^ 0x77A1), sol);
+    if (sol[0]) {
+      const int32_t bt = sol[1];
+      const int32_t fo = sol[3] / 256, fm = sol[3] % 256;
+      const int32_t gid = eng_decode7(st, C, ranks[bt], sol[2], fo, fm,
+                                      r1 + bt * 4, r0 + bt * 4);
+      eng_verify(st, gid, target, mask);
+      return gid;
+    }
+    if (nfeas > solve7) {  // overflow -> staged re-run on the device side
+      C.bailed = true;
+      return ENG_NO_GATE;
+    }
+  }
+  return ENG_NO_GATE;
+}
 
 // One select bit of the step-5 multiplexer (kwan._mux_try_bit gate-mode
 // branch; sboxgates.c:516-567).  Returns true with *out_state/*out_gid.
@@ -1239,6 +1493,35 @@ bool eng_mux_try_bit(const EngState& st, EngCfg& C, const TT& target,
   next_inbits[n_tracked] = bit;
   const int32_t n_next = n_tracked + 1;
   const TT fsel = st.tabs[bit];
+
+  if (C.lut != nullptr) {
+    // LUT mux: solve both halves, join with LUT 0xAC = sel ? fc : fb
+    // (kwan._mux_try_bit LUT branch; sboxgates.c:475-514).
+    EngState nst = st;
+    nst.max_gates -= 1;  // reserve room for the mux LUT
+    const int32_t fb = eng_search(nst, C, target, tt_and(mask, tt_not(fsel)),
+                                  next_inbits, n_next);
+    if (C.bailed || fb == ENG_NO_GATE) return false;
+    const int32_t fc = eng_search(nst, C, target, tt_and(mask, fsel),
+                                  next_inbits, n_next);
+    if (C.bailed || fc == ENG_NO_GATE) return false;
+    nst.max_gates += 1;
+    int32_t out;
+    if (fb == fc) {
+      out = fb;
+    } else if (fb == bit) {
+      out = eng_add_and(nst, C, fb, fc);
+    } else if (fc == bit) {
+      out = eng_add_or(nst, C, fb, fc);
+    } else {
+      out = eng_add_lut(nst, 0xAC, bit, fb, fc);
+    }
+    if (out == ENG_NO_GATE) return false;
+    eng_verify(nst, out, target, mask);
+    *out_state = std::move(nst);
+    *out_gid = out;
+    return true;
+  }
 
   // AND-based mux: out = fb ^ (sel & fc')  (sboxgates.c:516-537)
   EngState na = st;
@@ -1309,61 +1592,99 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
                    const int32_t* inbits, int32_t n_inbits) {
   C.nodes++;
   const int32_t g = st.ng();
-  const bool has_not = C.not_mt != nullptr;
-  const bool has_triple = g >= 3 && C.triple_mt != nullptr;
-  const int64_t total3 = has_triple ? (int64_t)n_choose_k(g, 3) : 0;
-  const int32_t chunk3 = total3 <= 1024 ? 1024 : 32768;
+  const bool lut_mode = C.lut != nullptr;
   const int32_t seed =
       C.randomize ? (int32_t)(sm64_next(C.rng) & 0x7FFFFFFF) : -1;
 
-  int32_t out4[4];
-  sbg_gate_step(reinterpret_cast<const uint64_t*>(st.tabs.data()), g,
-                eng_bucket(g), target.w, mask.w, C.pair_mt,
-                has_not ? C.not_mt : nullptr,
-                has_triple ? C.triple_mt : nullptr, total3, chunk3, seed,
-                out4);
-  const int32_t step = out4[0];
-  // Stats exactly as context._gate_step_native counts them.
-  if (step == 0 || step >= 3) C.pair_cand += (int64_t)g * (g - 1) / 2;
-  if (has_triple && (step == 0 || step == 5)) C.triple_cand += out4[3];
+  int32_t step, x0, x1;
+  int32_t out8[8] = {0};
+  bool has5 = false;
+  if (lut_mode) {
+    const int64_t total3 = g >= 3 ? (int64_t)n_choose_k(g, 3) : 0;
+    const int32_t chunk3 = pick_chunk_c(total3 > 0 ? total3 : 1, 32768);
+    const int64_t total5 = g >= 5 ? (int64_t)n_choose_k(g, 5) : 0;
+    has5 = g >= 5 && total5 < (int64_t)(1 << 21);  // PIVOT_MIN_TOTAL
+    const int32_t chunk5 =
+        has5 ? pick_chunk_c(total5 > 0 ? total5 : 1, 131072) : 1024;
+    sbg_lut_step(reinterpret_cast<const uint64_t*>(st.tabs.data()), g,
+                 eng_bucket(g), target.w, mask.w, C.pair_mt, inbits, n_inbits,
+                 total3, chunk3, has5 ? 1 : 0, total5, chunk5,
+                 1024 /* LUT5_HEAD_SOLVE_ROWS */, C.lut->w_tab, C.lut->m_tab,
+                 seed, out8);
+    step = out8[0];
+    x0 = out8[1];
+    x1 = out8[2];
+    // Stats exactly as context._lut_step_native counts them.
+    if (step == 0 || step >= 3) C.pair_cand += (int64_t)g * (g - 1) / 2;
+    C.lut3_cand += out8[6];
+    C.lut5_cand += out8[7];
+  } else {
+    const bool has_not = C.not_mt != nullptr;
+    const bool has_triple = g >= 3 && C.triple_mt != nullptr;
+    const int64_t total3 = has_triple ? (int64_t)n_choose_k(g, 3) : 0;
+    const int32_t chunk3 = total3 <= 1024 ? 1024 : 32768;
+    int32_t out4[4];
+    sbg_gate_step(reinterpret_cast<const uint64_t*>(st.tabs.data()), g,
+                  eng_bucket(g), target.w, mask.w, C.pair_mt,
+                  has_not ? C.not_mt : nullptr,
+                  has_triple ? C.triple_mt : nullptr, total3, chunk3, seed,
+                  out4);
+    step = out4[0];
+    x0 = out4[1];
+    x1 = out4[2];
+    // Stats exactly as context._gate_step_native counts them.
+    if (step == 0 || step >= 3) C.pair_cand += (int64_t)g * (g - 1) / 2;
+    if (has_triple && (step == 0 || step == 5)) C.triple_cand += out4[3];
+  }
 
   if (step == 1) {
-    eng_verify(st, out4[1], target, mask);
-    return out4[1];
+    eng_verify(st, x0, target, mask);
+    return x0;
   }
   if (!eng_check_possible(st, C, 1, SAT_W[GT_NOT])) return ENG_NO_GATE;
   if (step == 2) {
-    const int32_t ret = eng_add_not(st, C, out4[1]);
+    const int32_t ret = eng_add_not(st, C, x0);
     eng_verify(st, ret, target, mask);
     return ret;
   }
   if (!eng_check_possible(st, C, 1, SAT_W[EGT_AND])) return ENG_NO_GATE;
   if (step == 3) {
     int32_t i, j;
-    eng_decode_pair(out4[1], eng_bucket(g), &i, &j);
+    eng_decode_pair(x0, eng_bucket(g), &i, &j);
     const int32_t gids[3] = {i, j, 0};
-    const int32_t ret = eng_apply_op(st, C, C.pair_ops + out4[2] * 8, gids);
+    const int32_t ret = eng_apply_op(st, C, C.pair_ops + x1 * 8, gids);
     eng_verify(st, ret, target, mask);
     return ret;
   }
-  if (!eng_check_possible(st, C, 2, SAT_W[EGT_AND] + SAT_W[GT_NOT]))
-    return ENG_NO_GATE;
-  if (step == 4) {
-    int32_t i, j;
-    eng_decode_pair(out4[1], eng_bucket(g), &i, &j);
-    const int32_t gids[3] = {i, j, 0};
-    const int32_t ret = eng_apply_op(st, C, C.not_ops + out4[2] * 8, gids);
-    eng_verify(st, ret, target, mask);
-    return ret;
-  }
-  if (!eng_check_possible(st, C, 3, 2 * SAT_W[EGT_AND] + SAT_W[GT_NOT]))
-    return ENG_NO_GATE;
-  if (step == 5) {
-    int32_t trip[3];
-    eng_unrank3(out4[1], g, trip);
-    const int32_t ret = eng_apply_op(st, C, C.tri_ops + out4[2] * 8, trip);
-    eng_verify(st, ret, target, mask);
-    return ret;
+
+  if (lut_mode) {
+    // The LUT continuation (3/5-LUT decode + 7-LUT phase); ENG_NO_GATE
+    // falls through to the mux, exactly as lut_search_from_head's
+    // NO_GATE does in kwan.
+    const int32_t ret =
+        eng_lut_continue(st, C, target, mask, inbits, n_inbits, out8, has5);
+    if (C.bailed) return ENG_NO_GATE;
+    if (ret != ENG_NO_GATE) return ret;
+  } else {
+    if (!eng_check_possible(st, C, 2, SAT_W[EGT_AND] + SAT_W[GT_NOT]))
+      return ENG_NO_GATE;
+    if (step == 4) {
+      int32_t i, j;
+      eng_decode_pair(x0, eng_bucket(g), &i, &j);
+      const int32_t gids[3] = {i, j, 0};
+      const int32_t ret = eng_apply_op(st, C, C.not_ops + x1 * 8, gids);
+      eng_verify(st, ret, target, mask);
+      return ret;
+    }
+    if (!eng_check_possible(st, C, 3, 2 * SAT_W[EGT_AND] + SAT_W[GT_NOT]))
+      return ENG_NO_GATE;
+    if (step == 5) {
+      int32_t trip[3];
+      eng_unrank(x0, g, 3, trip);
+      const int32_t ret = eng_apply_op(st, C, C.tri_ops + x1 * 8, trip);
+      eng_verify(st, ret, target, mask);
+      return ret;
+    }
   }
 
   // Step 5 (Kwan): multiplex over an unused input bit
@@ -1390,8 +1711,10 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
   for (int32_t bi = 0; bi < n_bits; bi++) {
     EngState cand;
     int32_t cand_out;
-    if (!eng_mux_try_bit(st, C, target, mask, bit_order[bi], inbits,
-                         n_tracked, &cand, &cand_out)) {
+    const bool got = eng_mux_try_bit(st, C, target, mask, bit_order[bi],
+                                     inbits, n_tracked, &cand, &cand_out);
+    if (C.bailed) return ENG_NO_GATE;
+    if (!got) {
       continue;
     }
     bool better;
@@ -1421,8 +1744,9 @@ extern "C" {
 // Entry: runs the whole gate-mode search natively; returns the number of
 // gates appended to the input state (replayed by the Python caller onto
 // its State, which re-verifies), or -1 when nothing was found.
-// added: int32[(max_gates + 8) * 4] rows [type, in1, in2, in3];
-// stats out: int64[3] = [nodes, pair_candidates, triple_candidates].
+// added: int32[(max_gates + 8) * 5] rows [type, in1, in2, in3, function];
+// stats out: int64[8] = [nodes, pair, triple, lut3, lut5, lut7,
+// lut7_solved, 0].
 int64_t sbg_gate_engine(
     const uint64_t* tables, int32_t g, int32_t num_inputs, int32_t max_gates,
     int64_t sat_metric, int64_t max_sat_metric, int32_t metric,
@@ -1432,43 +1756,51 @@ int64_t sbg_gate_engine(
     int32_t n_inbits, int32_t randomize, uint64_t rng_seed, int32_t* out_gid,
     int32_t* added, int64_t* stats) {
   EngState st;
-  st.max_gates = max_gates;
-  st.sat = sat_metric;
-  st.max_sat = max_sat_metric;
-  st.tabs.assign(reinterpret_cast<const TT*>(tables),
-                 reinterpret_cast<const TT*>(tables) + g);
-  st.gd.resize(g);  // types of existing gates are irrelevant to the search
   EngCfg C;
+  eng_init(st, C, tables, g, num_inputs, max_gates, sat_metric,
+           max_sat_metric, metric, randomize, rng_seed);
   C.pair_mt = pair_mt;
   C.not_mt = not_mt;
   C.triple_mt = triple_mt;
   C.pair_ops = pair_ops;
   C.not_ops = not_ops;
   C.tri_ops = tri_ops;
-  C.metric = metric;
-  C.num_inputs = num_inputs;
-  C.randomize = randomize != 0;
-  C.rng = rng_seed;
-  C.nodes = C.pair_cand = C.triple_cand = 0;
+  return eng_run(st, C, target, mask, inbits, n_inbits, g, out_gid, added,
+                 stats);
+}
 
-  TT tgt, msk;
-  std::memcpy(tgt.w, target, sizeof(TT));
-  std::memcpy(msk.w, mask, sizeof(TT));
-  const int32_t gid = eng_search(st, C, tgt, msk, inbits, n_inbits);
-  stats[0] = C.nodes;
-  stats[1] = C.pair_cand;
-  stats[2] = C.triple_cand;
-  if (gid == ENG_NO_GATE) return -1;
-  const int32_t n_added = st.ng() - g;
-  for (int32_t i = 0; i < n_added; i++) {
-    const EngGate& e = st.gd[g + i];
-    added[i * 4 + 0] = e.type;
-    added[i * 4 + 1] = e.in1;
-    added[i * 4 + 2] = e.in2;
-    added[i * 4 + 3] = e.in3;
-  }
-  *out_gid = gid;
-  return n_added;
+// LUT-mode counterpart: the whole LUT-mode create_circuit recursion for
+// nodes that need no device work; returns -2 (BAILED) when a node would
+// need a device sweep (pivot-sized 5-LUT space, in-kernel solver
+// overflow, staged 7-LUT) — the caller then reruns the call through the
+// Python engine.  Same added-row/stats layout as sbg_gate_engine.
+int64_t sbg_lut_engine(
+    const uint64_t* tables, int32_t g, int32_t num_inputs, int32_t max_gates,
+    int64_t sat_metric, int64_t max_sat_metric, int32_t metric,
+    const uint64_t* target, const uint64_t* mask, const int16_t* pair_mt,
+    const int32_t* pair_ops, const uint32_t* w_tab, const uint32_t* m_tab,
+    const int32_t* idx_tab, const int32_t* orders, const uint32_t* wo_tab,
+    const uint32_t* wm_tab, const uint32_t* g_tab, int32_t n_sigma,
+    const int32_t* inbits, int32_t n_inbits, int32_t randomize,
+    uint64_t rng_seed, int32_t* out_gid, int32_t* added, int64_t* stats) {
+  EngState st;
+  EngCfg C;
+  eng_init(st, C, tables, g, num_inputs, max_gates, sat_metric,
+           max_sat_metric, metric, randomize, rng_seed);
+  LutTabs lt;
+  lt.w_tab = w_tab;
+  lt.m_tab = m_tab;
+  lt.idx_tab = idx_tab;
+  lt.orders = orders;
+  lt.wo_tab = wo_tab;
+  lt.wm_tab = wm_tab;
+  lt.g_tab = g_tab;
+  lt.n_sigma = n_sigma;
+  C.pair_mt = pair_mt;
+  C.pair_ops = pair_ops;
+  C.lut = &lt;
+  return eng_run(st, C, target, mask, inbits, n_inbits, g, out_gid, added,
+                 stats);
 }
 
 }  // extern "C"

@@ -239,17 +239,32 @@ class State:
             out = self.add_not_gate(out, metric)
         return out
 
-    def replay_gate(self, gate_type: int, gid1: int, gid2: int) -> int:
+    def replay_gate(
+        self,
+        gate_type: int,
+        gid1: int,
+        gid2: int,
+        gid3: int = NO_GATE,
+        function: int = 0,
+    ) -> int:
         """Appends a gate WITHOUT budget checks: the replay path for
-        results computed by the native engine, which already enforced
-        the add_gate budget rules during its search.  Re-checking here
-        would wrongly reject legal results — the mux recursion
-        temporarily raises budgets (the OR branch runs under the AND
-        branch's achieved size, sboxgates.c:539-543), so an adopted
-        circuit may exceed the ORIGINAL budgets by design, exactly as in
-        the Python engine.  Tables and the SAT metric are recomputed
-        here, never trusted from the engine."""
-        assert gate_type not in (bf.IN, bf.LUT)
+        results computed by the native engines, which already enforced
+        the add_gate/add_lut budget rules during their search.
+        Re-checking here would wrongly reject legal results — the mux
+        recursion temporarily raises budgets (the OR branch runs under
+        the AND branch's achieved size, sboxgates.c:539-543), so an
+        adopted circuit may exceed the ORIGINAL budgets by design,
+        exactly as in the Python engine.  Tables and the SAT metric are
+        recomputed here, never trusted from the engine."""
+        assert gate_type != bf.IN
+        if gate_type == bf.LUT:
+            table = tt.eval_lut(
+                function, self.tables[gid1], self.tables[gid2],
+                self.tables[gid3],
+            )
+            return self._append(
+                Gate(bf.LUT, gid1, gid2, gid3, function=function), table
+            )
         self.sat_metric += get_sat_metric(gate_type)
         if gate_type == bf.NOT:
             table = ~self.tables[gid1]

@@ -242,6 +242,36 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.sbg_gate_engine.restype = ctypes.c_int64
 
+        lib.sbg_lut_engine.argtypes = [
+            ctypes.c_void_p,  # tables
+            ctypes.c_int32,   # g
+            ctypes.c_int32,   # num_inputs
+            ctypes.c_int32,   # max_gates
+            ctypes.c_int64,   # sat_metric
+            ctypes.c_int64,   # max_sat_metric
+            ctypes.c_int32,   # metric
+            ctypes.c_void_p,  # target
+            ctypes.c_void_p,  # mask
+            ctypes.c_void_p,  # pair_mt
+            ctypes.c_void_p,  # pair_ops
+            ctypes.c_void_p,  # w_tab
+            ctypes.c_void_p,  # m_tab
+            ctypes.c_void_p,  # idx_tab
+            ctypes.c_void_p,  # orders
+            ctypes.c_void_p,  # wo_tab
+            ctypes.c_void_p,  # wm_tab
+            ctypes.c_void_p,  # g_tab
+            ctypes.c_int32,   # n_sigma
+            ctypes.c_void_p,  # inbits
+            ctypes.c_int32,   # n_inbits
+            ctypes.c_int32,   # randomize
+            ctypes.c_uint64,  # rng_seed
+            ctypes.c_void_p,  # out_gid
+            ctypes.c_void_p,  # added
+            ctypes.c_void_p,  # stats
+        ]
+        lib.sbg_lut_engine.restype = ctypes.c_int64
+
         _lib = lib
         return lib
 
@@ -527,8 +557,8 @@ class GateEngineCaller:
             np.asarray(list(inbits) or [0], dtype=np.int32)
         )
         out_gid = np.full(1, 0xFFFF, dtype=np.int32)
-        added = np.zeros((max_gates + 8, 4), dtype=np.int32)
-        stats = np.zeros(3, dtype=np.int64)
+        added = np.zeros((max_gates + 8, 5), dtype=np.int32)
+        stats = np.zeros(8, dtype=np.int64)
         n = self._fn(
             tables.ctypes.data,
             g,
@@ -553,6 +583,82 @@ class GateEngineCaller:
             added.ctypes.data,
             stats.ctypes.data,
         )
+        if n < 0:
+            return 0xFFFF, added[:0], stats
+        return int(out_gid[0]), added[: int(n)], stats
+
+
+class LutEngineCaller:
+    """Per-context entry to the native LUT-mode search engine
+    (csrc sbg_lut_engine): the whole LUT-mode create_circuit recursion
+    for nodes needing no device work; returns BAILED when a node would
+    (pivot-sized 5-LUT space, in-kernel solver overflow, staged 7-LUT),
+    and the caller reruns through the Python engine."""
+
+    BAILED = object()
+
+    __slots__ = ("_fn", "_bufs", "_addrs")
+
+    def __init__(self, pair_table, pair_entries):
+        from ..ops import sweeps
+
+        self._fn = _require().sbg_lut_engine
+        pair_mt = _buf(pair_table, np.int16)
+        pair_ops = GateEngineCaller._ops_array(pair_entries)
+        _, w_tab, m_tab = sweeps.lut5_split_tables()
+        idx_tab, _ = sweeps.lut7_pair_tables()
+        orders, wo_tab, wm_tab, g_tab = sweeps.lut7_split_tables()
+        bufs = (
+            pair_mt,
+            pair_ops,
+            _buf(w_tab, np.uint32),
+            _buf(m_tab, np.uint32),
+            _buf(idx_tab, np.int32),
+            _buf(np.asarray(orders), np.int32),
+            _buf(wo_tab, np.uint32),
+            _buf(wm_tab, np.uint32),
+            _buf(g_tab, np.uint32),
+        )
+        self._bufs = bufs
+        self._addrs = tuple(b.ctypes.data for b in bufs)
+
+    def __call__(
+        self, tables, g, num_inputs, max_gates, sat_metric, max_sat_metric,
+        metric, target, mask, inbits, randomize, rng_seed,
+    ):
+        """Returns (out_gid, added int32[n,5], stats int64[8]) or
+        (BAILED, None, stats) when the search needs device work."""
+        assert tables.flags["C_CONTIGUOUS"] and tables.shape[0] >= g
+        assert tables.shape[-1] * tables.itemsize == 32
+        inb = np.ascontiguousarray(
+            np.asarray(list(inbits) or [0], dtype=np.int32)
+        )
+        out_gid = np.full(1, 0xFFFF, dtype=np.int32)
+        added = np.zeros((max_gates + 8, 5), dtype=np.int32)
+        stats = np.zeros(8, dtype=np.int64)
+        n_sigma = self._bufs[4].shape[0]
+        n = self._fn(
+            tables.ctypes.data,
+            g,
+            num_inputs,
+            max_gates,
+            sat_metric,
+            max_sat_metric,
+            metric,
+            target.ctypes.data,
+            mask.ctypes.data,
+            *self._addrs,
+            n_sigma,
+            inb.ctypes.data,
+            len(inbits),
+            int(bool(randomize)),
+            rng_seed & 0xFFFFFFFFFFFFFFFF,
+            out_gid.ctypes.data,
+            added.ctypes.data,
+            stats.ctypes.data,
+        )
+        if n == -2:
+            return self.BAILED, None, stats
         if n < 0:
             return 0xFFFF, added[:0], stats
         return int(out_gid[0]), added[: int(n)], stats

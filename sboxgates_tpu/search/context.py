@@ -144,13 +144,18 @@ class Options:
     # results do not depend on the routing.  Disabled automatically when
     # the native library is unavailable.
     host_small_steps: bool = True
-    # Run the WHOLE gate-mode (non-LUT) create_circuit recursion in the
-    # native engine (csrc sbg_gate_engine) instead of Python driving the
-    # per-node native steps: profiling shows ~64% of gate-mode wall time
-    # is the Python recursion (state copies, mux fold, bookkeeping).
-    # Results are bit-identical to the Python engine when not
-    # randomizing (tests enforce it); randomized runs stay seed-
-    # deterministic but draw from the engine's own PRNG stream.
+    # Run the WHOLE create_circuit recursion in a native engine
+    # (csrc sbg_gate_engine / sbg_lut_engine) instead of Python driving
+    # the per-node native steps: profiling showed ~64% of gate-mode
+    # wall time was the Python recursion (state copies, mux fold,
+    # bookkeeping).  Gate mode always completes natively (10.9x
+    # measured); LUT mode runs natively until a node needs a device
+    # sweep (pivot-sized 5-LUT space, staged 7-LUT, solver overflow)
+    # and then bails back to the Python engine for that call (1.7x
+    # measured on DES-class searches).  Results are bit-identical to
+    # the Python engine when not randomizing (tests enforce it);
+    # randomized runs stay seed-deterministic but draw from the
+    # engine's own PRNG stream.
     native_engine: bool = True
 
 
@@ -276,6 +281,7 @@ class SearchContext:
         self._seed_buf = (np.empty(0, dtype=np.int64), 0)
         self._gate_step_caller = None
         self._gate_engine_caller = None
+        self._lut_engine_caller = None
         self._binom = None
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
@@ -618,14 +624,12 @@ class SearchContext:
         return g < 5 or lut_head_has5(g)
 
     def uses_native_engine(self, st: State) -> bool:
-        """True when the whole gate-mode recursion for this node runs in
-        the native engine (Options.native_engine; same availability /
-        multi-host agreement rules as the per-node native step)."""
-        return (
-            self.opt.native_engine
-            and not self.opt.lut_graph
-            and self.uses_native_step(st)
-        )
+        """True when the whole recursion for this node runs in a native
+        engine (Options.native_engine; same availability / multi-host
+        agreement rules as the per-node native step).  Gate mode always
+        completes natively; LUT mode bails back to the Python engine for
+        nodes that need device sweeps."""
+        return self.opt.native_engine and self.uses_native_step(st)
 
     def gate_engine_caller(self):
         if self._gate_engine_caller is None:
@@ -640,6 +644,15 @@ class SearchContext:
                 self.triple_entries,
             )
         return self._gate_engine_caller
+
+    def lut_engine_caller(self):
+        if self._lut_engine_caller is None:
+            from .. import native
+
+            self._lut_engine_caller = native.LutEngineCaller(
+                self.pair_table_np, self.pair_entries
+            )
+        return self._lut_engine_caller
 
     def _gate_step_native(self, st: State, target, mask):
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical

@@ -42,12 +42,19 @@ def _create_circuit(
     opt = ctx.opt
     metric = opt.metric
 
-    # Gate mode: the whole recursion runs in the native engine when
-    # available (csrc sbg_gate_engine) — Python only replays the final
-    # adopted gate additions and re-verifies.  Bit-identical to the
-    # Python path below when not randomizing.
+    # The whole recursion runs in a native engine when available
+    # (csrc sbg_gate_engine / sbg_lut_engine) — Python only replays the
+    # final adopted gate additions and re-verifies.  Bit-identical to
+    # the Python path below when not randomizing; LUT-mode nodes that
+    # need device sweeps (pivot-sized 5-LUT, staged 7-LUT, solver
+    # overflow) make the engine bail, and the call falls through to the
+    # Python engine below.
     if ctx.uses_native_engine(st):
-        return _native_engine_search(ctx, st, target, mask, inbits)
+        if not opt.lut_graph:
+            return _native_engine_search(ctx, st, target, mask, inbits)
+        ret = _native_lut_engine_search(ctx, st, target, mask, inbits)
+        if ret is not None:
+            return ret
 
     # Steps 1-4 in ONE fused device dispatch; budget gates are applied
     # host-side in the reference's order (sboxgates.c:301-435).  LUT mode
@@ -184,19 +191,51 @@ def _create_circuit(
     return best_out
 
 
+_ENGINE_STATS = {
+    1: "pair_candidates",
+    2: "triple_candidates",
+    3: "lut3_candidates",
+    4: "lut5_candidates",
+    5: "lut7_candidates",
+    6: "lut7_solved",
+}
+
+
+def _engine_replay(ctx, st: State, target, mask, out_gid, added, stats) -> int:
+    """Shared tail of both native engines: merge stats, replay the final
+    adopted gate additions onto ``st`` (recomputing tables and the SAT
+    metric through the ordinary mutators), and re-verify — the engine
+    result is never trusted blindly.  replay_gate skips budget checks:
+    the engine enforced them during the search, and the mux recursion's
+    temporary budget raises mean a legal result can exceed the original
+    budgets (exactly as the Python engine's can)."""
+    for idx, key in _ENGINE_STATS.items():
+        if int(stats[idx]):
+            ctx.stats[key] = ctx.stats.get(key, 0) + int(stats[idx])
+    ctx.stats["engine_nodes"] = (
+        ctx.stats.get("engine_nodes", 0) + int(stats[0])
+    )
+    if out_gid == NO_GATE:
+        return NO_GATE
+    for row in added:
+        t, i1, i2, i3, func = (int(x) for x in row)
+        st.replay_gate(t, i1, i2 if t != bf.NOT else NO_GATE, i3, func)
+    st.verify_gate(out_gid, target, mask)
+    return out_gid
+
+
+def _engine_seed(ctx) -> int:
+    return int(ctx.rng.integers(0, 2**63)) if ctx.opt.randomize else 0
+
+
 def _native_engine_search(
     ctx: SearchContext, st: State, target, mask, inbits: List[int]
 ) -> int:
-    """Runs the gate-mode search in the native engine and replays the
-    final adopted gate additions onto ``st`` (recomputing tables and the
-    SAT metric through the ordinary mutators, then re-verifying — the
-    engine result is never trusted blindly)."""
+    """Runs the gate-mode search in the native engine; see
+    :func:`_engine_replay` for the replay/verify contract."""
     import numpy as np
 
     eng = ctx.gate_engine_caller()
-    rng_seed = (
-        int(ctx.rng.integers(0, 2**63)) if ctx.opt.randomize else 0
-    )
     with ctx.prof.phase("gate_engine_native"):
         out_gid, added, stats = eng(
             st.live_tables(),
@@ -210,25 +249,42 @@ def _native_engine_search(
             np.asarray(mask),
             list(inbits),
             ctx.opt.randomize,
-            rng_seed,
+            _engine_seed(ctx),
             use_not=bool(ctx.not_entries),
         )
-    ctx.stats["pair_candidates"] += int(stats[1])
-    ctx.stats["triple_candidates"] += int(stats[2])
-    ctx.stats["engine_nodes"] = (
-        ctx.stats.get("engine_nodes", 0) + int(stats[0])
-    )
-    if out_gid == NO_GATE:
-        return NO_GATE
-    for row in added:
-        t, i1, i2, _ = (int(x) for x in row)
-        # replay_gate skips budget checks: the engine enforced them
-        # during the search, and the mux recursion's temporary budget
-        # raises mean a legal result can exceed the original budgets
-        # (exactly as the Python engine's can).
-        st.replay_gate(t, i1, i2 if t != bf.NOT else NO_GATE)
-    st.verify_gate(out_gid, target, mask)
-    return out_gid
+    return _engine_replay(ctx, st, target, mask, out_gid, added, stats)
+
+
+def _native_lut_engine_search(
+    ctx: SearchContext, st: State, target, mask, inbits: List[int]
+):
+    """LUT-mode native engine run; returns the gate id (or NO_GATE), or
+    None when the engine bailed (a node needed device work) and the
+    caller must run the Python engine instead.  On bail the engine's
+    exploration and stats are discarded — the Python rerun recounts."""
+    import numpy as np
+
+    eng = ctx.lut_engine_caller()
+    with ctx.prof.phase("lut_engine_native"):
+        out_gid, added, stats = eng(
+            st.live_tables(),
+            st.num_gates,
+            st.num_inputs,
+            st.max_gates,
+            st.sat_metric,
+            st.max_sat_metric,
+            ctx.opt.metric,
+            np.asarray(target),
+            np.asarray(mask),
+            list(inbits),
+            ctx.opt.randomize,
+            _engine_seed(ctx),
+        )
+    from ..native import LutEngineCaller
+
+    if out_gid is LutEngineCaller.BAILED:
+        return None
+    return _engine_replay(ctx, st, target, mask, out_gid, added, stats)
 
 
 def _mux_try_bit(ctx: SearchContext, st: State, target, mask, bit, tracked):

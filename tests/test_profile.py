@@ -109,14 +109,16 @@ def test_search_populates_phases():
     )
     assert results
     snap = ctx.prof.snapshot()
-    # LUT mode single-device runs the fused head (steps 1-3 + 3/5-LUT in
-    # one call per node) — native on the host when available, otherwise
-    # the device dispatch.
-    head = (
-        "lut_step_native"
-        if ctx.uses_native_step(results[-1])
-        else "lut_step"
-    )
+    # LUT mode runs the native engine when available (whole recursion in
+    # one phase), else the fused head per node — native on the host when
+    # available, otherwise the device dispatch.
+    if ctx.uses_native_engine(results[-1]):
+        head = "lut_engine_native"
+    elif ctx.uses_native_step(results[-1]):
+        head = "lut_step_native"
+    else:
+        head = "lut_step"
+
     assert snap[head][0] > 0 and snap[head][1] >= 1
     assert snap["kwan_host"][0] > 0
     # Phases appear in the report with the candidate-rate column.
