@@ -936,8 +936,17 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         detail = [{"metric": "backend_unreachable", "error": why_dead}]
+
+        def des_s1_lut():
+            # With the native LUT engine, DES-class LUT searches make no
+            # device dispatches at all, so this entry is backend-
+            # independent too.
+            entry, _ = bench_des_s1_lut()
+            return entry
+
         for fn in (bench_cpu_baseline, bench_des_s1_sat_not,
-                   bench_lut7_break_even):
+                   bench_lut7_break_even, des_s1_lut, bench_multibox_des,
+                   bench_permute_sweep):
             try:
                 detail.append(fn())
             except Exception as e:

@@ -280,9 +280,7 @@ def _native_lut_engine_search(
             ctx.opt.randomize,
             _engine_seed(ctx),
         )
-    from ..native import LutEngineCaller
-
-    if out_gid is LutEngineCaller.BAILED:
+    if added is None:  # BAILED: a node needed device work
         return None
     return _engine_replay(ctx, st, target, mask, out_gid, added, stats)
 
