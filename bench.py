@@ -451,6 +451,40 @@ def bench_des_s1_sat_not() -> dict:
     }
 
 
+def bench_des_s1_full_graph() -> dict:
+    """The third reference CI config (.travis.yml:43: mpirun -N 4
+    -a 10694 -i 3 -p 63 des_s1): the full 4-output beam search with a
+    restricted gate set and a permuted input.  Gate mode, so the whole
+    run executes in the native engine — backend-independent."""
+    from sboxgates_tpu import native
+    from sboxgates_tpu.search import Options, SearchContext, generate_graph, make_targets
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    if not native.available():
+        # Without the native engine every node is a device dispatch; the
+        # 4-output beam search would run for hours measuring the link.
+        raise RuntimeError(
+            f"native runtime unavailable: {native.build_error()}"
+        )
+    sbox, n = load_sbox(os.path.join(HERE, "sboxes/des_s1.txt"), permute=63)
+    targets = make_targets(sbox)
+    ctx = SearchContext(
+        Options(seed=42, iterations=3, avail_gates_bitfield=10694)
+    )
+    st = State.init_inputs(n)
+    t0 = time.perf_counter()
+    beam = generate_graph(ctx, st, targets, save_dir=None, log=lambda s: None)
+    dt = time.perf_counter() - t0
+    best = beam[0] if beam else None
+    return {
+        "metric": "des_s1_full_graph_a10694_p63_i3",
+        "value": dt, "unit": "s",
+        "gates": best.num_gates - best.num_inputs if best else None,
+        "outputs": 4,
+    }
+
+
 def bench_des_s1_outputs_batched() -> dict:
     """Batch-parallel axis (BASELINE configs 4-5): all four DES S1 output
     bits searched as ONE concurrent LUT batch (rendezvous-merged device
@@ -945,12 +979,16 @@ def main() -> None:
             return entry
 
         for fn in (bench_cpu_baseline, bench_des_s1_sat_not,
-                   bench_lut7_break_even, des_s1_lut, bench_multibox_des,
-                   bench_permute_sweep):
+                   bench_des_s1_full_graph, bench_lut7_break_even,
+                   des_s1_lut, bench_multibox_des, bench_permute_sweep):
             try:
                 detail.append(fn())
             except Exception as e:
                 detail.append({"metric": fn.__name__, "error": repr(e)})
+            # Incremental, like the main path: a hang in a later entry
+            # must not lose what's already captured.
+            with open(os.path.join(HERE, "BENCH_UNREACHABLE.json"), "w") as f:
+                json.dump(detail, f, indent=1)
         with open(os.path.join(HERE, "BENCH_UNREACHABLE.json"), "w") as f:
             json.dump(detail, f, indent=1)
         print(
@@ -1011,6 +1049,7 @@ def main() -> None:
         detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
     flush()
     run(bench_des_s1_sat_not)
+    run(bench_des_s1_full_graph)
     run(bench_des_s1_outputs_batched)
     run(bench_lut7_break_even)
     run(bench_lut7_capped_search)

@@ -628,17 +628,14 @@ class SearchContext:
         engine (Options.native_engine; same availability / multi-host
         agreement rules as the per-node native step).  Gate mode always
         completes natively; LUT mode bails back to the Python engine for
-        nodes that need device sweeps — and a pivot-sized 5-LUT space is
-        a GUARANTEED bail (g only grows down the recursion), so those
-        nodes skip the engine up front instead of paying a duplicate
-        head scan per node."""
-        if not (self.opt.native_engine and self.uses_native_step(st)):
-            return False
-        if self.opt.lut_graph and not lut_head_has5(st.num_gates) and (
-            st.num_gates >= 5
-        ):
-            return False
-        return True
+        nodes that need device sweeps.  Pivot-sized LUT nodes skip the
+        engine up front: their only native benefit is the head scan,
+        which the Python path runs natively anyway (_lut_step_native),
+        so entering the engine just duplicates that scan on the common
+        head-miss-then-bail outcome.  The predicate is exactly
+        node_host_only — the same routing that decides whether mux
+        threads are worthwhile."""
+        return self.opt.native_engine and self.node_host_only(st)
 
     def gate_engine_caller(self):
         if self._gate_engine_caller is None:

@@ -694,11 +694,12 @@ def test_lut_engine_matches_python_engine():
     from sboxgates_tpu.search.kwan import create_circuit
     from sboxgates_tpu.utils.sbox import load_sbox
 
-    for box, bit in [
-        ("crypto1_fa", 0),
-        ("crypto1_fc", 0),
-        ("des_s1", 0),
-        ("des_s1", 3),
+    for box, bit, kw in [
+        ("crypto1_fa", 0, {}),
+        ("crypto1_fc", 0, {}),
+        ("des_s1", 0, {}),
+        ("des_s1", 3, {}),
+        ("des_s1", 1, {"avail_gates_bitfield": 10694}),
     ]:
         sbox, n = load_sbox(os.path.join(SBOXES, f"{box}.txt"))
         targets = make_targets(sbox)
@@ -708,7 +709,7 @@ def test_lut_engine_matches_python_engine():
             ctx = SearchContext(
                 Options(
                     seed=1, randomize=False, lut_graph=True,
-                    native_engine=engine,
+                    native_engine=engine, **kw,
                 )
             )
             st = State.init_inputs(n)
@@ -722,7 +723,7 @@ def test_lut_engine_matches_python_engine():
             )
             if out != 0xFFFF:
                 st.verify_gate(out, targets[bit], mask)
-        assert res[True] == res[False], (box, bit)
+        assert res[True] == res[False], (box, bit, kw)
 
 
 def test_lut_engine_bails_to_python_on_pivot_states():
