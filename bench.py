@@ -985,12 +985,18 @@ def main() -> None:
                 detail.append(fn())
             except Exception as e:
                 detail.append({"metric": fn.__name__, "error": repr(e)})
-            # Incremental, like the main path: a hang in a later entry
-            # must not lose what's already captured.
-            with open(os.path.join(HERE, "BENCH_UNREACHABLE.json"), "w") as f:
+            # Incremental to a .partial file, renamed over the real one
+            # only at completion (same protocol as the main path): a
+            # hang loses nothing AND never clobbers the last complete
+            # capture with a truncated file.
+            with open(
+                os.path.join(HERE, "BENCH_UNREACHABLE.partial.json"), "w"
+            ) as f:
                 json.dump(detail, f, indent=1)
-        with open(os.path.join(HERE, "BENCH_UNREACHABLE.json"), "w") as f:
-            json.dump(detail, f, indent=1)
+        os.replace(
+            os.path.join(HERE, "BENCH_UNREACHABLE.partial.json"),
+            os.path.join(HERE, "BENCH_UNREACHABLE.json"),
+        )
         print(
             json.dumps(
                 {

@@ -634,7 +634,12 @@ class SearchContext:
         so entering the engine just duplicates that scan on the common
         head-miss-then-bail outcome.  The predicate is exactly
         node_host_only — the same routing that decides whether mux
-        threads are worthwhile."""
+        threads are worthwhile.  Verbose LUT runs stay on the Python
+        engine: the reference's rank-tagged find lines
+        ("[   0] Found 5LUT: ...", lut.c:219-222) are printed by the
+        Python decode paths the engine bypasses."""
+        if self.opt.lut_graph and self.opt.verbosity >= 1:
+            return False
         return self.opt.native_engine and self.node_host_only(st)
 
     def gate_engine_caller(self):
