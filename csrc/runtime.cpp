@@ -895,15 +895,9 @@ constexpr uint64_t AGREE_DIAG = 0x8040201008040201ULL;
 // immediately infeasible).  O(64) worst case, replacing the 256-fm scan
 // with an outcome-identical test whose cost does NOT depend on how
 // prunable the row is.
-inline bool middle_exists(uint64_t m) {
-  if (m & AGREE_DIAG) return false;
-  uint8_t adj[8];
-  for (int q = 0; q < 8; q++) adj[q] = (uint8_t)((m >> (q * 8)) & 0xFF);
-  for (int q = 0; q < 8; q++) {
-    for (int r = 0; r < 8; r++) {
-      if ((adj[q] >> r) & 1) adj[r] |= (uint8_t)(1 << q);
-    }
-  }
+// 2-colorability of an 8-node undirected graph given symmetric
+// adjacency bitmasks.
+inline bool bipartite8(const uint8_t adj[8]) {
   int8_t color[8] = {-1, -1, -1, -1, -1, -1, -1, -1};
   for (int s = 0; s < 8; s++) {
     if (color[s] >= 0 || adj[s] == 0) continue;
@@ -927,6 +921,41 @@ inline bool middle_exists(uint64_t m) {
     }
   }
   return true;
+}
+
+inline bool middle_exists(uint64_t m) {
+  if (m & AGREE_DIAG) return false;
+  uint8_t adj[8];
+  for (int q = 0; q < 8; q++) adj[q] = (uint8_t)((m >> (q * 8)) & 0xFF);
+  for (int q = 0; q < 8; q++) {
+    for (int r = 0; r < 8; r++) {
+      if ((adj[q] >> r) & 1) adj[r] |= (uint8_t)(1 << q);
+    }
+  }
+  return bipartite8(adj);
+}
+
+// Per-ordering pre-test over the OUTER function space: every fo's
+// conflict mask ORs the same-side B rows, and agree[fm] always contains
+// the diagonal, so an fo can only survive if its side split keeps all
+// diagonal-contributing B rows on opposite sides.  If the graph of
+// diagonal contributions (edge (p1,p0) when B[p1*8+p0]|B[p0*8+p1] has a
+// diagonal bit; self-loop when B[p][p] does) has a self-loop or an odd
+// cycle, NO side split avoids them — every fo is rejected, and the
+// whole SOS build + 256-fo scan can be skipped.  Conservative: a
+// bipartite graph still runs the full scan.
+inline bool outer_prefilter_feasible(const uint64_t B[64]) {
+  uint8_t dadj[8] = {0};
+  for (int p1 = 0; p1 < 8; p1++) {
+    if (B[p1 * 8 + p1] & AGREE_DIAG) return false;  // self-loop
+    for (int p0 = 0; p0 < p1; p0++) {
+      if ((B[p1 * 8 + p0] | B[p0 * 8 + p1]) & AGREE_DIAG) {
+        dadj[p1] |= (uint8_t)(1 << p0);
+        dadj[p0] |= (uint8_t)(1 << p1);
+      }
+    }
+  }
+  return bipartite8(dadj);
 }
 
 // Subset-OR decomposition of the fo sweep: sub[p1][S] = OR of B rows
@@ -991,6 +1020,7 @@ void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
         sel_sigma[t] = s;
         break;
       }
+      if (!outer_prefilter_feasible(B)) continue;  // no fo can pass
       FoSweep fs;
       fs.build(B);
       for (int fo = 0; fo < 256; fo++) {
