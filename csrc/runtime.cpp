@@ -454,6 +454,113 @@ inline bool pair_stage(const NodeCtx& n, const int16_t* mt, uint32_t sx,
   return true;
 }
 
+// Prefix-cached feasibility for lexicographic combination streams: the
+// successor iterator usually advances only the LAST tuple element, so
+// the first k-1 tables' cell masks (already intersected with the
+// need1/need0 position sets) are cached and reused across successors.
+// Bit-identical to feasible_constraints: same cell order (prefix
+// pattern = high bits, last input = LSB), same first-conflict early
+// exit, same packed constraint values — just ~2-4x less recomputation.
+extern "C++" {
+
+template <int K>
+struct PrefixScan {
+  static constexpr int PJ = 1 << (K - 1);
+  int32_t pc[K - 1];
+  TT a1[PJ], a0[PJ];
+  PrefixScan() {
+    for (int i = 0; i < K - 1; i++) pc[i] = -1;
+    // pc = -1 forces a rebuild on first use; zeroed anyway so the
+    // compiler's maybe-uninitialized analysis (which cannot see that
+    // guarantee) stays quiet.
+    std::memset(a1, 0, sizeof(a1));
+    std::memset(a0, 0, sizeof(a0));
+  }
+  bool feasible(const NodeCtx& n, const int32_t* c, uint32_t* r1,
+                uint32_t* r0) {
+    bool same = true;
+    for (int i = 0; i < K - 1; i++) same &= (c[i] == pc[i]);
+    if (!same) {
+      for (int j = 0; j < PJ; j++) {
+        TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+        for (int i = 0; i < K - 1; i++) {
+          const TT& t = n.T[c[i]];
+          m = tt_and(m, ((j >> (K - 2 - i)) & 1) ? t : tt_not(t));
+        }
+        a1[j] = tt_and(m, n.need1);
+        a0[j] = tt_and(m, n.need0);
+      }
+      for (int i = 0; i < K - 1; i++) pc[i] = c[i];
+    }
+    const TT& tl = n.T[c[K - 1]];
+    const TT ntl = tt_not(tl);
+    uint32_t x1 = 0, x0 = 0;
+    for (int cell = 0; cell < (1 << K); cell++) {
+      const int j = cell >> 1;
+      const TT& tb = (cell & 1) ? tl : ntl;
+      const bool h1 = tt_any(tt_and(a1[j], tb));
+      const bool h0 = tt_any(tt_and(a0[j], tb));
+      if (h1 && h0) return false;
+      if (h1) x1 |= 1u << cell;
+      if (h0) x0 |= 1u << cell;
+    }
+    *r1 = x1;
+    *r0 = x0;
+    return true;
+  }
+};
+
+// Wide (K > 5) prefix-cached variant: packed word-array constraints
+// (feasible_constraints_wide semantics), same cell order and early
+// conflict exit.
+template <int K>
+struct PrefixScanWide {
+  static constexpr int PJ = 1 << (K - 1);
+  int32_t pc[K - 1];
+  TT a1[PJ], a0[PJ];
+  PrefixScanWide() {
+    for (int i = 0; i < K - 1; i++) pc[i] = -1;
+    std::memset(a1, 0, sizeof(a1));
+    std::memset(a0, 0, sizeof(a0));
+  }
+  bool feasible(const NodeCtx& n, const int32_t* c, uint32_t* r1,
+                uint32_t* r0) {
+    constexpr int words = (1 << K) / 32;
+    bool same = true;
+    for (int i = 0; i < K - 1; i++) same &= (c[i] == pc[i]);
+    if (!same) {
+      for (int j = 0; j < PJ; j++) {
+        TT m = {~0ULL, ~0ULL, ~0ULL, ~0ULL};
+        for (int i = 0; i < K - 1; i++) {
+          const TT& t = n.T[c[i]];
+          m = tt_and(m, ((j >> (K - 2 - i)) & 1) ? t : tt_not(t));
+        }
+        a1[j] = tt_and(m, n.need1);
+        a0[j] = tt_and(m, n.need0);
+      }
+      for (int i = 0; i < K - 1; i++) pc[i] = c[i];
+    }
+    const TT& tl = n.T[c[K - 1]];
+    const TT ntl = tt_not(tl);
+    for (int w = 0; w < words; w++) {
+      r1[w] = 0;
+      r0[w] = 0;
+    }
+    for (int cell = 0; cell < (1 << K); cell++) {
+      const int j = cell >> 1;
+      const TT& tb = (cell & 1) ? tl : ntl;
+      const bool h1 = tt_any(tt_and(a1[j], tb));
+      const bool h0 = tt_any(tt_and(a0[j], tb));
+      if (h1 && h0) return false;
+      if (h1) r1[cell >> 5] |= 1u << (cell & 31);
+      if (h0) r0[cell >> 5] |= 1u << (cell & 31);
+    }
+    return true;
+  }
+};
+
+}  // extern "C++"
+
 // Lexicographic k-combination successor state.
 struct ComboIter {
   int32_t c[8];
@@ -561,6 +668,7 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
     const int32_t s3 = (int32_t)(seed ^ 0x7777);
     ComboIter it;
     it.init(g, 3);
+    PrefixScan<3> scan3;
     int64_t rank = 0;
     while (rank < total3) {
       const int64_t cstart = rank;
@@ -572,7 +680,7 @@ void sbg_gate_step(const uint64_t* tables, int32_t g, int32_t bucket,
       int32_t bslot = 0;
       for (; rank < cend; rank++, it.next()) {
         uint32_t r1, r0;
-        if (feasible_constraints(n, it.c, 3, &r1, &r0)) {
+        if (scan3.feasible(n, it.c, &r1, &r0)) {
           int16_t slot = triple_table[r1 | ((r1 | r0) << 8)];
           if (slot >= 0) {
             uint32_t row = (uint32_t)(rank - cstart);
@@ -631,6 +739,7 @@ void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
     const int32_t s3 = (int32_t)(seed ^ 0x55D3);
     ComboIter it;
     it.init(g, 3);
+    PrefixScan<3> scan3;
     int64_t rank = 0;
     while (rank < total3) {
       const int64_t cstart = rank;
@@ -642,7 +751,7 @@ void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
       uint32_t br1 = 0, br0 = 0;
       for (; rank < cend; rank++, it.next()) {
         uint32_t r1, r0;
-        if (feasible_constraints(n, it.c, 3, &r1, &r0)) {
+        if (scan3.feasible(n, it.c, &r1, &r0)) {
           uint32_t row = (uint32_t)(rank - cstart);
           uint32_t prio = sc < 0 ? (uint32_t)((uint32_t)chunk3 - row)
                                  : hash_prio(row, (uint32_t)sc);
@@ -671,6 +780,7 @@ void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
     const int32_t s5 = (int32_t)(seed ^ 0x1BF5);
     ComboIter it;
     it.init(g, 5);
+    PrefixScan<5> scan5;
     int64_t rank = 0;
     while (rank < total5) {
       const int64_t cstart = rank;
@@ -695,7 +805,7 @@ void sbg_lut_step(const uint64_t* tables, int32_t g, int32_t bucket,
         }
         if (excluded) continue;
         uint32_t r1, r0;
-        if (!feasible_constraints(n, it.c, 5, &r1, &r0)) continue;
+        if (!scan5.feasible(n, it.c, &r1, &r0)) continue;
         nfeas++;
         uint32_t row = (uint32_t)(rank - cstart);
         uint32_t prio = sc < 0 ? (uint32_t)((uint32_t)chunk5 - row)
@@ -792,6 +902,7 @@ int64_t sbg_lut7_stage_a(const uint64_t* tables, int32_t g,
   rows.clear();
   ComboIter it;
   it.init(g, 7);
+  PrefixScanWide<7> scan7;  // ~4KB of prefix cache on the stack
   int64_t end = total7 < (int64_t)chunk7 ? total7 : (int64_t)chunk7;
   int64_t nfeas = 0;
   for (int64_t rank = 0; rank < end; rank++, it.next()) {
@@ -803,7 +914,7 @@ int64_t sbg_lut7_stage_a(const uint64_t* tables, int32_t g,
     }
     if (excluded) continue;
     Row r;
-    if (!feasible_constraints_wide(n, it.c, 7, r.r1, r.r0)) continue;
+    if (!scan7.feasible(n, it.c, r.r1, r.r0)) continue;
     nfeas++;
     r.rank = (int32_t)rank;
     r.prio = sa < 0 ? (uint32_t)((uint32_t)chunk7 - (uint32_t)rank)
