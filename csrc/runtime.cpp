@@ -51,6 +51,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -323,6 +324,48 @@ int64_t sbg_lut5_search_cpu(const uint64_t* tables, int32_t g,
     }
   }
   return -1;
+}
+
+// Threaded driver over the same per-candidate loop: measures the
+// reference's real operating point — N ranks on one node
+// (.travis.yml:40-48) — on however many cores the host actually has,
+// instead of assuming a core count (the socket baseline, measured).
+// Threads scan disjoint contiguous slices with no cross-thread traffic
+// (exactly the reference's static partitioning, lut.c:138-149); the
+// returned hit is the global first in combo order, so the result matches
+// the serial scan.
+int64_t sbg_lut5_search_cpu_mt(const uint64_t* tables, int32_t g,
+                               const uint64_t* target, const uint64_t* mask,
+                               const int32_t* combos, int64_t n,
+                               int32_t n_threads, int32_t* result7) {
+  if (n_threads <= 1) {
+    return sbg_lut5_search_cpu(tables, g, target, mask, combos, n, result7);
+  }
+  std::vector<int64_t> hits((size_t)n_threads, -1);
+  std::vector<std::vector<int32_t>> results(
+      (size_t)n_threads, std::vector<int32_t>(7, 0));
+  std::vector<std::thread> threads;
+  const int64_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    threads.emplace_back([&, t]() {
+      const int64_t lo = (int64_t)t * per;
+      const int64_t hi = std::min(n, lo + per);
+      if (lo >= hi) return;
+      const int64_t r = sbg_lut5_search_cpu(
+          tables, g, target, mask, combos + lo * 5, hi - lo,
+          results[(size_t)t].data());
+      if (r >= 0) hits[(size_t)t] = lo + r;
+    });
+  }
+  for (auto& th : threads) th.join();
+  int64_t best = -1;
+  for (int32_t t = 0; t < n_threads; t++) {
+    if (hits[(size_t)t] >= 0 && (best < 0 || hits[(size_t)t] < best)) {
+      best = hits[(size_t)t];
+      std::memcpy(result7, results[(size_t)t].data(), 7 * sizeof(int32_t));
+    }
+  }
+  return best;
 }
 
 // ---------------------------------------------------------------------
@@ -1192,6 +1235,29 @@ void sbg_lut7_solve_small(const uint32_t* req1, const uint32_t* req0,
 
 }  // extern "C"
 
+// Device-work continuation callback: services a request the engine cannot
+// run host-side, so the native recursion SURVIVES device work instead of
+// discarding its exploration (the round-3 design bailed the whole call).
+// The engine blocks in the callback — its C stack is the "resumable
+// state" — while the Python side runs the exact same search drivers the
+// Python engine would (search/lut.py), then resumes the recursion in
+// place.  Kinds:
+//   1 = full 5-LUT search (pivot-sized space; lut.py lut5_search)
+//   2 = 5-LUT head-solver overflow: resume from chunk rank arg0
+//       (lut.py lut5_resume_overflow)
+//   3 = staged 7-LUT search (lut.py lut7_search)
+// The service writes resp (int32[12]): resp[0] = 0 miss / 1 hit; 5-LUT
+// hits carry [fo, fi, a, b, c, d, e] in resp[1..7]; 7-LUT hits carry
+// [fo, fm, fi, a..g] in resp[1..10].  Returns 0 on success, nonzero on
+// service failure (the engine then bails exactly as the round-3 design
+// always did).  ``rng`` is a per-request draw from the engine stream and
+// ``slot`` a branch id — both reserved for concurrent mux branches.
+extern "C" typedef int32_t (*sbg_eng_devcb)(
+    void* handle, int32_t kind, const uint64_t* tables, int32_t g,
+    const uint64_t* target, const uint64_t* mask, const int32_t* inbits,
+    int32_t n_inbits, int64_t arg0, uint64_t rng, int32_t slot,
+    int32_t* resp);
+
 namespace {
 
 constexpr int32_t ENG_NO_GATE = 0xFFFF;
@@ -1245,16 +1311,20 @@ struct EngCfg {
   const int32_t* not_ops;
   const int32_t* tri_ops;
   const LutTabs* lut;  // non-null = LUT mode
+  // Device-work continuation (may be null): nodes that need device work
+  // call back into Python and resume; without it (or on service failure)
+  // the engine sets `bailed` and unwinds, and the Python caller reruns
+  // the whole call through its own engine.
+  sbg_eng_devcb devcb;
+  void* devcb_handle;
+  int32_t slot;
   int32_t metric;  // 0 = gates, 1 = SAT
   int32_t num_inputs;
   bool randomize;
-  // A node that needs device work (pivot-sized 5-LUT space, staged
-  // 7-LUT, in-kernel 5-LUT solver overflow) sets this and unwinds; the
-  // Python caller reruns the whole call through its own engine.
   bool bailed;
   uint64_t rng;
   int64_t nodes, pair_cand, triple_cand;
-  int64_t lut3_cand, lut5_cand, lut7_cand, lut7_solved;
+  int64_t lut3_cand, lut5_cand, lut7_cand, lut7_solved, devcalls;
 };
 
 inline int32_t eng_bucket(int32_t g) { return g <= 64 ? 64 : 512; }
@@ -1469,7 +1539,7 @@ int64_t eng_run(EngState& st, EngCfg& C, const uint64_t* target,
   stats[4] = C.lut5_cand;
   stats[5] = C.lut7_cand;
   stats[6] = C.lut7_solved;
-  stats[7] = 0;
+  stats[7] = C.devcalls;
   if (C.bailed) return -2;
   if (gid == ENG_NO_GATE) return -1;
   const int32_t n_added = st.ng() - g;
@@ -1543,11 +1613,45 @@ int32_t eng_decode7(EngState& st, EngCfg& C, int64_t rank, int32_t sigma,
   return eng_add_lut(st, fi, outer, mid, G2);
 }
 
+// Invoke the device-work service (see sbg_eng_devcb).  Returns the
+// service's verdict status (0 miss, 1 hit) or -1 when no callback is
+// attached / the service failed — the caller then sets C.bailed and the
+// engine unwinds as the pre-continuation design did.
+int32_t eng_devcall(EngState& st, EngCfg& C, int32_t kind, const TT& target,
+                    const TT& mask, const int32_t* inbits, int32_t n_inbits,
+                    int64_t arg0, int32_t* resp) {
+  if (C.devcb == nullptr) return -1;
+  C.devcalls++;
+  const uint64_t sub = C.randomize ? sm64_next(C.rng) : 0;
+  const int32_t rc = C.devcb(
+      C.devcb_handle, kind,
+      reinterpret_cast<const uint64_t*>(st.tabs.data()), st.ng(), target.w,
+      mask.w, inbits, n_inbits, arg0, sub, C.slot, resp);
+  if (rc != 0) return -1;
+  return resp[0];
+}
+
+// Materialize a service-found 5-LUT decomposition (lut.py
+// _add_lut5_result): two LUT gates from resp [_, fo, fi, a, b, c, d, e].
+int32_t eng_apply_cb5(EngState& st, const int32_t* resp) {
+  const int32_t outer = eng_add_lut(st, resp[1], resp[3], resp[4], resp[5]);
+  return eng_add_lut(st, resp[2], outer, resp[6], resp[7]);
+}
+
+// Materialize a service-found 7-LUT decomposition (lut.py
+// _add_lut7_result): three LUT gates from resp [_, fo, fm, fi, a..g].
+int32_t eng_apply_cb7(EngState& st, const int32_t* resp) {
+  const int32_t outer = eng_add_lut(st, resp[1], resp[4], resp[5], resp[6]);
+  const int32_t mid = eng_add_lut(st, resp[2], resp[7], resp[8], resp[9]);
+  return eng_add_lut(st, resp[3], outer, mid, resp[10]);
+}
+
 // The LUT continuation of one node (search/lut.py lut_search_from_head):
 // decode the head's 3/5-LUT verdict, then the single-chunk 7-LUT phase.
-// Returns the gate id, ENG_NO_GATE to continue into the mux, and sets
-// C.bailed for device-work nodes (pivot-sized 5-LUT spaces, in-kernel
-// solver overflows, staged 7-LUT).
+// Returns the gate id, ENG_NO_GATE to continue into the mux; device-work
+// nodes (pivot-sized 5-LUT spaces, in-kernel solver overflows, staged
+// 7-LUT) are serviced through the continuation callback, or set C.bailed
+// when none is attached.
 int32_t eng_lut_continue(EngState& st, EngCfg& C, const TT& target,
                          const TT& mask, const int32_t* inbits,
                          int32_t n_inbits, const int32_t* out8,
@@ -1573,22 +1677,56 @@ int32_t eng_lut_continue(EngState& st, EngCfg& C, const TT& target,
     eng_verify(st, gid, target, mask);
     return gid;
   }
-  if (step == 6) {  // in-kernel 5-LUT solver overflow -> device re-drive
-    C.bailed = true;
-    return ENG_NO_GATE;
-  }
-  if (!has5 && g_before >= 5) {  // pivot-sized space -> device sweep
-    C.bailed = true;
-    return ENG_NO_GATE;
+  int32_t resp[12] = {0};
+  if (step == 6) {
+    // In-kernel 5-LUT solver overflow: the service re-drives the flagged
+    // chunk two-phase and resumes the fused stream after it (the step==6
+    // branch of lut_search_from_head); a miss falls through to 7-LUT.
+    const int32_t r = eng_devcall(st, C, 2, target, mask, inbits, n_inbits,
+                                  out8[1], resp);
+    if (r < 0) {
+      C.bailed = true;
+      return ENG_NO_GATE;
+    }
+    if (r == 1) {
+      const int32_t gid = eng_apply_cb5(st, resp);
+      eng_verify(st, gid, target, mask);
+      return gid;
+    }
+  } else if (!has5 && g_before >= 5) {
+    // Pivot-sized space: the service runs the full 5-LUT search (pivot
+    // MXU sweep / host fallback); a miss falls through to 7-LUT.
+    const int32_t r = eng_devcall(st, C, 1, target, mask, inbits, n_inbits,
+                                  0, resp);
+    if (r < 0) {
+      C.bailed = true;
+      return ENG_NO_GATE;
+    }
+    if (r == 1) {
+      const int32_t gid = eng_apply_cb5(st, resp);
+      eng_verify(st, gid, target, mask);
+      return gid;
+    }
   }
 
-  // 7-LUT phase (single-chunk only; search/context.py _lut7_step_native).
+  // 7-LUT phase (search/context.py _lut7_step_native single-chunk, or the
+  // staged search through the continuation service).
   const int32_t g = st.ng();
   if (g < 7) return ENG_NO_GATE;
   if (!eng_check_possible(st, C, 3, 0)) return ENG_NO_GATE;
   const int64_t total7 = (int64_t)n_choose_k(g, 7);
   if (total7 > 32768) {  // staged path (stage A cap 100k + chunked B)
-    C.bailed = true;
+    const int32_t r = eng_devcall(st, C, 3, target, mask, inbits, n_inbits,
+                                  0, resp);
+    if (r < 0) {
+      C.bailed = true;
+      return ENG_NO_GATE;
+    }
+    if (r == 1) {
+      const int32_t gid = eng_apply_cb7(st, resp);
+      eng_verify(st, gid, target, mask);
+      return gid;
+    }
     return ENG_NO_GATE;
   }
   const int32_t chunk7 = pick_chunk_c(total7, 32768);
@@ -1615,9 +1753,25 @@ int32_t eng_lut_continue(EngState& st, EngCfg& C, const TT& target,
       eng_verify(st, gid, target, mask);
       return gid;
     }
-    if (nfeas > solve7) {  // overflow -> staged re-run on the device side
-      C.bailed = true;
-      return ENG_NO_GATE;
+    if (nfeas > solve7) {
+      // Overflow: staged re-run through the service.  The staged path
+      // re-counts this node's candidate space and re-solves its tuples,
+      // so back out this call's tallies first — exactly the stats
+      // back-out the Python fused path does (lut_search_from_head
+      // status==2).
+      C.lut7_cand -= total7 < chunk7 ? total7 : chunk7;
+      C.lut7_solved -= nfeas < solve7 ? nfeas : solve7;
+      const int32_t r = eng_devcall(st, C, 3, target, mask, inbits,
+                                    n_inbits, 0, resp);
+      if (r < 0) {
+        C.bailed = true;
+        return ENG_NO_GATE;
+      }
+      if (r == 1) {
+        const int32_t gid = eng_apply_cb7(st, resp);
+        eng_verify(st, gid, target, mask);
+        return gid;
+      }
     }
   }
   return ENG_NO_GATE;
@@ -1910,11 +2064,14 @@ int64_t sbg_gate_engine(
                  stats);
 }
 
-// LUT-mode counterpart: the whole LUT-mode create_circuit recursion for
-// nodes that need no device work; returns -2 (BAILED) when a node would
-// need a device sweep (pivot-sized 5-LUT space, in-kernel solver
-// overflow, staged 7-LUT) — the caller then reruns the call through the
-// Python engine.  Same added-row/stats layout as sbg_gate_engine.
+// LUT-mode counterpart: the whole LUT-mode create_circuit recursion.
+// Nodes that need device work (pivot-sized 5-LUT space, in-kernel solver
+// overflow, staged 7-LUT) are serviced through ``devcb`` (see
+// sbg_eng_devcb) and the recursion continues in place; with no callback
+// attached — or when the service fails — the engine returns -2 (BAILED)
+// and the caller reruns the call through the Python engine.  Same
+// added-row/stats layout as sbg_gate_engine; stats[7] counts serviced
+// device-work requests.
 int64_t sbg_lut_engine(
     const uint64_t* tables, int32_t g, int32_t num_inputs, int32_t max_gates,
     int64_t sat_metric, int64_t max_sat_metric, int32_t metric,
@@ -1923,7 +2080,8 @@ int64_t sbg_lut_engine(
     const int32_t* idx_tab, const int32_t* orders, const uint32_t* wo_tab,
     const uint32_t* wm_tab, const uint32_t* g_tab, int32_t n_sigma,
     const int32_t* inbits, int32_t n_inbits, int32_t randomize,
-    uint64_t rng_seed, int32_t* out_gid, int32_t* added, int64_t* stats) {
+    uint64_t rng_seed, sbg_eng_devcb devcb, void* devcb_handle,
+    int32_t* out_gid, int32_t* added, int64_t* stats) {
   EngState st;
   EngCfg C;
   eng_init(st, C, tables, g, num_inputs, max_gates, sat_metric,
@@ -1940,6 +2098,8 @@ int64_t sbg_lut_engine(
   C.pair_mt = pair_mt;
   C.pair_ops = pair_ops;
   C.lut = &lt;
+  C.devcb = devcb;
+  C.devcb_handle = devcb_handle;
   return eng_run(st, C, target, mask, inbits, n_inbits, g, out_gid, added,
                  stats);
 }

@@ -51,6 +51,93 @@ class NativeUnavailable(RuntimeError):
     """The native runtime could not be built or loaded."""
 
 
+# Device-work continuation callback for the LUT engine (the C side's
+# sbg_eng_devcb): (handle, kind, tables*, g, target*, mask*, inbits*,
+# n_inbits, arg0, rng, slot, resp*) -> rc.  See csrc/runtime.cpp for the
+# kind and resp encodings.
+ENG_DEVCB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_void_p,  # handle
+    ctypes.c_int32,   # kind
+    ctypes.c_void_p,  # tables (uint32[g, 8] view)
+    ctypes.c_int32,   # g
+    ctypes.c_void_p,  # target (uint32[8] view)
+    ctypes.c_void_p,  # mask
+    ctypes.c_void_p,  # inbits (int32[n_inbits])
+    ctypes.c_int32,   # n_inbits
+    ctypes.c_int64,   # arg0 (kind 2: overflow chunk start rank)
+    ctypes.c_uint64,  # rng (engine-stream draw; reserved)
+    ctypes.c_int32,   # slot (branch id; reserved)
+    ctypes.c_void_p,  # resp (int32[12] out)
+)
+
+
+def _as_i32(ptr, n):
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_int32)), shape=(n,)
+    )
+
+
+def _as_u32(ptr, shape):
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint32)), shape=shape
+    )
+
+
+def make_eng_devcb(service):
+    """Wraps a Python device-work service into the C callback ABI;
+    returns (callback, pending) where ``pending`` holds a
+    KeyboardInterrupt/SystemExit captured inside the callback for the
+    caller to re-raise once the ctypes engine call returns (raising
+    across the C frame is not an option).
+
+    ``service(kind, tables, g, target, mask, inbits, arg0, rng, slot)``
+    receives COPIES of the engine's live tables / target / mask (the
+    originals live on the C++ stack) and returns None on a miss or the
+    flat hit tuple to write into resp[1:] ([fo, fi, a..e] for 5-LUT,
+    [fo, fm, fi, a..g] for 7-LUT).  Ordinary exceptions are caught and
+    reported as a service failure — the engine then bails to the Python
+    engine, so a broken service degrades to round-3 behavior instead of
+    crashing.  Interrupts also make the engine bail (the fastest unwind)
+    but are re-raised by the caller, so Ctrl-C still stops the run."""
+    pending = {"exc": None}
+
+    def cb(
+        handle, kind, tables_p, g, target_p, mask_p, inbits_p, n_inbits,
+        arg0, rng, slot, resp_p,
+    ):
+        try:
+            tables = _as_u32(tables_p, (g, 8)).copy()
+            target = _as_u32(target_p, (8,)).copy()
+            mask = _as_u32(mask_p, (8,)).copy()
+            inbits = (
+                [int(x) for x in _as_i32(inbits_p, n_inbits)]
+                if n_inbits
+                else []
+            )
+            out = service(
+                kind, tables, g, target, mask, inbits, int(arg0), int(rng),
+                int(slot),
+            )
+            resp = _as_i32(resp_p, 12)
+            if out is None:
+                resp[0] = 0
+            else:
+                resp[0] = 1
+                resp[1 : 1 + len(out)] = np.asarray(out, dtype=np.int64)
+            return 0
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return 1
+        except BaseException as e:  # KeyboardInterrupt / SystemExit
+            pending["exc"] = e
+            return 1
+
+    return ENG_DEVCB(cb), pending
+
+
 def _build() -> Optional[str]:
     """Compiles the shared library; returns an error string or None."""
     src = os.path.abspath(_SRC_PATH)
@@ -147,6 +234,18 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.sbg_lut5_search_cpu.restype = ctypes.c_int64
+
+        lib.sbg_lut5_search_cpu_mt.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.sbg_lut5_search_cpu_mt.restype = ctypes.c_int64
 
         lib.sbg_gate_step.argtypes = [
             ctypes.c_void_p,
@@ -266,6 +365,8 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,   # n_inbits
             ctypes.c_int32,   # randomize
             ctypes.c_uint64,  # rng_seed
+            ENG_DEVCB,        # devcb (None = bail on device-work nodes)
+            ctypes.c_void_p,  # devcb_handle
             ctypes.c_void_p,  # out_gid
             ctypes.c_void_p,  # added
             ctypes.c_void_p,  # stats
@@ -410,6 +511,42 @@ def lut5_search_cpu(
         _ptr(mask64, ctypes.c_uint64),
         _ptr(combos, ctypes.c_int32),
         combos.shape[0],
+        _ptr(res, ctypes.c_int32),
+    )
+    if idx < 0:
+        return -1, None
+    return int(idx), {
+        "func_outer": int(res[0]),
+        "func_inner": int(res[1]),
+        "gates": tuple(int(x) for x in res[2:7]),
+    }
+
+
+def lut5_search_cpu_mt(
+    tables64: np.ndarray,
+    target64: np.ndarray,
+    mask64: np.ndarray,
+    combos: np.ndarray,
+    n_threads: int,
+) -> Tuple[int, Optional[dict]]:
+    """Threaded :func:`lut5_search_cpu` (disjoint contiguous slices, one
+    OS thread per slice — the reference's N-rank operating point on the
+    host's real cores).  The returned hit is the global first in combo
+    order, identical to the serial scan's."""
+    lib = _require()
+    tables64 = np.ascontiguousarray(tables64, dtype=np.uint64)
+    target64 = np.ascontiguousarray(target64, dtype=np.uint64)
+    mask64 = np.ascontiguousarray(mask64, dtype=np.uint64)
+    combos = np.ascontiguousarray(combos, dtype=np.int32)
+    res = np.zeros(7, dtype=np.int32)
+    idx = lib.sbg_lut5_search_cpu_mt(
+        _ptr(tables64, ctypes.c_uint64),
+        tables64.shape[0],
+        _ptr(target64, ctypes.c_uint64),
+        _ptr(mask64, ctypes.c_uint64),
+        _ptr(combos, ctypes.c_int32),
+        combos.shape[0],
+        int(n_threads),
         _ptr(res, ctypes.c_int32),
     )
     if idx < 0:
@@ -590,16 +727,20 @@ class GateEngineCaller:
 
 class LutEngineCaller:
     """Per-context entry to the native LUT-mode search engine
-    (csrc sbg_lut_engine): the whole LUT-mode create_circuit recursion
-    for nodes needing no device work; returns BAILED when a node would
-    (pivot-sized 5-LUT space, in-kernel solver overflow, staged 7-LUT),
+    (csrc sbg_lut_engine): the whole LUT-mode create_circuit recursion.
+    Device-work nodes (pivot-sized 5-LUT space, in-kernel solver
+    overflow, staged 7-LUT) are serviced through the ``service``
+    continuation callback and the native recursion resumes in place;
+    without one (or when the service fails) the engine returns BAILED
     and the caller reruns through the Python engine."""
 
     BAILED = object()
 
-    __slots__ = ("_fn", "_bufs", "_addrs")
+    __slots__ = ("_fn", "_bufs", "_addrs", "_cb_service", "_cb")
 
     def __init__(self, pair_table, pair_entries):
+        self._cb_service = None
+        self._cb = None
         from ..ops import sweeps
 
         self._fn = _require().sbg_lut_engine
@@ -624,10 +765,12 @@ class LutEngineCaller:
 
     def __call__(
         self, tables, g, num_inputs, max_gates, sat_metric, max_sat_metric,
-        metric, target, mask, inbits, randomize, rng_seed,
+        metric, target, mask, inbits, randomize, rng_seed, service=None,
     ):
         """Returns (out_gid, added int32[n,5], stats int64[8]) or
-        (BAILED, None, stats) when the search needs device work."""
+        (BAILED, None, stats) when the search needed device work and no
+        ``service`` (see :func:`make_eng_devcb`) was attached (or it
+        failed)."""
         assert tables.flags["C_CONTIGUOUS"] and tables.shape[0] >= g
         assert tables.shape[-1] * tables.itemsize == 32
         inb = np.ascontiguousarray(
@@ -637,6 +780,19 @@ class LutEngineCaller:
         added = np.zeros((max_gates + 8, 5), dtype=np.int32)
         stats = np.zeros(8, dtype=np.int64)
         n_sigma = self._bufs[4].shape[0]
+        # The CFUNCTYPE object must stay referenced for the whole engine
+        # call — the C side holds only the bare function pointer.  Cached
+        # per service: the engine runs once per search node and wrapper
+        # construction is measurable at that rate.
+        pending = None
+        if service is None:
+            cb = None
+        elif service is self._cb_service:
+            cb, pending = self._cb
+        else:
+            cb, pending = make_eng_devcb(service)
+            self._cb_service = service
+            self._cb = (cb, pending)
         n = self._fn(
             tables.ctypes.data,
             g,
@@ -653,10 +809,15 @@ class LutEngineCaller:
             len(inbits),
             int(bool(randomize)),
             rng_seed & 0xFFFFFFFFFFFFFFFF,
+            cb,
+            None,
             out_gid.ctypes.data,
             added.ctypes.data,
             stats.ctypes.data,
         )
+        if pending is not None and pending["exc"] is not None:
+            exc, pending["exc"] = pending["exc"], None
+            raise exc
         if n == -2:
             return self.BAILED, None, stats
         if n < 0:

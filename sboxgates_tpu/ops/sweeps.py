@@ -745,18 +745,12 @@ _PIVOT_CELLBITS = (
 ).astype(np.uint32)
 
 
-def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
-    """Shared per-tile constraint computation.  d: descriptor int32[5].
-    Returns (valid [tl,th], feasible, req1, req0 packed uint32 [tl,th]).
-
-    MXU formulation: "does low-pair cell j (pivot polarity s) intersect
-    high-pair cell c2 on any required position" is a boolean inner product
-    over the 256 truth-table positions, so all 32 cells of all tl x th
-    candidates reduce to two int8 matmuls [2*4*tl, 256] x [256, 4*th] with
-    int32 accumulation — the systolic-array path instead of the VPU.
-    Measured ~3.5x faster per tile than the elementwise AND + any-reduce
-    formulation on a v5 chip (and bit-identical to it).
-    """
+def _pivot_tile_operands(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
+    """Expansion half of one pivot tile: packed uint32 cell masks ->
+    int8 matmul operands (lhs1/lhs0 [2*4*tl, 256], rhs [256, 4*th]) plus
+    the validity mask.  Pure VPU/memory work — factored from the matmul
+    half so the pipelined stream can overlap tile t+1's expansion with
+    tile t's MXU pass (ROOFLINE.md lever 1)."""
     m, lo0, lo_end, hi0, hi_end = d[0], d[1], d[2], d[3], d[4]
     pm = tables[m]
     l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
@@ -770,6 +764,29 @@ def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
     lhs1 = (l1b[None] * pmsel[:, None, None, :]).reshape(2 * 4 * tl, 256)
     lhs0 = (l0b[None] * pmsel[:, None, None, :]).reshape(2 * 4 * tl, 256)
     rhs = hb.reshape(4 * th, 256).T              # [256, 4*th]
+    lv = ((lo0 + jnp.arange(tl, dtype=jnp.int32)) < lo_end) & (
+        jax.lax.dynamic_slice(lowvalid, (lo0,), (tl,))
+    )
+    hv = ((hi0 + jnp.arange(th, dtype=jnp.int32)) < hi_end) & (
+        jax.lax.dynamic_slice(highvalid, (hi0,), (th,))
+    )
+    valid = lv[:, None] & hv[None, :]
+    return lhs1, lhs0, rhs, valid
+
+
+def _pivot_tile_from_operands(ops, tl, th):
+    """Matmul half of one pivot tile: int8 operands -> (valid, feasible,
+    req1, req0 packed uint32 [tl, th]).
+
+    MXU formulation: "does low-pair cell j (pivot polarity s) intersect
+    high-pair cell c2 on any required position" is a boolean inner product
+    over the 256 truth-table positions, so all 32 cells of all tl x th
+    candidates reduce to two int8 matmuls [2*4*tl, 256] x [256, 4*th] with
+    int32 accumulation — the systolic-array path instead of the VPU.
+    Measured ~3.5x faster per tile than the elementwise AND + any-reduce
+    formulation on a v5 chip (and bit-identical to it).
+    """
+    lhs1, lhs0, rhs, valid = ops
     dn = (((1,), (0,)), ((), ()))
     c1 = jax.lax.dot_general(
         lhs1, rhs, dn, preferred_element_type=jnp.int32
@@ -785,14 +802,17 @@ def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
     # exactly the bitwise OR
     req1 = (b1.astype(jnp.uint32) << sh).sum(axis=(0, 1, 3))
     req0 = (b0.astype(jnp.uint32) << sh).sum(axis=(0, 1, 3))
-    lv = ((lo0 + jnp.arange(tl, dtype=jnp.int32)) < lo_end) & (
-        jax.lax.dynamic_slice(lowvalid, (lo0,), (tl,))
-    )
-    hv = ((hi0 + jnp.arange(th, dtype=jnp.int32)) < hi_end) & (
-        jax.lax.dynamic_slice(highvalid, (hi0,), (th,))
-    )
-    valid = lv[:, None] & hv[None, :]
     return valid, valid & ~conflict, req1, req0
+
+
+def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
+    """Shared per-tile constraint computation (expansion + matmul halves).
+    d: descriptor int32[5].  Returns (valid [tl,th], feasible, req1, req0
+    packed uint32 [tl,th])."""
+    ops = _pivot_tile_operands(
+        tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+    )
+    return _pivot_tile_from_operands(ops, tl, th)
 
 
 @functools.partial(jax.jit, static_argnames=("tl", "th"))
@@ -869,11 +889,13 @@ def _pivot_tile_solve(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tl", "th", "solve_rows", "tile_batch")
+    jax.jit,
+    static_argnames=("tl", "th", "solve_rows", "tile_batch", "pipeline"),
 )
 def lut5_pivot_stream(
     tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
-    w_tab, m_tab, seed, *, tl, th, solve_rows=64, tile_batch=1
+    w_tab, m_tab, seed, *, tl, th, solve_rows=64, tile_batch=1,
+    pipeline=False,
 ):
     """Whole-space 5-LUT search over pivot tiles [start_t, t_end) in one
     dispatch.
@@ -893,28 +915,54 @@ def lut5_pivot_stream(
     (the trailing tiles of a hit batch are re-swept on resume — only
     ever paid on the overflow path).  Selection is tile-order resolved,
     so non-randomized runs return bit-identical results for every T.
+
+    ``pipeline=True`` double-buffers tile operands (ROOFLINE.md lever 1):
+    the loop carries the NEXT round's int8 expansion (pure VPU/memory
+    work, independent of the current round's results) so the TPU
+    scheduler can overlap it with the current round's MXU matmuls and
+    solve epilogue.  One speculative expansion past the final round is
+    computed and discarded (descriptor index clamped).  Results are
+    bit-identical for either value — it is an A/B measurement lever, like
+    ``tile_batch``.
     """
     start_t = jnp.asarray(start_t, jnp.int32)
     t_end = jnp.asarray(t_end, jnp.int32)
     z = jnp.int32(0)
-    init = (z, start_t, z, z, z, z, z, z, z)
-
-    def cond(s):
-        return (s[0] == 0) & (s[1] < t_end)
+    t_clamp = jnp.int32(descs.shape[0] - 1)
 
     if tile_batch == 1:
-        def body(s):
-            t = s[1]
-            status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = _pivot_tile_step(
-                tables, lc1, lc0, hc, lowvalid, highvalid, descs[t],
-                w_tab, m_tab, seed ^ t, jnp.bool_(True), tl, th, solve_rows,
+        def operands(t):
+            return _pivot_tile_operands(
+                tables, lc1, lc0, hc, lowvalid, highvalid,
+                descs[jnp.minimum(t, t_clamp)], tl, th,
             )
-            return (status, t + 1, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b)
+
+        def round_result(t, ops):
+            valid_feas = _pivot_tile_from_operands(ops, tl, th)
+            feasible = valid_feas[1].reshape(-1) & (t < t_end)
+            req1, req0 = valid_feas[2], valid_feas[3]
+            d = descs[jnp.minimum(t, t_clamp)]
+
+            def solve_tile(_):
+                return _pivot_tile_solve(
+                    feasible, req1, req0, d, w_tab, m_tab, seed ^ t, th,
+                    solve_rows,
+                )
+
+            def skip_tile(_):
+                return (z,) * 8
+
+            outs = jax.lax.cond(feasible.any(), solve_tile, skip_tile, None)
+            return outs[0], t + 1, outs[1:]
     else:
+        batch_range = jnp.arange(tile_batch, dtype=jnp.int32)
         constrain = jax.vmap(
-            lambda d: _pivot_tile_constraints(
+            lambda d: _pivot_tile_operands(
                 tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
             )
+        )
+        from_ops = jax.vmap(
+            lambda ops: _pivot_tile_from_operands(ops, tl, th)
         )
         solve = jax.vmap(
             lambda feas, r1, r0, d, s_t: _pivot_tile_solve(
@@ -922,20 +970,22 @@ def lut5_pivot_stream(
             )
         )
 
-        def body(s):
-            t = s[1]
-            ts = t + jnp.arange(tile_batch, dtype=jnp.int32)
-            tc = jnp.minimum(ts, jnp.int32(descs.shape[0] - 1))
-            ds = descs[tc]
-            _, feas2d, req1, req0 = constrain(ds)
+        def operands(t):
+            ts = t + batch_range
+            return constrain(descs[jnp.minimum(ts, t_clamp)])
+
+        def round_result(t, ops):
+            ts = t + batch_range
+            ds = descs[jnp.minimum(ts, t_clamp)]
+            _, feas2d, req1, req0 = from_ops(ops)
             feas = feas2d.reshape(tile_batch, -1) & (ts < t_end)[:, None]
 
             def solve_batch(_):
                 return solve(feas, req1, req0, ds, seed ^ ts)
 
             def skip_batch(_):
-                z = jnp.zeros(tile_batch, jnp.int32)
-                return (z,) * 8
+                zv = jnp.zeros(tile_batch, jnp.int32)
+                return (zv,) * 8
 
             # Batch-level cond keeps the infeasible-skip (a vmapped cond
             # would become a select and pay the solve epilogue on every
@@ -946,17 +996,39 @@ def lut5_pivot_stream(
             hit_any = (statuses != 0).any()
             # First hit in tile order within the batch.
             chosen = jnp.argmax(statuses != 0).astype(jnp.int32)
-            pick = lambda x: x[chosen]
             nxt = jnp.where(hit_any, t + chosen + 1, t + tile_batch)
-            return (
-                pick(statuses), nxt, pick(outs[1]), pick(outs[2]),
-                pick(outs[3]), pick(outs[4]), pick(outs[5]),
-                pick(outs[6]), pick(outs[7]),
-            )
+            return statuses[chosen], nxt, tuple(x[chosen] for x in outs[1:])
 
-    status, t, m, lo_abs, hi_abs, sigma, fo, r1b, r0b = jax.lax.while_loop(
-        cond, body, init
-    )
+    if pipeline:
+        init = ((z, start_t, z, z, z, z, z, z, z), operands(start_t))
+
+        def cond(s):
+            return (s[0][0] == 0) & (s[0][1] < t_end)
+
+        def body(s):
+            t = s[0][1]
+            # Next round's expansion first: independent of this round's
+            # matmuls, so the scheduler is free to overlap the two.
+            nxt_ops = operands(t + tile_batch)
+            status, nxt, rest = round_result(t, s[1])
+            return ((status, nxt) + rest, nxt_ops)
+
+        final, _ = jax.lax.while_loop(cond, body, init)
+        status, t, m, lo_abs, hi_abs, sigma, fo, r1b, r0b = final
+    else:
+        init = (z, start_t, z, z, z, z, z, z, z)
+
+        def cond(s):
+            return (s[0] == 0) & (s[1] < t_end)
+
+        def body(s):
+            t = s[1]
+            status, nxt, rest = round_result(t, operands(t))
+            return (status, nxt) + rest
+
+        status, t, m, lo_abs, hi_abs, sigma, fo, r1b, r0b = (
+            jax.lax.while_loop(cond, body, init)
+        )
     return jnp.stack([status, m, lo_abs, hi_abs, sigma, fo, r1b, r0b, t])
 
 

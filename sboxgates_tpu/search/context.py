@@ -627,20 +627,31 @@ class SearchContext:
         """True when the whole recursion for this node runs in a native
         engine (Options.native_engine; same availability / multi-host
         agreement rules as the per-node native step).  Gate mode always
-        completes natively; LUT mode bails back to the Python engine for
-        nodes that need device sweeps.  Pivot-sized LUT nodes skip the
-        engine up front: their only native benefit is the head scan,
-        which the Python path runs natively anyway (_lut_step_native),
-        so entering the engine just duplicates that scan on the common
-        head-miss-then-bail outcome.  The predicate is exactly
-        node_host_only — the same routing that decides whether mux
-        threads are worthwhile.  Verbose LUT runs stay on the Python
-        engine: the reference's rank-tagged find lines
+        completes natively.  LUT-mode nodes that need device sweeps
+        (pivot-sized 5-LUT spaces, staged 7-LUT, solver overflows) run
+        natively too: the engine services them through a continuation
+        callback into the Python drivers and resumes in place, so no
+        exploration is ever discarded.  The one exception is a node with
+        device work while mux-concurrency threads are attached
+        (self.rdv): the serial engine would forfeit their overlap of
+        device round trips — the dominant win on network-attached chips
+        — so those stay on the Python recursion.  Verbose LUT runs stay
+        on the Python engine: the reference's rank-tagged find lines
         ("[   0] Found 5LUT: ...", lut.c:219-222) are printed by the
         Python decode paths the engine bypasses."""
         if self.opt.lut_graph and self.opt.verbosity >= 1:
             return False
-        return self.opt.native_engine and self.node_host_only(st)
+        if not self.opt.native_engine:
+            return False
+        if self.node_host_only(st):
+            return True
+        # Device-work LUT nodes: engine + continuation service, unless
+        # mux threads would overlap the dispatches better.
+        return (
+            self.opt.lut_graph
+            and self.rdv is None
+            and self.uses_native_step(st)
+        )
 
     def gate_engine_caller(self):
         if self._gate_engine_caller is None:

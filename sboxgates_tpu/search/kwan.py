@@ -55,6 +55,9 @@ def _create_circuit(
         ret = _native_lut_engine_search(ctx, st, target, mask, inbits)
         if ret is not None:
             return ret
+    # Node driven by the Python engine (vs stats["engine_nodes"]): the
+    # two counters give the engine-active node fraction of a run.
+    ctx.stats["python_nodes"] = ctx.stats.get("python_nodes", 0) + 1
 
     # Steps 1-4 in ONE fused device dispatch; budget gates are applied
     # host-side in the reference's order (sboxgates.c:301-435).  LUT mode
@@ -198,7 +201,59 @@ _ENGINE_STATS = {
     4: "lut5_candidates",
     5: "lut7_candidates",
     6: "lut7_solved",
+    7: "engine_devcalls",
 }
+
+
+class _EngineView:
+    """Read-only :class:`State` facade over the native engine's live
+    tables, for the device-work service: the search drivers it reuses
+    (lut5_search / lut7_search / lut5_resume_overflow) touch only
+    ``num_gates`` and ``live_tables()``."""
+
+    __slots__ = ("_tables", "num_gates")
+
+    def __init__(self, tables, g: int):
+        self._tables = tables
+        self.num_gates = g
+
+    def live_tables(self):
+        return self._tables
+
+
+def _lut_engine_service(ctx: SearchContext):
+    """Builds the engine's device-work continuation service (the Python
+    half of csrc's sbg_eng_devcb contract): each request runs the SAME
+    search driver the Python engine would at that node, so results stay
+    bit-identical with randomize off.  The engine blocks in the callback
+    (its C stack is the resumable state) and resumes in place."""
+    from . import lut as lutmod
+
+    def service(kind, tables, g, target, mask, inbits, arg0, rng, slot):
+        st = _EngineView(tables, g)
+        if kind == 1:  # pivot-sized space: full 5-LUT search
+            with ctx.prof.phase("lut5"):
+                res = lutmod.lut5_search(ctx, st, target, mask, inbits)
+        elif kind == 2:  # fused-head in-kernel solver overflow
+            res = lutmod.lut5_resume_overflow(
+                ctx, st, target, mask, inbits, arg0
+            )
+        elif kind == 3:  # staged 7-LUT
+            with ctx.prof.phase("lut7"):
+                res = lutmod.lut7_search(ctx, st, target, mask, inbits)
+            if res is None:
+                return None
+            return (
+                res["func_outer"], res["func_middle"], res["func_inner"],
+                *res["gates"],
+            )
+        else:
+            raise ValueError(f"unknown engine device-work kind {kind}")
+        if res is None:
+            return None
+        return (res["func_outer"], res["func_inner"], *res["gates"])
+
+    return service
 
 
 def _engine_replay(ctx, st: State, target, mask, out_gid, added, stats) -> int:
@@ -258,13 +313,25 @@ def _native_engine_search(
 def _native_lut_engine_search(
     ctx: SearchContext, st: State, target, mask, inbits: List[int]
 ):
-    """LUT-mode native engine run; returns the gate id (or NO_GATE), or
-    None when the engine bailed (a node needed device work) and the
-    caller must run the Python engine instead.  On bail the engine's
-    exploration and stats are discarded — the Python rerun recounts."""
+    """LUT-mode native engine run; device-work nodes (pivot-sized 5-LUT,
+    staged 7-LUT, solver overflow) are serviced through the continuation
+    callback (:func:`_lut_engine_service`) and the native recursion
+    resumes in place — no exploration is ever discarded.  Returns the
+    gate id (or NO_GATE), or None only when the service itself failed
+    (the engine bailed) and the caller must run the Python engine
+    instead."""
     import numpy as np
 
     eng = ctx.lut_engine_caller()
+    service = getattr(ctx, "_lut_engine_service_fn", None)
+    if service is None:
+        service = _lut_engine_service(ctx)
+        ctx._lut_engine_service_fn = service
+    # Snapshot the candidate counters: if a LATER devcall's service fails
+    # after earlier devcalls already ran Python drivers (which count into
+    # ctx.stats directly), the bail reruns the whole call through the
+    # Python engine and would double-count that serviced work.
+    stats_snapshot = dict(ctx.stats)
     with ctx.prof.phase("lut_engine_native"):
         out_gid, added, stats = eng(
             st.live_tables(),
@@ -279,8 +346,11 @@ def _native_lut_engine_search(
             list(inbits),
             ctx.opt.randomize,
             _engine_seed(ctx),
+            service=service,
         )
-    if added is None:  # BAILED: a node needed device work
+    if added is None:  # BAILED: the device-work service failed
+        ctx.stats.clear()
+        ctx.stats.update(stats_snapshot)
         return None
     return _engine_replay(ctx, st, target, mask, out_gid, added, stats)
 

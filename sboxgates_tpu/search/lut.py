@@ -233,6 +233,17 @@ def pivot_tile_batch() -> int:
     return max(1, int(os.environ.get("SBG_PIVOT_TILE_BATCH", "1")))
 
 
+def pivot_pipeline() -> bool:
+    """Double-buffer pivot tile operands (SBG_PIVOT_PIPELINE, default 1):
+    the stream loop carries the next round's int8 expansion so the TPU
+    scheduler can overlap that VPU/memory work with the current round's
+    MXU matmuls (ROOFLINE.md lever 1).  Bit-identical results either
+    way; set SBG_PIVOT_PIPELINE=0 for the A/B baseline."""
+    import os
+
+    return os.environ.get("SBG_PIVOT_PIPELINE", "1") != "0"
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
@@ -407,7 +418,7 @@ def _lut5_search_pivot(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
                 jw, jm, ctx.next_seed(), tl=tl, th=th,
-                tile_batch=pivot_tile_batch(),
+                tile_batch=pivot_tile_batch(), pipeline=pivot_pipeline(),
             )
         )
         status, next_t = int(v[0]), int(v[8])
@@ -553,6 +564,32 @@ def _lut5_chunk_two_phase(
         ctx, st, target, mask, cstart, feas, r1, r0, jw, jm,
         splits, w_tab, m_tab,
     )
+
+
+def lut5_resume_overflow(
+    ctx: SearchContext, st: State, target, mask, inbits, cstart: int
+) -> Optional[dict]:
+    """Resume a 5-LUT search after a fused-head in-kernel solver overflow
+    at chunk rank ``cstart``: re-drive that chunk through the two-phase
+    path, then resume the fused stream after it.  Shared by the Python
+    head path (:func:`lut_search_from_head` step 6) and the native
+    engine's device-work service (kwan._lut_engine_service kind 2)."""
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+    with ctx.prof.phase("lut5"):
+        res = _lut5_chunk_two_phase(
+            ctx, st, target, mask, inbits, cstart, jw, jm,
+            splits, w_tab, m_tab,
+        )
+        if res is None:
+            chunk = pick_chunk(
+                comb.n_choose_k(st.num_gates, 5), STREAM_CHUNK[5]
+            )
+            res = _lut5_stream_loop(
+                ctx, st, target, mask, inbits, cstart + chunk,
+                jw, jm, splits, w_tab, m_tab,
+            )
+    return res
 
 
 def _lut5_search_host(
@@ -878,19 +915,9 @@ def lut_search_from_head(
     elif step == 6:
         # In-kernel solver overflow: re-drive the flagged chunk through the
         # two-phase path, then resume the fused stream after it.
-        jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
-        cstart = int(head[1])
-        with ctx.prof.phase("lut5"):
-            res = _lut5_chunk_two_phase(
-                ctx, st, target, mask, inbits, cstart, jw, jm,
-                splits, w_tab, m_tab,
-            )
-            if res is None:
-                chunk = pick_chunk(comb.n_choose_k(g, 5), STREAM_CHUNK[5])
-                res = _lut5_stream_loop(
-                    ctx, st, target, mask, inbits, cstart + chunk,
-                    jw, jm, splits, w_tab, m_tab,
-                )
+        res = lut5_resume_overflow(
+            ctx, st, target, mask, inbits, int(head[1])
+        )
     elif not lut_head_has5(g):
         # The head skipped 5-LUT (pivot-sized space or g < 5): run the
         # full 5-LUT search separately.

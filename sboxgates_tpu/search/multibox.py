@@ -6,18 +6,24 @@ The reference searches one S-box per process invocation and applies one
 sweeping boxes or permutations means re-running the binary.  Here the
 sweep itself is the batch axis: every (box | permutation) x iteration
 attempt is an independent ``create_circuit`` job, and when batching is on
-their device sweeps rendezvous into vmapped dispatches
-(:mod:`sboxgates_tpu.search.batched`) — one device round trip per search
-round across the whole sweep instead of one per job.
+their DEVICE sweeps rendezvous into vmapped dispatches
+(:mod:`sboxgates_tpu.search.batched`) — device round trips merge across
+the wave.  That only pays when jobs actually dispatch: nodes the
+execution-placement policy routes to the native host engine (DES-class
+states) make no dispatches to merge, and there batching measures neutral
+to slightly negative (BENCH_DETAIL ``permute_sweep_des_s1_p64``: batched
+4.09 s vs serial 4.05 s) — hence the per-family defaults below.
 
 Execution modes:
 
-- ``batched=True`` (default off a mesh): all jobs of a round run
-  concurrently through :func:`run_batched_circuits`.  Jobs are
-  independent — no cross-job budget ratchet, the same semantics as the
-  reference run once per (box, permutation) in parallel processes.
-- ``batched=False`` (forced under a mesh, where GSPMD owns the devices):
-  jobs run serially in job order.
+- ``batched=True`` (default off a mesh for multi-box runs; measured
+  1.16x on the 8-box DES batch): all jobs of a round run concurrently
+  through :func:`run_batched_circuits`.  Jobs are independent — no
+  cross-job budget ratchet, the same semantics as the reference run once
+  per (box, permutation) in parallel processes.
+- ``batched=False`` (forced under a mesh, where GSPMD owns the devices;
+  the measured default for permutation sweeps — see
+  :func:`permute_sweep_jobs`): jobs run serially in job order.
 
 Both modes fold results through the same per-box :class:`BeamFold`, so
 the kept states are identical given identical per-job outcomes.
@@ -41,7 +47,11 @@ from .orchestrator import BeamFold, make_targets, sbox_num_outputs
 
 @dataclass
 class BoxJob:
-    """One S-box (or one permutation of one) in a batched sweep."""
+    """One S-box (or one permutation of one) in a batched sweep.
+
+    ``prefer_serial`` marks job families whose measured default is the
+    serial loop (see :func:`permute_sweep_jobs`); ``batched=None`` then
+    resolves to serial for the whole sweep."""
 
     name: str
     sbox: np.ndarray  # uint8[256]
@@ -50,6 +60,7 @@ class BoxJob:
     n_out: int = 0
     beam: Optional[BeamFold] = None
     done: bool = False
+    prefer_serial: bool = False
 
     def __post_init__(self):
         if not self.targets:
@@ -114,9 +125,18 @@ def _run_jobs(
     return out
 
 
-def _auto_batched(ctx: SearchContext, batched: Optional[bool]) -> bool:
+def _auto_batched(
+    ctx: SearchContext,
+    batched: Optional[bool],
+    boxes: Sequence[BoxJob] = (),
+) -> bool:
+    """Resolves ``batched=None``: serial under a mesh (GSPMD owns the
+    devices) or when the job family's measured default is serial
+    (BoxJob.prefer_serial — see permute_sweep_jobs); batched otherwise."""
     if batched is None:
-        return ctx.mesh_plan is None
+        if ctx.mesh_plan is not None:
+            return False
+        return not any(b.prefer_serial for b in boxes)
     if batched and ctx.mesh_plan is not None:
         raise ValueError(
             "batched multi-box execution is host-threaded and cannot run "
@@ -151,7 +171,7 @@ def search_boxes_one_output(
     budget ratchet between a box's iterations) — parallel-restart
     semantics, reference-equivalent to one process per attempt.
     """
-    batched = _auto_batched(ctx, batched)
+    batched = _auto_batched(ctx, batched, boxes)
     r = ctx.opt.iterations
     jobs, meta = [], []
     for box in boxes:
@@ -206,7 +226,7 @@ def search_boxes_all_outputs(
     single-box driver, sboxgates.c:701-788).  Boxes whose graphs complete
     drop out of later rounds.  Returns {box.name: final beam states}.
     """
-    batched = _auto_batched(ctx, batched)
+    batched = _auto_batched(ctx, batched, boxes)
     opt = ctx.opt
     beams = {box.name: [State.init_inputs(box.num_inputs)] for box in boxes}
     final: dict = {box.name: [] for box in boxes}
@@ -288,8 +308,20 @@ def load_box_jobs(paths: Sequence[str], permute: int = 0) -> List[BoxJob]:
 def permute_sweep_jobs(sbox: np.ndarray, num_inputs: int) -> List[BoxJob]:
     """One BoxJob per input permutation (all 2^n), named ``pXX`` (hex).
     The driver-level analog of re-running the reference once per
-    ``--permute`` value."""
+    ``--permute`` value.
+
+    Defaults to the serial loop (``prefer_serial``): measured on the
+    bench host, the 64-permutation DES S1 sweep is not helped by
+    batching (BENCH_DETAIL permute_sweep_des_s1_p64: batched 4.09 s vs
+    serial 4.05 s) — DES-class nodes route to the native host engine,
+    so a 64-job wave has no device round trips to merge and its threads
+    only contend.  Pass ``batched=True`` to the search driver to force
+    batching (e.g. for boxes big enough that nodes dispatch to the
+    device)."""
     return [
-        BoxJob(f"p{p:02x}", permuted_box(sbox, num_inputs, p), num_inputs)
+        BoxJob(
+            f"p{p:02x}", permuted_box(sbox, num_inputs, p), num_inputs,
+            prefer_serial=True,
+        )
         for p in range(1 << num_inputs)
     ]

@@ -143,12 +143,29 @@ int main(void) {
 
 
 def test_emitted_cuda_format(fa_lut_circuit):
+    """The reference's CI compiles its emitted CUDA with nvcc
+    (.travis.yml:49-51); no nvcc exists in this image, so by default
+    this asserts the CUDA-specific constructs instead — a toolchain
+    limitation, not a policy: when nvcc IS present, the emitted source
+    is nvcc-compiled too."""
     st, _, _ = fa_lut_circuit
     src = c_function_text(st)
     assert src.startswith("#define LUT(a,b,c,d,e)")
     assert "lop3.b32" in src
     assert "__device__ __forceinline__" in src
     assert "typedef int bit_t;" in src
+    import shutil
+
+    if shutil.which("nvcc"):
+        with tempfile.TemporaryDirectory() as tmp:
+            cu = os.path.join(tmp, "s.cu")
+            with open(cu, "w") as f:
+                f.write(src + "\n")
+            subprocess.run(
+                ["nvcc", "-c", "-o", os.path.join(tmp, "s.o"), cu],
+                check=True,
+                capture_output=True,
+            )
 
 
 def test_multi_output_signature():

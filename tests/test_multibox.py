@@ -37,6 +37,23 @@ def _assert_realizes(box, st, output):
     ), f"{box.name} output {output} not realized"
 
 
+def test_auto_batched_defaults():
+    """batched=None resolution: multi-box sweeps batch, permutation
+    sweeps run the serial loop (the measured default —
+    permute_sweep_des_s1_p64: host-routed jobs have no dispatches to
+    merge), and an explicit batched=True overrides it."""
+    from sboxgates_tpu.search.multibox import _auto_batched
+
+    sbox, n = load_sbox(os.path.join(SBOXES, "des_s1.txt"))
+    ctx = SearchContext(Options(seed=1))
+    multi = _boxes(["des_s1", "des_s2"])
+    sweep = permute_sweep_jobs(sbox, n)
+    assert _auto_batched(ctx, None, multi) is True
+    assert _auto_batched(ctx, None, sweep) is False
+    assert _auto_batched(ctx, True, sweep) is True
+    assert _auto_batched(ctx, False, multi) is False
+
+
 def test_permuted_box_is_input_xor():
     sbox, n = load_sbox(os.path.join(SBOXES, "des_s1.txt"))
     p = 0b101101

@@ -149,6 +149,32 @@ def test_lut5_search_cpu_finds_planted_decomposition():
     assert bool(tt.eq_mask(got, target, mask))
 
 
+def test_lut5_search_cpu_mt_matches_serial():
+    """The threaded CPU driver (the measured-socket baseline,
+    sbg_lut5_search_cpu_mt) must return the global first hit in combo
+    order — identical index and decomposition to the serial scan — for
+    every thread count, including counts that don't divide the space."""
+    st = State.init_inputs(8)
+    rng = np.random.default_rng(7)
+    while st.num_gates < 12:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x6B, st.table(2), st.table(5), st.table(7))
+    target = tt.eval_lut(0x9C, outer, st.table(3), st.table(9))
+    mask = tt.mask_table(8)
+    combos = comb.CombinationStream(st.num_gates, 5).next_chunk(1 << 12)
+    args = (
+        native.tables32_to_64(st.live_tables()),
+        native.tables32_to_64(target),
+        native.tables32_to_64(mask),
+        combos,
+    )
+    base = native.lut5_search_cpu(*args)
+    assert base[0] >= 0
+    for threads in (1, 2, 3, 8):
+        assert native.lut5_search_cpu_mt(*args, threads) == base, threads
+
+
 def test_lut5_search_cpu_no_false_positives():
     with open("sboxes/rijndael.txt") as f:
         sbox, n = parse_sbox(f.read())
@@ -726,10 +752,70 @@ def test_lut_engine_matches_python_engine():
         assert res[True] == res[False], (box, bit, kw)
 
 
-def test_lut_engine_bails_to_python_on_pivot_states():
-    """A pivot-sized state makes the LUT engine bail; the Python engine
-    then finds (and verifies) the planted decomposition — no behavior is
-    lost, only the native shortcut."""
+def _run_lut_engine_case(build, engine: bool, **kw):
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    st, target, mask = build()
+    ctx = SearchContext(
+        Options(
+            seed=2, lut_graph=True, randomize=False, native_engine=engine,
+            **kw,
+        )
+    )
+    out = create_circuit(ctx, st, target, mask, [])
+    assert out != 0xFFFF
+    st.verify_gate(out, target, mask)
+    gates = [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
+    return out, gates, ctx
+
+
+def test_lut_engine_continuation_services_pivot_states():
+    """A pivot-sized state keeps the engine active: the device-work
+    continuation services the pivot 5-LUT sweep and the native recursion
+    resumes — bit-identical result to the Python engine, zero
+    Python-driven nodes, no discarded exploration (round-3 bailed the
+    whole call here and reran everything in Python)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5
+
+    out_e, gates_e, ctx_e = _run_lut_engine_case(build_planted_lut5, True)
+    out_p, gates_p, ctx_p = _run_lut_engine_case(build_planted_lut5, False)
+    assert (out_e, gates_e) == (out_p, gates_p)
+    # The service ran the pivot sweep (counting its candidate space) and
+    # the engine, not the Python recursion, drove every node.
+    assert ctx_e.stats["engine_devcalls"] >= 1
+    assert ctx_e.stats["lut5_candidates"] == ctx_p.stats["lut5_candidates"] > 0
+    assert ctx_e.stats.get("python_nodes", 0) == 0
+    assert ctx_e.stats["engine_nodes"] >= 1
+
+
+def test_lut_engine_continuation_services_staged_lut7():
+    """A state whose 7-LUT space exceeds the single-chunk limit routes
+    the staged search through the continuation service; the engine
+    materializes the serviced decomposition bit-identically to the
+    Python engine's."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut7
+
+    out_e, gates_e, ctx_e = _run_lut_engine_case(build_planted_lut7, True)
+    out_p, gates_p, ctx_p = _run_lut_engine_case(build_planted_lut7, False)
+    assert (out_e, gates_e) == (out_p, gates_p)
+    assert ctx_e.stats["engine_devcalls"] >= 1
+    assert ctx_e.stats["lut7_candidates"] == ctx_p.stats["lut7_candidates"] > 0
+    assert ctx_e.stats["lut7_solved"] == ctx_p.stats["lut7_solved"] > 0
+    assert ctx_e.stats.get("python_nodes", 0) == 0
+
+
+def test_lut_engine_bails_to_python_on_service_failure():
+    """A broken device-work service degrades to the round-3 design: the
+    engine bails and the Python engine finds (and verifies) the planted
+    decomposition — robustness, not correctness, depends on the
+    service."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))
@@ -740,12 +826,16 @@ def test_lut_engine_bails_to_python_on_pivot_states():
 
     st, target, mask = build_planted_lut5()
     ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+
+    def broken_service(*args):
+        raise RuntimeError("simulated device failure")
+
+    ctx._lut_engine_service_fn = broken_service
     out = create_circuit(ctx, st, target, mask, [])
     assert out != 0xFFFF
     st.verify_gate(out, target, mask)
-    # The engine ran (and bailed) without contributing stats; the Python
-    # path's pivot sweep counted the 5-LUT space.
     assert ctx.stats["lut5_candidates"] > 0
+    assert ctx.stats.get("python_nodes", 0) >= 1
 
 
 def test_lut_engine_randomized_valid_and_deterministic():

@@ -259,9 +259,18 @@ def test_pivot_tile_batch_parity(monkeypatch):
         miss = lut5_search(ctx, st, miss_target, mask, [])
         return hit, miss
 
+    monkeypatch.setenv("SBG_PIVOT_PIPELINE", "0")
     base_hit, base_miss = run()
     assert base_hit is not None and base_miss is None
     monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "2")
     b2_hit, b2_miss = run()
     assert base_hit == b2_hit
     assert b2_miss is None
+    # The double-buffer lever (SBG_PIVOT_PIPELINE) must be bit-identical
+    # too — alone and combined with tile batching.
+    monkeypatch.setenv("SBG_PIVOT_PIPELINE", "1")
+    pb_hit, pb_miss = run()
+    assert base_hit == pb_hit and pb_miss is None
+    monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "1")
+    p_hit, p_miss = run()
+    assert base_hit == p_hit and p_miss is None
