@@ -109,6 +109,35 @@ def test_lut5_pivot_sharded_equals_single():
     assert verify_lut5_result(st, target, mask, res1)
 
 
+def test_engine_continuation_under_mesh_matches_unmeshed():
+    """Under a local 8-device mesh the native engine drives pivot-sized
+    LUT nodes too (uses_native_engine: no rendezvous under a mesh), with
+    the continuation service dispatching the SHARDED pivot stream.  The
+    full create_circuit result must equal the unmeshed engine run's, the
+    engine must stay active (no Python nodes), and the service must have
+    been exercised."""
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    results = {}
+    for plan in (None, MeshPlan(make_mesh())):
+        st, target, mask = build_planted_lut5()
+        ctx = SearchContext(
+            Options(seed=3, lut_graph=True, randomize=False),
+            mesh_plan=plan,
+        )
+        out = create_circuit(ctx, st, target, mask, [])
+        assert out != NO_GATE
+        st.verify_gate(out, target, mask)
+        assert ctx.stats["engine_devcalls"] >= 1
+        assert ctx.stats.get("python_nodes", 0) == 0
+        results[plan is None] = (
+            out, [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
+        )
+    assert results[True] == results[False]
+
+
 def test_restart_batched_filter():
     from sboxgates_tpu.parallel.mesh import restart_batched_filter
 
