@@ -133,25 +133,39 @@ def bench_pivot_tile_batch() -> dict:
     jw, jm = jnp.asarray(w_tab), jnp.asarray(m_tab)
     space = math.comb(g, 5)
 
-    def sweep(tb, pl):
+    def sweep(tb, pl, backend):
         v = np.asarray(
             sweeps.lut5_pivot_stream(
                 *ops.stream_args(), 0, ops.t_real, jw, jm, 1,
-                tl=tl, th=th, tile_batch=tb, pipeline=pl,
+                tl=tl, th=th, tile_batch=tb, pipeline=pl, backend=backend,
             )
         )
         assert int(v[0]) == 0, "unexpected hit in bench state"
 
     out = {"metric": "pivot_tile_batch_ab", "unit": "cand/s",
            "state_g": g}
-    variants = [(1, False), (1, True), (2, False), (2, True),
-                (4, False), (4, True)]
-    for tb, pl in variants:
-        sweep(tb, pl)  # compile/warm
+    variants = [
+        (1, False, "xla"), (1, True, "xla"), (2, False, "xla"),
+        (2, True, "xla"), (4, False, "xla"), (4, True, "xla"),
+        (1, False, "pallas"), (1, True, "pallas"),
+    ]
+    warmed = []
+    for v in variants:
+        # A variant whose backend fails to lower (e.g. the pallas kernel
+        # on an unsupported toolchain) drops out of the A/B instead of
+        # killing the whole entry.
+        try:
+            sweep(*v)  # compile/warm
+            warmed.append(v)
+        except Exception as e:
+            key = f"t{v[0]}{'p' if v[1] else ''}"
+            key += "_pallas" if v[2] == "pallas" else ""
+            out[f"{key}_error"] = repr(e)[:300]
+    variants = warmed
 
-    def one(tb, pl):
+    def one(tb, pl, backend):
         t0 = time.perf_counter()
-        sweep(tb, pl)
+        sweep(tb, pl, backend)
         return space / (time.perf_counter() - t0)
 
     # Round-robin the reps across variants so throttle drift hits all
@@ -162,14 +176,16 @@ def bench_pivot_tile_batch() -> dict:
         for v in variants:
             rates[v].append(one(*v))
     best = None
-    for tb, pl in variants:
-        vals = sorted(rates[(tb, pl)])
+    for v in variants:
+        tb, pl, backend = v
+        vals = sorted(rates[v])
         key = f"t{tb}p" if pl else f"t{tb}"
+        key += "_pallas" if backend == "pallas" else ""
         out[key] = vals[len(vals) // 2]
         out[f"{key}_spread"] = [vals[0], vals[-1]]
         if best is None or out[key] > out[best]:
             best = key
-    out["value"] = out["t1"]
+    out["value"] = out.get("t1")
     out["best"] = out[best]
     out["best_variant"] = best
     return out

@@ -1,0 +1,121 @@
+"""Pallas TPU kernel for the pivot 5-LUT constraint sweep.
+
+The XLA formulation of one pivot tile (``sweeps._pivot_tile_constraints``)
+materializes its int8 matmul operands (~2.5 MB) AND the two int32
+count matrices (2 x 32 MB for a 512 x 512 tile) through HBM before the
+epilogue packs them down to two uint32[tl, th] constraint words — at
+~800 GB/s that HBM round trip costs an order of magnitude more time
+than the 4.3e9 int8 MACs themselves, which is where most of the MFU gap
+in ROOFLINE.md lives.  This kernel fuses the whole per-tile pipeline in
+VMEM blocks:
+
+- unpack the PACKED uint32 cell masks to int8 lanes in-kernel (the
+  expanded operands never touch HBM);
+- run the two ``[2*4*BL, 256] x [256, 4*BH]`` int8 MXU matmuls per
+  block;
+- apply the ``> 0`` test and the disjoint-cell-bit packing in-register;
+- write ONLY the packed uint32 constraint words (1 MB per 512 x 512
+  tile instead of ~66 MB of intermediates).
+
+Feasibility needs no separate output: a candidate conflicts exactly
+when some cell requires both values, i.e. ``(req1 & req0) != 0``.
+
+Bit-identical to the XLA path by construction (same operand order, same
+cell-bit layout — ``sweeps._PIVOT_CELLBITS``); parity is enforced by
+``tests/test_sweeps.py`` in interpreter mode, and the backend is an A/B
+lever (``SBG_PIVOT_BACKEND=pallas``) measured by
+``bench.bench_pivot_tile_batch`` on silicon.  The reference's
+counterpart for "the hot loop in native code" is its per-rank C sweep
+(lut.c:116-249); here the hot loop is a TPU kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# Default VMEM block: (64 lows x 128 highs) keeps the per-block int32
+# count matrices at 2 x 1 MB plus ~0.5 MB of operands — well under the
+# ~16 MB/core VMEM budget including pipeline double-buffering.
+BLOCK_LOW = 64
+BLOCK_HIGH = 128
+
+
+def _unpack_bits_i8(x):
+    """[..., W] uint32 -> [..., W*32] int8 of 0/1 bits (LSB-first); the
+    in-kernel twin of sweeps._expand_bits_i8."""
+    b = (x[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    return b.astype(jnp.int8).reshape(x.shape[:-1] + (x.shape[-1] * 32,))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tl", "th", "bl", "bh", "interpret")
+)
+def pivot_constraints_pallas(
+    l1, l0, hcs, pmsel, *, tl, th, bl=BLOCK_LOW, bh=BLOCK_HIGH,
+    interpret=False,
+):
+    """Packed cell constraints for one pivot tile on the MXU, fused.
+
+    ``l1``/``l0``: uint32[4, tl, 8] low-pair required-1/required-0 cell
+    masks (already sliced to the tile); ``hcs``: uint32[4, th, 8] high
+    cells; ``pmsel``: int8[2, 256] pivot polarity selectors.  Returns
+    (req1, req0) uint32[tl, th] — identical bits to the XLA
+    ``_pivot_tile_from_operands`` packing.
+    """
+    from jax.experimental import pallas as pl
+
+    assert tl % bl == 0 and th % bh == 0, (tl, th, bl, bh)
+
+    def kernel(l1_ref, l0_ref, hc_ref, pm_ref, r1_ref, r0_ref):
+        pm = pm_ref[:]                       # [2, 256] i8
+        hb = _unpack_bits_i8(hc_ref[:])      # [4, bh, 256] i8
+        rhs = hb.reshape(4 * bh, 256).T      # [256, 4*bh]
+        # (s, j, c2) -> packed cell bit (j << 3) | (s << 2) | c2, the
+        # shared 32-cell key order (sweeps._PIVOT_CELLBITS) — built with
+        # iotas because pallas kernels cannot capture array constants.
+        shp = (2, 4, 1, 4, 1)
+        s_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 0)
+        j_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 1)
+        c_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 3)
+        sh = (j_i << 3) | (s_i << 2) | c_i
+        dn = (((1,), (0,)), ((), ()))
+
+        def packed(lref):
+            lb = _unpack_bits_i8(lref[:])    # [4, bl, 256] i8
+            lhs = (lb[None] * pm[:, None, None, :]).reshape(2 * 4 * bl, 256)
+            c = jax.lax.dot_general(
+                lhs, rhs, dn, preferred_element_type=jnp.int32
+            ).reshape(2, 4, bl, 4, bh)
+            bits = (c > 0).astype(jnp.uint32)
+            # cell bits are disjoint: the sum over the 32 (s, j, c2)
+            # terms is exactly the bitwise OR
+            return (bits << sh).sum(axis=(0, 1, 3)).astype(jnp.uint32)
+
+        r1_ref[:] = packed(l1_ref)
+        r0_ref[:] = packed(l0_ref)
+
+    grid = (tl // bl, th // bh)
+    req1, req0 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((4, bl, 8), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((4, bl, 8), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((4, bh, 8), lambda i, j: (0, j, 0)),
+            pl.BlockSpec((2, 256), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bl, bh), lambda i, j: (i, j)),
+            pl.BlockSpec((bl, bh), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tl, th), jnp.uint32),
+            jax.ShapeDtypeStruct((tl, th), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(l1, l0, hcs, pmsel)
+    return req1, req0

@@ -764,14 +764,7 @@ def _pivot_tile_operands(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
     lhs1 = (l1b[None] * pmsel[:, None, None, :]).reshape(2 * 4 * tl, 256)
     lhs0 = (l0b[None] * pmsel[:, None, None, :]).reshape(2 * 4 * tl, 256)
     rhs = hb.reshape(4 * th, 256).T              # [256, 4*th]
-    lv = ((lo0 + jnp.arange(tl, dtype=jnp.int32)) < lo_end) & (
-        jax.lax.dynamic_slice(lowvalid, (lo0,), (tl,))
-    )
-    hv = ((hi0 + jnp.arange(th, dtype=jnp.int32)) < hi_end) & (
-        jax.lax.dynamic_slice(highvalid, (hi0,), (th,))
-    )
-    valid = lv[:, None] & hv[None, :]
-    return lhs1, lhs0, rhs, valid
+    return lhs1, lhs0, rhs, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
 
 
 def _pivot_tile_from_operands(ops, tl, th):
@@ -813,6 +806,50 @@ def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
         tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
     )
     return _pivot_tile_from_operands(ops, tl, th)
+
+
+def _pivot_tile_valid(lowvalid, highvalid, d, tl, th):
+    """The tile's validity mask (boundary + exclusion rows), shared by
+    both backends."""
+    lo0, lo_end, hi0, hi_end = d[1], d[2], d[3], d[4]
+    lv = ((lo0 + jnp.arange(tl, dtype=jnp.int32)) < lo_end) & (
+        jax.lax.dynamic_slice(lowvalid, (lo0,), (tl,))
+    )
+    hv = ((hi0 + jnp.arange(th, dtype=jnp.int32)) < hi_end) & (
+        jax.lax.dynamic_slice(highvalid, (hi0,), (th,))
+    )
+    return lv[:, None] & hv[None, :]
+
+
+def _pivot_tile_packed_operands(
+    tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+):
+    """Pallas-backend operand half: only the PACKED uint32 slices and the
+    pivot polarity selectors leave this stage — the int8 expansion
+    happens inside the kernel's VMEM blocks (pallas_pivot module doc)."""
+    m, lo0, hi0 = d[0], d[1], d[3]
+    l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
+    l0 = jax.lax.dynamic_slice(lc0, (0, lo0, 0), (4, tl, lc0.shape[2]))
+    hcs = jax.lax.dynamic_slice(hc, (0, hi0, 0), (4, th, hc.shape[2]))
+    pmb = _expand_bits_i8(tables[m])
+    pmsel = jnp.stack([1 - pmb, pmb])
+    return l1, l0, hcs, pmsel, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
+
+
+def _pivot_tile_from_packed(ops, tl, th):
+    """Pallas-backend matmul half: the fused VMEM kernel; bit-identical
+    constraint words to _pivot_tile_from_operands (parity-tested)."""
+    import jax as _jax
+
+    from .pallas_pivot import pivot_constraints_pallas
+
+    l1, l0, hcs, pmsel, valid = ops
+    req1, req0 = pivot_constraints_pallas(
+        l1, l0, hcs, pmsel, tl=tl, th=th,
+        interpret=_jax.default_backend() == "cpu",
+    )
+    conflict = (req1 & req0) != 0
+    return valid, valid & ~conflict, req1, req0
 
 
 @functools.partial(jax.jit, static_argnames=("tl", "th"))
@@ -890,12 +927,14 @@ def _pivot_tile_solve(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("tl", "th", "solve_rows", "tile_batch", "pipeline"),
+    static_argnames=(
+        "tl", "th", "solve_rows", "tile_batch", "pipeline", "backend"
+    ),
 )
 def lut5_pivot_stream(
     tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
     w_tab, m_tab, seed, *, tl, th, solve_rows=64, tile_batch=1,
-    pipeline=False,
+    pipeline=False, backend="xla",
 ):
     """Whole-space 5-LUT search over pivot tiles [start_t, t_end) in one
     dispatch.
@@ -924,21 +963,40 @@ def lut5_pivot_stream(
     computed and discarded (descriptor index clamped).  Results are
     bit-identical for either value — it is an A/B measurement lever, like
     ``tile_batch``.
+
+    ``backend="pallas"`` runs each tile's constraint computation as the
+    fused VMEM kernel (ops/pallas_pivot.py) instead of the XLA
+    expansion + matmul + pack pipeline — same bits, radically less HBM
+    traffic per tile.  Composes with ``pipeline`` (the carried operands
+    are then just the packed slices), not with ``tile_batch``.
     """
     start_t = jnp.asarray(start_t, jnp.int32)
     t_end = jnp.asarray(t_end, jnp.int32)
     z = jnp.int32(0)
     t_clamp = jnp.int32(descs.shape[0] - 1)
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown pivot backend {backend!r}")
+    if backend == "pallas" and tile_batch != 1:
+        raise ValueError("backend='pallas' requires tile_batch=1")
 
     if tile_batch == 1:
+        tile_operands = (
+            _pivot_tile_packed_operands if backend == "pallas"
+            else _pivot_tile_operands
+        )
+        tile_from_ops = (
+            _pivot_tile_from_packed if backend == "pallas"
+            else _pivot_tile_from_operands
+        )
+
         def operands(t):
-            return _pivot_tile_operands(
+            return tile_operands(
                 tables, lc1, lc0, hc, lowvalid, highvalid,
                 descs[jnp.minimum(t, t_clamp)], tl, th,
             )
 
         def round_result(t, ops):
-            valid_feas = _pivot_tile_from_operands(ops, tl, th)
+            valid_feas = tile_from_ops(ops, tl, th)
             feasible = valid_feas[1].reshape(-1) & (t < t_end)
             req1, req0 = valid_feas[2], valid_feas[3]
             d = descs[jnp.minimum(t, t_clamp)]

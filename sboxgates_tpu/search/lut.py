@@ -244,6 +244,18 @@ def pivot_pipeline() -> bool:
     return os.environ.get("SBG_PIVOT_PIPELINE", "1") != "0"
 
 
+def pivot_backend() -> str:
+    """Pivot tile constraint backend (SBG_PIVOT_BACKEND, default xla):
+    ``pallas`` fuses unpack + matmul + constraint packing in VMEM blocks
+    (ops/pallas_pivot.py) so the per-tile int32 count matrices never
+    round-trip HBM.  Bit-identical results (parity-tested); defaults to
+    the measured xla path until the pallas kernel's on-chip A/B
+    (bench_pivot_tile_batch) lands.  Forces tile_batch=1."""
+    import os
+
+    return os.environ.get("SBG_PIVOT_BACKEND", "xla")
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
@@ -414,11 +426,13 @@ def _lut5_search_pivot(
             start_t = next_t
             continue
 
+        backend = pivot_backend()
         v = np.asarray(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
                 jw, jm, ctx.next_seed(), tl=tl, th=th,
-                tile_batch=pivot_tile_batch(), pipeline=pivot_pipeline(),
+                tile_batch=1 if backend == "pallas" else pivot_tile_batch(),
+                pipeline=pivot_pipeline(), backend=backend,
             )
         )
         status, next_t = int(v[0]), int(v[8])

@@ -1,5 +1,6 @@
 """Device sweep kernels vs. brute-force numpy oracles."""
 
+import os
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -489,6 +490,47 @@ def test_pivot_search_finds_planted_decomposition(rng):
     )
     assert bool(tt.eq_mask(got, target, mask))
     assert ctx.stats["lut5_candidates"] > 0
+
+
+def test_pivot_pallas_backend_bit_identical():
+    """The fused Pallas pivot kernel (ops/pallas_pivot.py, interpreter
+    mode here) must produce the byte-identical stream verdict as the XLA
+    backend — hits, constraint words, and resume tile — alone and
+    composed with the pipeline lever, plus at the small-G tile shape."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    import jax.numpy as jnp
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.lut import PivotOperands, pivot_tile_shape
+
+    st, target, mask = build_planted_lut5()
+    g = st.num_gates
+    for tl, th in (pivot_tile_shape(g), (256, 512)):
+        ctx = SearchContext(Options(seed=1, lut_graph=True, randomize=False))
+        dev_tables, _ = ctx.device_tables(st)
+        ops = PivotOperands(
+            g, tl, th, [], dev_tables, target, mask, ctx.place_replicated
+        )
+        _, w_tab, m_tab = sweeps.lut5_split_tables()
+        jw, jm = jnp.asarray(w_tab), jnp.asarray(m_tab)
+        args = ops.stream_args()
+        base = np.asarray(
+            sweeps.lut5_pivot_stream(
+                *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th
+            )
+        )
+        for pipeline in (False, True):
+            got = np.asarray(
+                sweeps.lut5_pivot_stream(
+                    *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
+                    backend="pallas", pipeline=pipeline,
+                )
+            )
+            assert (base == got).all(), (tl, th, pipeline, base, got)
+        assert int(base[0]) == 1  # the planted decomposition was found
 
 
 def test_pivot_search_respects_exclusions(rng):
