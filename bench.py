@@ -38,9 +38,14 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 import numpy as np
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-G_HEAD = 200    # headline state size: C(200,5) = 2,535,650,040
-CPU_COMBOS = 1 << 16
-REPEATS = 3
+# SBG_BENCH_SMOKE=1: a CPU-sized dry run of the FULL main bench path
+# (every entry, shrunk problem sizes, results to BENCH_SMOKE.json) so a
+# code change can be validated end to end before the one shot at real
+# silicon.  Never used for recorded numbers.
+SMOKE = bool(os.environ.get("SBG_BENCH_SMOKE"))
+G_HEAD = 60 if SMOKE else 200  # headline: C(200,5) = 2,535,650,040
+CPU_COMBOS = 1 << 12 if SMOKE else 1 << 16
+REPEATS = 2 if SMOKE else 3
 # The reference is always run with many MPI ranks (.travis.yml:40-48); a
 # modern 2-socket node commonly exposes 64+ cores.  vs_baseline is
 # per-core (the honest unit we can measure on this 1-core host); the
@@ -469,7 +474,7 @@ def bench_mesh_scaling() -> dict:
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
-def bench_lut5_g500_slice(n_tiles=1500) -> dict:
+def bench_lut5_g500_slice(n_tiles=8 if SMOKE else 1500) -> dict:
     """Pivot-stream slice at the reference's MAX_GATES=500 scale: sweeps
     `n_tiles` mid-range tiles of the C(500,5)=2.55e11 space and reports the
     real-candidate rate (full-space sweeps take ~1.5 min/call)."""
@@ -682,7 +687,7 @@ def bench_lut7() -> dict:
     from sboxgates_tpu.search import Options, SearchContext
     from sboxgates_tpu.search.context import LUT7_SOLVE_CHUNK
 
-    st, target, mask = build_state(60)  # C(60,7) = 386M
+    st, target, mask = build_state(40 if SMOKE else 60)  # C(60,7) = 386M
     ctx = SearchContext(Options(seed=1, lut_graph=True))
     prebuilt = ctx.stream_args(st, target, mask, [], 7)
     ctx.feasible_stream_driver(st, target, mask, [], k=7, prebuilt=prebuilt)
@@ -1093,7 +1098,7 @@ def bench_batch_axis_pivot() -> dict:
     from sboxgates_tpu.search.batched import run_batched_circuits
     from sboxgates_tpu.search.kwan import create_circuit
 
-    g = 140
+    g = 60 if SMOKE else 140
     st, target, mask = build_state(g)
 
     def make_jobs():
@@ -1402,7 +1407,17 @@ def main() -> None:
         _gather_bench_worker(int(sys.argv[i + 1]), sys.argv[i + 2])
         return
 
-    why_dead = _backend_alive()
+    if SMOKE:
+        # CPU dry run of the full main path: pin the CPU backend (env
+        # alone is not enough — the axon sitecustomize re-forces the
+        # tunnel platform at interpreter start) and skip the probe.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        why_dead = None
+    else:
+        why_dead = _backend_alive()
     if why_dead is not None:
         # Still record what needs no accelerator — the pure-native CPU
         # baseline and the backend-independent gate-mode config (every
@@ -1468,11 +1483,13 @@ def main() -> None:
         # keeps everything captured so far WITHOUT clobbering the last
         # complete BENCH_DETAIL.json; the real file is written (and the
         # partial removed) only when the whole run finishes.
-        partial = os.path.join(HERE, "BENCH_DETAIL.partial.json")
+        # Smoke runs must never clobber the real on-chip capture.
+        name = "BENCH_SMOKE" if SMOKE else "BENCH_DETAIL"
+        partial = os.path.join(HERE, f"{name}.partial.json")
         with open(partial, "w") as f:
             json.dump(detail, f, indent=1)
         if final:
-            os.replace(partial, os.path.join(HERE, "BENCH_DETAIL.json"))
+            os.replace(partial, os.path.join(HERE, f"{name}.json"))
 
     def run(fn, *a, **k):
         t0 = time.perf_counter()
@@ -1514,8 +1531,11 @@ def main() -> None:
     run(bench_permute_sweep)
     run(bench_pallas_exec, best)
     run(bench_pallas_deep)
-    run(bench_mesh_scaling)
-    run(bench_gather_compaction)
+    if not SMOKE:
+        # Already-validated CPU-subprocess entries (~30 min); the smoke
+        # run's job is the chip-path code above.
+        run(bench_mesh_scaling)
+        run(bench_gather_compaction)
     flush(final=True)
 
     dev = head["value"] if head else float("nan")
