@@ -863,21 +863,13 @@ def lut5_pivot_tile(tables, lc1, lc0, hc, lowvalid, highvalid, descs, t, *, tl, 
     return feasible.reshape(-1), req1.reshape(-1), req0.reshape(-1)
 
 
-def _pivot_tile_step(
-    tables, lc1, lc0, hc, lowvalid, highvalid, d, w_tab, m_tab, seed_t,
-    active, tl, th, solve_rows
+def _pivot_tile_solve_or_skip(
+    feas2d, req1, req0, d, w_tab, m_tab, seed_t, active, th, solve_rows
 ):
-    """One pivot tile's filter + in-kernel decomposition solve (shared by the
-    single-device stream and the mesh-sharded SPMD stream).
-
-    d: descriptor int32[5]; seed_t: per-tile seed; active: bool scalar
-    masking the whole tile off (sharded lockstep rounds run past t_end on
-    some devices).  Returns (status, m, lo_abs, hi_abs, sigma, func_outer,
-    req1, req0) — status 0 none / 1 found / 2 solver-row overflow.
-    """
-    _, feas2d, req1, req0 = _pivot_tile_constraints(
-        tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
-    )
+    """The skip-guarded decomposition solve of one pivot tile: runs the
+    in-kernel solver only when the (active-masked) tile has feasible
+    candidates.  Returns (status, m, lo_abs, hi_abs, sigma, func_outer,
+    req1, req0) — status 0 none / 1 found / 2 solver-row overflow."""
     feasible = feas2d.reshape(-1) & active
 
     def solve_tile(_):
@@ -890,6 +882,25 @@ def _pivot_tile_step(
         return (z, z, z, z, z, z, z, z)
 
     return jax.lax.cond(feasible.any(), solve_tile, skip_tile, None)
+
+
+def _pivot_tile_step(
+    tables, lc1, lc0, hc, lowvalid, highvalid, d, w_tab, m_tab, seed_t,
+    active, tl, th, solve_rows
+):
+    """One pivot tile's filter + in-kernel decomposition solve (shared by the
+    single-device stream and the mesh-sharded SPMD stream).
+
+    d: descriptor int32[5]; seed_t: per-tile seed; active: bool scalar
+    masking the whole tile off (sharded lockstep rounds run past t_end on
+    some devices).  Returns :func:`_pivot_tile_solve_or_skip`'s tuple.
+    """
+    _, feas2d, req1, req0 = _pivot_tile_constraints(
+        tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+    )
+    return _pivot_tile_solve_or_skip(
+        feas2d, req1, req0, d, w_tab, m_tab, seed_t, active, th, solve_rows
+    )
 
 
 def _pivot_tile_solve(
