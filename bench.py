@@ -222,12 +222,17 @@ def _mesh_scaling_worker() -> dict:
     from sboxgates_tpu.search.context import SearchContext
     from sboxgates_tpu.search.lut import PivotOperands, pivot_tile_shape
 
+    from sboxgates_tpu.search.lut import pivot_tile_shape
+
     g = G_HEAD
     st, target, mask = build_state(g)
-    # Smaller tiles than production (128 x 128 vs 512 x 512): the SPMD
-    # overhead being measured is per-round, and a CPU core grinds ~16x
-    # longer per full production tile than the measurement needs.
-    tl = th = 128
+    # PRODUCTION tile shape: the SPMD overhead being measured is the
+    # per-round psum barrier + gathers, and its relative cost depends
+    # directly on how much work one round holds — a smaller test tile
+    # would overstate it 16x (measured: 128x128 tiles show 0.60
+    # efficiency at 8 devices where production tiles amortize the same
+    # barrier over 16x the candidates).
+    tl, th = pivot_tile_shape(g)
     _, w_tab, m_tab = sweeps.lut5_split_tables()
     tables_np = np.zeros((512, 8), np.uint32)
     tables_np[:g] = st.live_tables()
@@ -236,7 +241,7 @@ def _mesh_scaling_worker() -> dict:
 
     # Window of consecutive FULL tiles (mid-space): boundary tiles are
     # mostly padding and would measure per-tile overhead, not rate.
-    PIVOT_TILES = 32
+    PIVOT_TILES = 16
     descs = sweeps.pivot_tile_descs(g, tl, th, [])
     sizes = (
         (descs[:, 2] - descs[:, 1]).astype(np.int64)

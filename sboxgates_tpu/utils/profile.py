@@ -99,6 +99,19 @@ class PhaseProfiler:
             "%-24s %6s %10.3f %6.1f   (wall %.3f s)"
             % ("total", "", total, 100.0 if total else 0.0, wall)
         )
+        if stats:
+            en = stats.get("engine_nodes", 0)
+            pn = stats.get("python_nodes", 0)
+            if en or pn:
+                lines.append(
+                    "engine-active nodes: %d/%d (%.1f%%), serviced device"
+                    " requests: %d"
+                    % (
+                        en, en + pn,
+                        100.0 * en / (en + pn),
+                        stats.get("engine_devcalls", 0),
+                    )
+                )
         return "\n".join(lines)
 
 

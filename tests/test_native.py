@@ -811,6 +811,47 @@ def test_lut_engine_continuation_services_staged_lut7():
     assert ctx_e.stats.get("python_nodes", 0) == 0
 
 
+def test_lut_engine_service_kind2_overflow_resume():
+    """The kind-2 device-work service (fused-head in-kernel solver
+    overflow) must re-drive the flagged chunk and resume the stream —
+    exercised directly against the service contract, since planting a
+    genuine >1024-feasible-row overflow is not deterministic: from
+    cstart=0 on a small planted state it must find the planted
+    decomposition, and from past the end of the space it must miss."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5_small
+
+    from sboxgates_tpu.ops import combinatorics as comb_ops
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import _lut_engine_service
+    from sboxgates_tpu.utils import sbox as _  # noqa: F401
+
+    st, target, mask = build_planted_lut5_small()
+    ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+    service = _lut_engine_service(ctx)
+    tables = np.ascontiguousarray(st.live_tables())
+    hit = service(
+        2, tables, st.num_gates, np.asarray(target), np.asarray(mask),
+        [], 0, 0, 0,
+    )
+    assert hit is not None and len(hit) == 7
+    fo, fi, a, b, c, d, e = (int(x) for x in hit)
+    got = tt.eval_lut(
+        fi, tt.eval_lut(fo, st.table(a), st.table(b), st.table(c)),
+        st.table(d), st.table(e),
+    )
+    assert bool(tt.eq_mask(got, target, mask))
+    # Resuming past the end of the space must scan nothing and miss.
+    total = comb_ops.n_choose_k(st.num_gates, 5)
+    miss = service(
+        2, tables, st.num_gates, np.asarray(target), np.asarray(mask),
+        [], total, 0, 0,
+    )
+    assert miss is None
+
+
 def test_lut_engine_bails_to_python_on_service_failure():
     """A broken device-work service degrades to the round-3 design: the
     engine bails and the Python engine finds (and verifies) the planted
