@@ -1444,11 +1444,19 @@ def main() -> None:
             entry, _ = bench_des_s1_lut()
             return entry
 
+        def lut7_capped_cpu():
+            # Never chip-captured (VERDICT r3 weak 6): a CPU-backend
+            # number bounds the cost until the tunnel returns — the chip
+            # runs stage A sharded and stage B as device matmuls.
+            e = bench_lut7_capped_search()
+            e["backend"] = "cpu"
+            return e
+
         for fn in (bench_cpu_baseline, bench_des_s1_sat_not,
                    bench_des_s1_full_graph, bench_lut7_break_even,
                    des_s1_lut, bench_multibox_des, bench_permute_sweep,
-                   bench_engine_pivot_ab, bench_mesh_scaling,
-                   bench_gather_compaction):
+                   bench_engine_pivot_ab, lut7_capped_cpu,
+                   bench_mesh_scaling, bench_gather_compaction):
             try:
                 r = fn()
                 detail.extend(r if isinstance(r, list) else [r])
