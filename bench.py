@@ -167,6 +167,11 @@ def bench_pivot_tile_batch() -> dict:
             key += "_pallas" if v[2] == "pallas" else ""
             out[f"{key}_error"] = repr(e)[:300]
     variants = warmed
+    if not variants:
+        # Keep the collected per-variant *_error diagnostics in the
+        # entry instead of losing them to run()'s exception handler.
+        out["error"] = "every pivot-stream variant failed to warm"
+        return out
 
     def one(tb, pl, backend):
         t0 = time.perf_counter()
@@ -190,9 +195,11 @@ def bench_pivot_tile_batch() -> dict:
         out[f"{key}_spread"] = [vals[0], vals[-1]]
         if best is None or out[key] > out[best]:
             best = key
-    out["value"] = out.get("t1")
+    # value = the t1 baseline when it survived, else the best variant
+    # (a None value would NaN-poison ratio consumers).
     out["best"] = out[best]
     out["best_variant"] = best
+    out["value"] = out.get("t1", out[best])
     return out
 
 
@@ -221,8 +228,6 @@ def _mesh_scaling_worker() -> dict:
     )
     from sboxgates_tpu.search.context import SearchContext
     from sboxgates_tpu.search.lut import PivotOperands, pivot_tile_shape
-
-    from sboxgates_tpu.search.lut import pivot_tile_shape
 
     g = G_HEAD
     st, target, mask = build_state(g)

@@ -148,6 +148,7 @@ def _build() -> Optional[str]:
         "-O3",
         "-march=native",
         "-std=c++17",
+        "-pthread",  # std::thread (sbg_lut5_search_cpu_mt)
         "-shared",
         "-fPIC",
         "-o",
@@ -736,11 +737,15 @@ class LutEngineCaller:
 
     BAILED = object()
 
-    __slots__ = ("_fn", "_bufs", "_addrs", "_cb_service", "_cb")
+    __slots__ = ("_fn", "_bufs", "_addrs", "_cb_cache")
 
     def __init__(self, pair_table, pair_entries):
-        self._cb_service = None
-        self._cb = None
+        # {id(service): (service, callback, pending)} — the strong
+        # service reference keeps the id stable, and per-service entries
+        # keep concurrent engine calls through a SHARED caller (contexts
+        # inherit it) from ever receiving another thread's callback or
+        # pending-interrupt holder.
+        self._cb_cache = {}
         from ..ops import sweeps
 
         self._fn = _require().sbg_lut_engine
@@ -782,17 +787,20 @@ class LutEngineCaller:
         n_sigma = self._bufs[4].shape[0]
         # The CFUNCTYPE object must stay referenced for the whole engine
         # call — the C side holds only the bare function pointer.  Cached
-        # per service: the engine runs once per search node and wrapper
-        # construction is measurable at that rate.
+        # per service (the engine runs once per search node and wrapper
+        # construction is measurable at that rate); the local variables
+        # carry the entry so a concurrent thread's insert can never hand
+        # this call someone else's callback.
         pending = None
         if service is None:
             cb = None
-        elif service is self._cb_service:
-            cb, pending = self._cb
         else:
-            cb, pending = make_eng_devcb(service)
-            self._cb_service = service
-            self._cb = (cb, pending)
+            entry = self._cb_cache.get(id(service))
+            if entry is not None and entry[0] is service:
+                _, cb, pending = entry
+            else:
+                cb, pending = make_eng_devcb(service)
+                self._cb_cache[id(service)] = (service, cb, pending)
         n = self._fn(
             tables.ctypes.data,
             g,

@@ -323,10 +323,16 @@ def _native_lut_engine_search(
     import numpy as np
 
     eng = ctx.lut_engine_caller()
-    service = getattr(ctx, "_lut_engine_service_fn", None)
-    if service is None:
+    # Cache keyed to THIS context: RestartContext views inherit the base
+    # context's __dict__ (batched.py), so a bare cached closure would
+    # service a thread's devcalls against the base context (racing its
+    # rng/stats).  The identity check makes every view build its own.
+    cached = getattr(ctx, "_lut_engine_service_fn", None)
+    if cached is not None and cached[0] is ctx:
+        service = cached[1]
+    else:
         service = _lut_engine_service(ctx)
-        ctx._lut_engine_service_fn = service
+        ctx._lut_engine_service_fn = (ctx, service)
     # Snapshot the candidate counters: if a LATER devcall's service fails
     # after earlier devcalls already ran Python drivers (which count into
     # ctx.stats directly), the bail reruns the whole call through the

@@ -11,8 +11,10 @@ their DEVICE sweeps rendezvous into vmapped dispatches
 the wave.  That only pays when jobs actually dispatch: nodes the
 execution-placement policy routes to the native host engine (DES-class
 states) make no dispatches to merge, and there batching measures neutral
-to slightly negative (BENCH_DETAIL ``permute_sweep_des_s1_p64``: batched
-4.09 s vs serial 4.05 s) — hence the per-family defaults below.
+to slightly negative (BENCH_UNREACHABLE.json
+``permute_sweep_des_s1_p64``: batched 4.26 s vs serial 3.94 s medians;
+the round-3 capture read 4.09 vs 4.05) — hence the per-family defaults
+below.
 
 Execution modes:
 
@@ -312,12 +314,12 @@ def permute_sweep_jobs(sbox: np.ndarray, num_inputs: int) -> List[BoxJob]:
 
     Defaults to the serial loop (``prefer_serial``): measured on the
     bench host, the 64-permutation DES S1 sweep is not helped by
-    batching (BENCH_DETAIL permute_sweep_des_s1_p64: batched 4.09 s vs
-    serial 4.05 s) — DES-class nodes route to the native host engine,
-    so a 64-job wave has no device round trips to merge and its threads
-    only contend.  Pass ``batched=True`` to the search driver to force
-    batching (e.g. for boxes big enough that nodes dispatch to the
-    device)."""
+    batching (BENCH_UNREACHABLE.json permute_sweep_des_s1_p64: batched
+    4.26 s vs serial 3.94 s medians) — DES-class nodes route to the
+    native host engine, so a 64-job wave has no device round trips to
+    merge and its threads only contend.  Pass ``batched=True`` to the
+    search driver to force batching (e.g. for boxes big enough that
+    nodes dispatch to the device)."""
     return [
         BoxJob(
             f"p{p:02x}", permuted_box(sbox, num_inputs, p), num_inputs,

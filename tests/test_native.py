@@ -811,6 +811,45 @@ def test_lut_engine_continuation_services_staged_lut7():
     assert ctx_e.stats.get("python_nodes", 0) == 0
 
 
+def test_lut_engine_service_binds_per_context_views():
+    """A RestartContext view inherits the base context's __dict__ —
+    including any cached engine device-work service.  A devcall from the
+    view's engine (host-only node whose 7-LUT phase is staged) must be
+    serviced against the VIEW (its stats, its rng), not the base the
+    cached closure was built for: the view counts the serviced work and
+    the base's counters stay untouched until an explicit merge."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5, build_planted_lut7
+
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.batched import Rendezvous, RestartContext
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    base = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+    # Prime the base's service cache with a real engine+devcall run.
+    st0, t0, m0 = build_planted_lut5()
+    assert create_circuit(base, st0, t0, m0, []) != 0xFFFF
+    assert base._lut_engine_service_fn[0] is base
+    base_counts = dict(base.stats)
+
+    view = RestartContext(base, 123, Rendezvous(1))
+    # The inherited cache entry still names the base as its owner...
+    assert view._lut_engine_service_fn[0] is base
+    st, target, mask = build_planted_lut7()  # host-only node, staged 7-LUT
+    out = create_circuit(view, st, target, mask, [])
+    assert out != 0xFFFF
+    st.verify_gate(out, target, mask)
+    # ...so the view must have built (and cached) its own.
+    assert view._lut_engine_service_fn[0] is view
+    assert base._lut_engine_service_fn[0] is base
+    assert view.stats["engine_devcalls"] >= 1
+    assert view.stats["lut7_candidates"] > 0
+    # The serviced work was counted on the view, not leaked to the base.
+    assert dict(base.stats) == base_counts
+
+
 def test_lut_engine_service_kind2_overflow_resume():
     """The kind-2 device-work service (fused-head in-kernel solver
     overflow) must re-drive the flagged chunk and resume the stream —
@@ -871,7 +910,8 @@ def test_lut_engine_bails_to_python_on_service_failure():
     def broken_service(*args):
         raise RuntimeError("simulated device failure")
 
-    ctx._lut_engine_service_fn = broken_service
+    # The cache entry is (owning_ctx, service) — kwan validates ownership.
+    ctx._lut_engine_service_fn = (ctx, broken_service)
     out = create_circuit(ctx, st, target, mask, [])
     assert out != 0xFFFF
     st.verify_gate(out, target, mask)

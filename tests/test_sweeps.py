@@ -496,7 +496,9 @@ def test_pivot_pallas_backend_bit_identical():
     """The fused Pallas pivot kernel (ops/pallas_pivot.py, interpreter
     mode here) must produce the byte-identical stream verdict as the XLA
     backend — hits, constraint words, and resume tile — alone and
-    composed with the pipeline lever, plus at the small-G tile shape."""
+    composed with the pipeline lever, at BOTH production tile shapes
+    ((256, 512) for small G — what pivot_tile_shape(50) selects — and
+    (512, 512) for G > 128, the shape every large search uses)."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))
@@ -508,7 +510,8 @@ def test_pivot_pallas_backend_bit_identical():
 
     st, target, mask = build_planted_lut5()
     g = st.num_gates
-    for tl, th in (pivot_tile_shape(g), (256, 512)):
+    assert pivot_tile_shape(g) == (256, 512)
+    for tl, th in ((256, 512), (512, 512)):
         ctx = SearchContext(Options(seed=1, lut_graph=True, randomize=False))
         dev_tables, _ = ctx.device_tables(st)
         ops = PivotOperands(
