@@ -1318,6 +1318,11 @@ struct EngCfg {
   sbg_eng_devcb devcb;
   void* devcb_handle;
   int32_t slot;
+  // > 1: the OUTERMOST step-5 mux fans its select-bit branches out over
+  // std::threads (each branch serial below), overlapping their serviced
+  // device dispatches — the engine analog of the Python path's
+  // run_mux_jobs.  Branch configs run with mux_threads = 1.
+  int32_t mux_threads;
   int32_t metric;  // 0 = gates, 1 = SAT
   int32_t num_inputs;
   bool randomize;
@@ -2003,15 +2008,9 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
   EngState best;
   int32_t best_out = ENG_NO_GATE;
   bool have = false;
-  for (int32_t bi = 0; bi < n_bits; bi++) {
-    EngState cand;
-    int32_t cand_out;
-    const bool got = eng_mux_try_bit(st, C, target, mask, bit_order[bi],
-                                     inbits, n_tracked, &cand, &cand_out);
-    if (C.bailed) return ENG_NO_GATE;
-    if (!got) {
-      continue;
-    }
+  auto consider = [&](EngState& cand, int32_t cand_out) {
+    // Keep the best mux construction; first-in-bit-order wins ties
+    // (strict <), exactly as the serial fold (sboxgates.c:593-606).
     bool better;
     if (!have) {
       better = true;
@@ -2024,6 +2023,70 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
       best = std::move(cand);
       best_out = cand_out;
       have = true;
+    }
+  };
+
+  if (C.mux_threads > 1 && n_bits > 1 && C.lut != nullptr &&
+      C.devcb != nullptr) {
+    // Concurrent branch exploration: one thread per select bit, each on
+    // its own state copy and config — its own splitmix64 stream (branch
+    // seeds drawn HERE in bit order, so randomized runs stay
+    // seed-deterministic regardless of thread timing), its own counters
+    // (summed after the join — order-independent), and the shared devcb
+    // with `slot` tagging the branch (the Python service isolates
+    // per-call context views when this lever is on).  Only the
+    // outermost mux fans out; the fold stays in bit order, so
+    // non-randomized results are bit-identical to the serial loop's.
+    std::vector<EngCfg> cfgs((size_t)n_bits, C);
+    std::vector<EngState> cands((size_t)n_bits);
+    std::vector<int32_t> outs((size_t)n_bits, ENG_NO_GATE);
+    std::vector<uint8_t> gots((size_t)n_bits, 0);
+    for (int32_t bi = 0; bi < n_bits; bi++) {
+      EngCfg& B = cfgs[(size_t)bi];
+      B.mux_threads = 1;
+      B.slot = bi;
+      B.rng = C.randomize ? sm64_next(C.rng) : 0;
+      B.nodes = B.pair_cand = B.triple_cand = 0;
+      B.lut3_cand = B.lut5_cand = B.lut7_cand = B.lut7_solved = 0;
+      B.devcalls = 0;
+    }
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)n_bits);
+    for (int32_t bi = 0; bi < n_bits; bi++) {
+      threads.emplace_back([&, bi]() {
+        gots[(size_t)bi] =
+            eng_mux_try_bit(st, cfgs[(size_t)bi], target, mask,
+                            bit_order[bi], inbits, n_tracked,
+                            &cands[(size_t)bi], &outs[(size_t)bi])
+                ? 1
+                : 0;
+      });
+    }
+    for (auto& th : threads) th.join();
+    for (int32_t bi = 0; bi < n_bits; bi++) {
+      const EngCfg& B = cfgs[(size_t)bi];
+      C.nodes += B.nodes;
+      C.pair_cand += B.pair_cand;
+      C.triple_cand += B.triple_cand;
+      C.lut3_cand += B.lut3_cand;
+      C.lut5_cand += B.lut5_cand;
+      C.lut7_cand += B.lut7_cand;
+      C.lut7_solved += B.lut7_solved;
+      C.devcalls += B.devcalls;
+      C.bailed = C.bailed || B.bailed;
+    }
+    if (C.bailed) return ENG_NO_GATE;
+    for (int32_t bi = 0; bi < n_bits; bi++) {
+      if (gots[(size_t)bi]) consider(cands[(size_t)bi], outs[(size_t)bi]);
+    }
+  } else {
+    for (int32_t bi = 0; bi < n_bits; bi++) {
+      EngState cand;
+      int32_t cand_out;
+      const bool got = eng_mux_try_bit(st, C, target, mask, bit_order[bi],
+                                       inbits, n_tracked, &cand, &cand_out);
+      if (C.bailed) return ENG_NO_GATE;
+      if (got) consider(cand, cand_out);
     }
   }
   if (!have) return ENG_NO_GATE;
@@ -2080,8 +2143,8 @@ int64_t sbg_lut_engine(
     const int32_t* idx_tab, const int32_t* orders, const uint32_t* wo_tab,
     const uint32_t* wm_tab, const uint32_t* g_tab, int32_t n_sigma,
     const int32_t* inbits, int32_t n_inbits, int32_t randomize,
-    uint64_t rng_seed, sbg_eng_devcb devcb, void* devcb_handle,
-    int32_t* out_gid, int32_t* added, int64_t* stats) {
+    uint64_t rng_seed, int32_t mux_threads, sbg_eng_devcb devcb,
+    void* devcb_handle, int32_t* out_gid, int32_t* added, int64_t* stats) {
   EngState st;
   EngCfg C;
   eng_init(st, C, tables, g, num_inputs, max_gates, sat_metric,
@@ -2100,6 +2163,7 @@ int64_t sbg_lut_engine(
   C.lut = &lt;
   C.devcb = devcb;
   C.devcb_handle = devcb_handle;
+  C.mux_threads = mux_threads;
   return eng_run(st, C, target, mask, inbits, n_inbits, g, out_gid, added,
                  stats);
 }

@@ -366,6 +366,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,   # n_inbits
             ctypes.c_int32,   # randomize
             ctypes.c_uint64,  # rng_seed
+            ctypes.c_int32,   # mux_threads (>1 = threaded outermost mux)
             ENG_DEVCB,        # devcb (None = bail on device-work nodes)
             ctypes.c_void_p,  # devcb_handle
             ctypes.c_void_p,  # out_gid
@@ -771,11 +772,15 @@ class LutEngineCaller:
     def __call__(
         self, tables, g, num_inputs, max_gates, sat_metric, max_sat_metric,
         metric, target, mask, inbits, randomize, rng_seed, service=None,
+        mux_threads=1,
     ):
         """Returns (out_gid, added int32[n,5], stats int64[8]) or
         (BAILED, None, stats) when the search needed device work and no
         ``service`` (see :func:`make_eng_devcb`) was attached (or it
-        failed)."""
+        failed).  ``mux_threads > 1`` fans the outermost mux's branches
+        out over C++ threads — the service must then be thread-safe
+        (kwan._lut_engine_service isolates per-call views when the
+        lever is on)."""
         assert tables.flags["C_CONTIGUOUS"] and tables.shape[0] >= g
         assert tables.shape[-1] * tables.itemsize == 32
         inb = np.ascontiguousarray(
@@ -817,6 +822,7 @@ class LutEngineCaller:
             len(inbits),
             int(bool(randomize)),
             rng_seed & 0xFFFFFFFFFFFFFFFF,
+            int(mux_threads),
             cb,
             None,
             out_gid.ctypes.data,

@@ -676,6 +676,24 @@ class SearchContext:
             )
         return self._lut_engine_caller
 
+    def engine_mux_threads(self) -> int:
+        """Threads for the native engine's outermost mux fan-out
+        (SBG_ENGINE_MUX_THREADS, default 1 = serial).  >1 overlaps the
+        branches' serviced device dispatches — the engine analog of
+        parallel_mux — at the cost of a different (still
+        seed-deterministic) randomize stream; non-randomized results
+        are bit-identical for every value (parity-tested).  An A/B
+        lever pending on-chip measurement, like the pivot levers."""
+        cached = getattr(self, "_engine_mux_threads", None)
+        if cached is None:
+            import os
+
+            cached = max(1, int(os.environ.get(
+                "SBG_ENGINE_MUX_THREADS", "1"
+            )))
+            self._engine_mux_threads = cached
+        return cached
+
     def _gate_step_native(self, st: State, target, mask):
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical
         verdict to the device kernel, without the dispatch."""

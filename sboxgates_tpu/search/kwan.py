@@ -221,26 +221,35 @@ class _EngineView:
         return self._tables
 
 
-def _lut_engine_service(ctx: SearchContext):
+def _lut_engine_service(ctx: SearchContext, threaded: bool = False):
     """Builds the engine's device-work continuation service (the Python
     half of csrc's sbg_eng_devcb contract): each request runs the SAME
     search driver the Python engine would at that node, so results stay
     bit-identical with randomize off.  The engine blocks in the callback
-    (its C stack is the resumable state) and resumes in place."""
+    (its C stack is the resumable state) and resumes in place.
+
+    ``threaded``: requests may arrive concurrently from the engine's mux
+    branch threads — every call then runs against its own context view
+    (rng seeded from the engine branch stream's per-call draw, so
+    randomized results stay deterministic regardless of thread timing)
+    and merges its counters into ``ctx`` under a lock."""
+    import threading
+
     from . import lut as lutmod
 
-    def service(kind, tables, g, target, mask, inbits, arg0, rng, slot):
-        st = _EngineView(tables, g)
+    merge_lock = threading.Lock()
+
+    def run(cctx, kind, st, target, mask, inbits, arg0):
         if kind == 1:  # pivot-sized space: full 5-LUT search
-            with ctx.prof.phase("lut5"):
-                res = lutmod.lut5_search(ctx, st, target, mask, inbits)
+            with cctx.prof.phase("lut5"):
+                res = lutmod.lut5_search(cctx, st, target, mask, inbits)
         elif kind == 2:  # fused-head in-kernel solver overflow
             res = lutmod.lut5_resume_overflow(
-                ctx, st, target, mask, inbits, arg0
+                cctx, st, target, mask, inbits, arg0
             )
         elif kind == 3:  # staged 7-LUT
-            with ctx.prof.phase("lut7"):
-                res = lutmod.lut7_search(ctx, st, target, mask, inbits)
+            with cctx.prof.phase("lut7"):
+                res = lutmod.lut7_search(cctx, st, target, mask, inbits)
             if res is None:
                 return None
             return (
@@ -252,6 +261,18 @@ def _lut_engine_service(ctx: SearchContext):
         if res is None:
             return None
         return (res["func_outer"], res["func_inner"], *res["gates"])
+
+    def service(kind, tables, g, target, mask, inbits, arg0, rng, slot):
+        st = _EngineView(tables, g)
+        if not threaded:
+            return run(ctx, kind, st, target, mask, inbits, arg0)
+        from .batched import Rendezvous, RestartContext
+
+        cctx = RestartContext(ctx, rng, Rendezvous(1))
+        try:
+            return run(cctx, kind, st, target, mask, inbits, arg0)
+        finally:
+            cctx.merge_stats_into(ctx, merge_lock)
 
     return service
 
@@ -323,6 +344,7 @@ def _native_lut_engine_search(
     import numpy as np
 
     eng = ctx.lut_engine_caller()
+    mux_threads = ctx.engine_mux_threads()
     # Cache keyed to THIS context: RestartContext views inherit the base
     # context's __dict__ (batched.py), so a bare cached closure would
     # service a thread's devcalls against the base context (racing its
@@ -331,7 +353,7 @@ def _native_lut_engine_search(
     if cached is not None and cached[0] is ctx:
         service = cached[1]
     else:
-        service = _lut_engine_service(ctx)
+        service = _lut_engine_service(ctx, threaded=mux_threads > 1)
         ctx._lut_engine_service_fn = (ctx, service)
     # Snapshot the candidate counters: if a LATER devcall's service fails
     # after earlier devcalls already ran Python drivers (which count into
@@ -353,6 +375,7 @@ def _native_lut_engine_search(
             ctx.opt.randomize,
             _engine_seed(ctx),
             service=service,
+            mux_threads=mux_threads,
         )
     if added is None:  # BAILED: the device-work service failed
         ctx.stats.clear()

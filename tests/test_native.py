@@ -850,6 +850,55 @@ def test_lut_engine_service_binds_per_context_views():
     assert dict(base.stats) == base_counts
 
 
+def test_engine_threaded_mux_matches_serial(monkeypatch):
+    """SBG_ENGINE_MUX_THREADS > 1 fans the outermost mux over C++
+    threads whose branches service their device work concurrently
+    (per-call context views).  Non-randomized results and the summed
+    candidate counters must be bit-identical to the serial engine's —
+    the fold stays in bit order.  The target (AND of all 8 inputs) is
+    unrealizable from the XOR state, so both arms walk the whole mux
+    tree; kind-3 requests are suppressed (the staged 7-LUT's C(50,7)
+    stage A is minutes on CPU and identical in both arms)."""
+    import sys
+    from functools import reduce
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import _lut_engine_service, create_circuit
+
+    def run(threads):
+        monkeypatch.setenv("SBG_ENGINE_MUX_THREADS", str(threads))
+        st, _, mask = build_planted_lut5()
+        miss = reduce(
+            lambda a, b: np.asarray(a) & np.asarray(b),
+            [st.table(i) for i in range(8)],
+        )
+        st.max_gates = st.num_gates + 3
+        ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+        real = _lut_engine_service(ctx, threaded=threads > 1)
+
+        def wrapped(kind, *args):
+            return None if kind == 3 else real(kind, *args)
+
+        ctx._lut_engine_service_fn = (ctx, wrapped)
+        out = create_circuit(ctx, st, miss, mask, [])
+        keys = (
+            "engine_nodes", "engine_devcalls", "lut3_candidates",
+            "lut5_candidates", "pair_candidates",
+        )
+        return out, st.num_gates, {k: ctx.stats.get(k, 0) for k in keys}
+
+    s_out, s_g, s_stats = run(1)
+    t_out, t_g, t_stats = run(8)
+    assert (s_out, s_g) == (t_out, t_g)
+    assert s_stats == t_stats, (s_stats, t_stats)
+    # The mux branches really serviced device work: the root plus each
+    # first-level branch runs a pivot 5-LUT sweep.
+    assert s_stats["engine_devcalls"] >= 9
+
+
 def test_lut_engine_service_kind2_overflow_resume():
     """The kind-2 device-work service (fused-head in-kernel solver
     overflow) must re-drive the flagged chunk and resume the stream —
