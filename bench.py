@@ -1095,6 +1095,73 @@ def bench_engine_pivot_ab() -> dict:
     }
 
 
+def bench_engine_mux_threads() -> dict:
+    """A/B of the engine's threaded mux fan-out (SBG_ENGINE_MUX_THREADS):
+    a budget-capped unrealizable target over a G=50 planted state makes
+    the engine walk its full mux tree with one serviced pivot sweep at
+    the root and one per first-level branch (9 devcalls) — the workload
+    the lever exists to overlap.  Staged-7-LUT requests are suppressed
+    via the service seam so the measurement isolates branch-dispatch
+    overlap (and stays CPU-feasible in smoke runs); both arms share the
+    suppression, and their results are bit-identical (parity-tested)."""
+    import sys as _sys
+    from functools import reduce
+
+    _sys.path.insert(0, os.path.join(HERE, "tests"))
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import _lut_engine_service, create_circuit
+
+    def run(threads):
+        os.environ["SBG_ENGINE_MUX_THREADS"] = str(threads)
+        try:
+            st, _, mask = build_planted_lut5()
+            miss = reduce(
+                lambda a, b: np.asarray(a) & np.asarray(b),
+                [np.asarray(st.table(i)) for i in range(8)],
+            )
+            st.max_gates = st.num_gates + 3
+            ctx = SearchContext(
+                Options(seed=2, lut_graph=True, randomize=False,
+                        parallel_mux=False)
+            )
+            real = _lut_engine_service(ctx, threaded=threads > 1)
+
+            def wrapped(kind, *args):
+                return None if kind == 3 else real(kind, *args)
+
+            ctx._lut_engine_service_fn = (ctx, wrapped)
+            t0 = time.perf_counter()
+            out = create_circuit(ctx, st, miss, mask, [])
+            dt = time.perf_counter() - t0
+            assert out == 0xFFFF, "miss target unexpectedly realized"
+            return dt, ctx.stats.get("engine_devcalls", 0)
+        finally:
+            os.environ.pop("SBG_ENGINE_MUX_THREADS", None)
+
+    run(1)  # warm/compile
+    run(8)
+    stimes, ttimes = [], []
+    devcalls = 0
+    for _ in range(REPEATS):
+        sdt, devcalls = run(1)
+        tdt, _ = run(8)
+        stimes.append(sdt)
+        ttimes.append(tdt)
+    stimes.sort()
+    ttimes.sort()
+    return {
+        "metric": "engine_mux_threads_ab_g50",
+        "value": ttimes[len(ttimes) // 2], "unit": "s",
+        "min": ttimes[0], "max": ttimes[-1], "reps": REPEATS,
+        "serial_s": stimes[len(stimes) // 2],
+        "serial_spread": [stimes[0], stimes[-1]],
+        "threaded_wins": ttimes[len(ttimes) // 2] < stimes[len(stimes) // 2],
+        "devcalls_per_run": devcalls,
+    }
+
+
 def bench_batch_axis_pivot() -> dict:
     """The batch axis in its claimed win regime (VERDICT r2 item 4):
     pivot-sized states (G=140, C(140,5)=416M — every node makes real
@@ -1544,6 +1611,7 @@ def main() -> None:
     run(bench_lut7_break_even)
     run(bench_lut7_capped_search)
     run(bench_engine_pivot_ab)
+    run(bench_engine_mux_threads)
     run(bench_batch_axis_pivot)
     run(bench_multibox_des)
     run(bench_permute_sweep)
