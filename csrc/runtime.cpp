@@ -47,6 +47,7 @@
 // Build: see csrc/Makefile (g++ -O3 -march=native -shared -fPIC).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -1319,10 +1320,14 @@ struct EngCfg {
   void* devcb_handle;
   int32_t slot;
   // > 1: the OUTERMOST step-5 mux fans its select-bit branches out over
-  // std::threads (each branch serial below), overlapping their serviced
-  // device dispatches — the engine analog of the Python path's
-  // run_mux_jobs.  Branch configs run with mux_threads = 1.
+  // std::threads, at most mux_threads concurrent (wave launches),
+  // overlapping their serviced device dispatches — the engine analog of
+  // the Python path's run_mux_jobs.  Branch configs run with
+  // mux_threads = 1 and share `abort_flag`: a bailing branch (service
+  // failure / interrupt) stops its siblings at their next node instead
+  // of letting them finish subtrees the bail will discard.
   int32_t mux_threads;
+  std::atomic<bool>* abort_flag;
   int32_t metric;  // 0 = gates, 1 = SAT
   int32_t num_inputs;
   bool randomize;
@@ -1632,7 +1637,14 @@ int32_t eng_devcall(EngState& st, EngCfg& C, int32_t kind, const TT& target,
       C.devcb_handle, kind,
       reinterpret_cast<const uint64_t*>(st.tabs.data()), st.ng(), target.w,
       mask.w, inbits, n_inbits, arg0, sub, C.slot, resp);
-  if (rc != 0) return -1;
+  if (rc != 0) {
+    // Service failure/interrupt: tell concurrent mux branches to stop —
+    // the whole engine result is about to be discarded.
+    if (C.abort_flag != nullptr) {
+      C.abort_flag->store(true, std::memory_order_relaxed);
+    }
+    return -1;
+  }
   return resp[0];
 }
 
@@ -1890,6 +1902,13 @@ bool eng_mux_try_bit(const EngState& st, EngCfg& C, const TT& target,
 // the LUT branches; sboxgates.c:282-616).
 int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
                    const int32_t* inbits, int32_t n_inbits) {
+  if (C.abort_flag != nullptr &&
+      C.abort_flag->load(std::memory_order_relaxed)) {
+    // A sibling mux branch bailed; everything computed from here on
+    // would be discarded with it — unwind promptly.
+    C.bailed = true;
+    return ENG_NO_GATE;
+  }
   C.nodes++;
   const int32_t g = st.ng();
   const bool lut_mode = C.lut != nullptr;
@@ -2037,6 +2056,7 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
     // per-call context views when this lever is on).  Only the
     // outermost mux fans out; the fold stays in bit order, so
     // non-randomized results are bit-identical to the serial loop's.
+    std::atomic<bool> abort_flag(false);
     std::vector<EngCfg> cfgs((size_t)n_bits, C);
     std::vector<EngState> cands((size_t)n_bits);
     std::vector<int32_t> outs((size_t)n_bits, ENG_NO_GATE);
@@ -2046,23 +2066,31 @@ int32_t eng_search(EngState& st, EngCfg& C, const TT& target, const TT& mask,
       B.mux_threads = 1;
       B.slot = bi;
       B.rng = C.randomize ? sm64_next(C.rng) : 0;
+      B.abort_flag = &abort_flag;
       B.nodes = B.pair_cand = B.triple_cand = 0;
       B.lut3_cand = B.lut5_cand = B.lut7_cand = B.lut7_solved = 0;
       B.devcalls = 0;
     }
-    std::vector<std::thread> threads;
-    threads.reserve((size_t)n_bits);
-    for (int32_t bi = 0; bi < n_bits; bi++) {
-      threads.emplace_back([&, bi]() {
-        gots[(size_t)bi] =
-            eng_mux_try_bit(st, cfgs[(size_t)bi], target, mask,
-                            bit_order[bi], inbits, n_tracked,
-                            &cands[(size_t)bi], &outs[(size_t)bi])
-                ? 1
-                : 0;
-      });
+    // Wave launches honor the lever as a concurrency CAP (at most
+    // mux_threads branches in flight), not just an on/off switch.
+    const int32_t wave = std::min(C.mux_threads, n_bits);
+    for (int32_t lo = 0; lo < n_bits; lo += wave) {
+      const int32_t hi = std::min(n_bits, lo + wave);
+      std::vector<std::thread> threads;
+      threads.reserve((size_t)(hi - lo));
+      for (int32_t bi = lo; bi < hi; bi++) {
+        threads.emplace_back([&, bi]() {
+          gots[(size_t)bi] =
+              eng_mux_try_bit(st, cfgs[(size_t)bi], target, mask,
+                              bit_order[bi], inbits, n_tracked,
+                              &cands[(size_t)bi], &outs[(size_t)bi])
+                  ? 1
+                  : 0;
+        });
+      }
+      for (auto& th : threads) th.join();
+      if (abort_flag.load(std::memory_order_relaxed)) break;
     }
-    for (auto& th : threads) th.join();
     for (int32_t bi = 0; bi < n_bits; bi++) {
       const EngCfg& B = cfgs[(size_t)bi];
       C.nodes += B.nodes;

@@ -738,15 +738,9 @@ class LutEngineCaller:
 
     BAILED = object()
 
-    __slots__ = ("_fn", "_bufs", "_addrs", "_cb_cache")
+    __slots__ = ("_fn", "_bufs", "_addrs")
 
     def __init__(self, pair_table, pair_entries):
-        # {id(service): (service, callback, pending)} — the strong
-        # service reference keeps the id stable, and per-service entries
-        # keep concurrent engine calls through a SHARED caller (contexts
-        # inherit it) from ever receiving another thread's callback or
-        # pending-interrupt holder.
-        self._cb_cache = {}
         from ..ops import sweeps
 
         self._fn = _require().sbg_lut_engine
@@ -772,13 +766,18 @@ class LutEngineCaller:
     def __call__(
         self, tables, g, num_inputs, max_gates, sat_metric, max_sat_metric,
         metric, target, mask, inbits, randomize, rng_seed, service=None,
-        mux_threads=1,
+        mux_threads=1, devcb=None,
     ):
         """Returns (out_gid, added int32[n,5], stats int64[8]) or
         (BAILED, None, stats) when the search needed device work and no
-        ``service`` (see :func:`make_eng_devcb`) was attached (or it
-        failed).  ``mux_threads > 1`` fans the outermost mux's branches
-        out over C++ threads — the service must then be thread-safe
+        service was attached (or it failed).  ``devcb`` is a pre-wrapped
+        (callback, pending) pair from :func:`make_eng_devcb` — the hot
+        path, with the wrapper's lifetime owned by the caller's context
+        (the caller itself caches nothing: a per-caller cache would pin
+        every dead context's service for the process lifetime).
+        ``service`` alternatively wraps a raw callable per call.
+        ``mux_threads > 1`` fans the outermost mux's branches out over
+        C++ threads — the service must then be thread-safe
         (kwan._lut_engine_service isolates per-call views when the
         lever is on)."""
         assert tables.flags["C_CONTIGUOUS"] and tables.shape[0] >= g
@@ -791,21 +790,16 @@ class LutEngineCaller:
         stats = np.zeros(8, dtype=np.int64)
         n_sigma = self._bufs[4].shape[0]
         # The CFUNCTYPE object must stay referenced for the whole engine
-        # call — the C side holds only the bare function pointer.  Cached
-        # per service (the engine runs once per search node and wrapper
-        # construction is measurable at that rate); the local variables
-        # carry the entry so a concurrent thread's insert can never hand
-        # this call someone else's callback.
+        # call — the C side holds only the bare function pointer; the
+        # local variables keep it alive here, its owner (the context's
+        # service-cache entry, or this frame for a raw `service`) beyond.
         pending = None
-        if service is None:
-            cb = None
+        if devcb is not None:
+            cb, pending = devcb
+        elif service is not None:
+            cb, pending = make_eng_devcb(service)
         else:
-            entry = self._cb_cache.get(id(service))
-            if entry is not None and entry[0] is service:
-                _, cb, pending = entry
-            else:
-                cb, pending = make_eng_devcb(service)
-                self._cb_cache[id(service)] = (service, cb, pending)
+            cb = None
         n = self._fn(
             tables.ctypes.data,
             g,

@@ -343,18 +343,26 @@ def _native_lut_engine_search(
     instead."""
     import numpy as np
 
+    from .. import native
+
     eng = ctx.lut_engine_caller()
     mux_threads = ctx.engine_mux_threads()
     # Cache keyed to THIS context: RestartContext views inherit the base
     # context's __dict__ (batched.py), so a bare cached closure would
     # service a thread's devcalls against the base context (racing its
     # rng/stats).  The identity check makes every view build its own.
+    # The entry also owns the wrapped ctypes callback, so its lifetime
+    # is the context's — not pinned forever in a shared cache.  A
+    # 2-tuple (ctx, service) — the test/bench injection seam — is
+    # upgraded in place.
     cached = getattr(ctx, "_lut_engine_service_fn", None)
-    if cached is not None and cached[0] is ctx:
-        service = cached[1]
-    else:
+    if cached is None or cached[0] is not ctx:
         service = _lut_engine_service(ctx, threaded=mux_threads > 1)
-        ctx._lut_engine_service_fn = (ctx, service)
+        cached = (ctx, service, *native.make_eng_devcb(service))
+        ctx._lut_engine_service_fn = cached
+    elif len(cached) < 4:
+        cached = (ctx, cached[1], *native.make_eng_devcb(cached[1]))
+        ctx._lut_engine_service_fn = cached
     # Snapshot the candidate counters: if a LATER devcall's service fails
     # after earlier devcalls already ran Python drivers (which count into
     # ctx.stats directly), the bail reruns the whole call through the
@@ -374,7 +382,7 @@ def _native_lut_engine_search(
             list(inbits),
             ctx.opt.randomize,
             _engine_seed(ctx),
-            service=service,
+            devcb=cached[2:],
             mux_threads=mux_threads,
         )
     if added is None:  # BAILED: the device-work service failed

@@ -894,6 +894,10 @@ def test_engine_threaded_mux_matches_serial(monkeypatch):
     t_out, t_g, t_stats = run(8)
     assert (s_out, s_g) == (t_out, t_g)
     assert s_stats == t_stats, (s_stats, t_stats)
+    # The lever is a concurrency CAP (wave launches): 2 must give the
+    # identical result via 4 waves of 2 branches.
+    w_out, w_g, w_stats = run(2)
+    assert (s_out, s_g, s_stats) == (w_out, w_g, w_stats)
     # The mux branches really serviced device work: the root plus each
     # first-level branch runs a pivot 5-LUT sweep.
     assert s_stats["engine_devcalls"] >= 9
