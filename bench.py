@@ -54,6 +54,9 @@ REPEATS = 2 if SMOKE else 3
 # sweep is embarrassingly parallel with no cross-rank traffic until a
 # hit, so linear is the right model).
 SOCKET_CORES = 64
+# Per-entry watchdog budget (main path): generous vs the slowest healthy
+# entry; entries that legitimately run longer pass budget= explicitly.
+ENTRY_BUDGET_S = 900.0
 
 
 def _spread(fn, n=REPEATS):
@@ -1576,41 +1579,126 @@ def main() -> None:
         if final:
             os.replace(partial, os.path.join(HERE, f"{name}.json"))
 
-    def run(fn, *a, **k):
+    def _headline_line():
+        """The ONE driver-facing JSON line, computed from whatever
+        entries have been captured so far (so the watchdog can emit it
+        from a partial run)."""
+        dev = cpu_rate = float("nan")
+        for e in detail:
+            if e.get("metric") == f"lut5_sweep_g{G_HEAD}" and "value" in e:
+                dev = e["value"]
+            if e.get("metric") == "cpu_core_lut5" and "value" in e:
+                cpu_rate = e["value"]
+        finite = dev == dev and cpu_rate == cpu_rate and cpu_rate > 0
+        return {
+            "metric": "lut5_candidates_per_sec_per_chip_aes",
+            "value": round(dev, 1) if dev == dev else None,
+            "unit": "candidates/s",
+            "vs_baseline": round(dev / cpu_rate, 3) if finite else None,
+        }
+
+    # Mid-run tunnel death watchdog (observed live in round 4: the
+    # start-of-run probe passed, the first four entries captured, then
+    # the tunnel dropped and the fifth entry's RPC blocked FOREVER —
+    # XLA device calls are not interruptible, so without this the
+    # whole run, partial capture and headline included, would hang past
+    # the driver's timeout and record null).  Each run() arms a
+    # per-entry deadline; a daemon thread watches it, and on breach
+    # salvages the partial capture to BENCH_ABORTED.json, prints the
+    # headline line from the entries already captured, and _exits (the
+    # only way out of a blocked RPC).
+    import threading
+
+    watchdog = {"deadline": None, "entry": ""}
+    # Serializes detail/flush between the main thread and the watchdog:
+    # without it, an entry finishing right at its budget races run()'s
+    # finally-flush against the salvage flush on the same .partial file
+    # (interleaved json.dump = corrupt file, plus an abort of a run
+    # that had just recovered).
+    wd_lock = threading.Lock()
+
+    def _watch():
+        while True:
+            time.sleep(10)
+            d = watchdog["deadline"]
+            if d is not None and time.time() > d:
+                with wd_lock:
+                    # Re-check under the lock: the entry may have
+                    # completed (and disarmed) while we acquired it.
+                    d = watchdog["deadline"]
+                    if d is None or time.time() <= d:
+                        continue
+                    detail.append({
+                        "metric": watchdog["entry"],
+                        "error": "entry exceeded its watchdog budget "
+                                 "(tunnel died mid-run?); run aborted, "
+                                 "partial capture salvaged",
+                    })
+                    flush()
+                    with open(
+                        os.path.join(HERE, "BENCH_ABORTED.json"), "w"
+                    ) as f:
+                        json.dump(detail, f, indent=1)
+                    line = _headline_line()
+                    line["error"] = (
+                        f"aborted: {watchdog['entry']} hung past its "
+                        "budget; captured entries in BENCH_ABORTED.json"
+                    )
+                    print(json.dumps(line), flush=True)
+                    os._exit(2)
+
+    threading.Thread(target=_watch, daemon=True).start()
+
+    def run(fn, *a, budget=ENTRY_BUDGET_S, **k):
         t0 = time.perf_counter()
+        watchdog["entry"] = fn.__name__
+        watchdog["deadline"] = time.time() + budget
         try:
             r = fn(*a, **k)
-            detail.extend(r if isinstance(r, list) else [r])
-            return r
         except Exception as e:  # record, never break the headline line
-            detail.append({"metric": fn.__name__, "error": repr(e)})
-            return None
-        finally:
-            flush()
-            print(
-                f"[bench] {fn.__name__}: {time.perf_counter() - t0:.1f}s",
-                file=sys.stderr,
-            )
+            with wd_lock:
+                watchdog["deadline"] = None
+                detail.append({"metric": fn.__name__, "error": repr(e)})
+                flush()
+            r = None
+        else:
+            with wd_lock:
+                watchdog["deadline"] = None
+                detail.extend(r if isinstance(r, list) else [r])
+                flush()
+        print(
+            f"[bench] {fn.__name__}: {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+        return r
 
-    cpu = run(bench_cpu_baseline)
-    head = run(bench_lut5_device, G_HEAD)
+    run(bench_cpu_baseline)
+    run(bench_lut5_device, G_HEAD)
     run(bench_pivot_tile_batch)
     run(bench_lut5_g500_slice)
     run(bench_gate_mode_sweeps)
     run(bench_lut7)
     best = None
+    watchdog["entry"], watchdog["deadline"] = (
+        "des_s1_bit0_lut", time.time() + ENTRY_BUDGET_S,
+    )
     try:
         entry, best = bench_des_s1_lut()
-        detail.append(entry)
+        with wd_lock:
+            watchdog["deadline"] = None
+            detail.append(entry)
+            flush()
     except Exception as e:
-        detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
-    flush()
+        with wd_lock:
+            watchdog["deadline"] = None
+            detail.append({"metric": "des_s1_bit0_lut", "error": repr(e)})
+            flush()
     run(bench_des_s1_sat_not)
     run(bench_des_s1_full_graph)
     run(bench_des_s1_outputs_batched)
     run(bench_lut7_break_even)
     run(bench_lut7_capped_search)
-    run(bench_engine_pivot_ab)
+    run(bench_engine_pivot_ab, budget=1800.0)
     run(bench_engine_mux_threads)
     run(bench_batch_axis_pivot)
     run(bench_multibox_des)
@@ -1620,25 +1708,11 @@ def main() -> None:
     if not SMOKE:
         # Already-validated CPU-subprocess entries (~30 min); the smoke
         # run's job is the chip-path code above.
-        run(bench_mesh_scaling)
-        run(bench_gather_compaction)
+        run(bench_mesh_scaling, budget=3600.0)
+        run(bench_gather_compaction, budget=1800.0)
     flush(final=True)
 
-    dev = head["value"] if head else float("nan")
-    cpu_entry = cpu[0] if isinstance(cpu, list) else cpu
-    cpu_rate = cpu_entry["value"] if cpu_entry else float("nan")
-    finite = dev == dev and cpu_rate == cpu_rate and cpu_rate > 0
-    vs = dev / cpu_rate if finite else None
-    print(
-        json.dumps(
-            {
-                "metric": "lut5_candidates_per_sec_per_chip_aes",
-                "value": round(dev, 1) if dev == dev else None,
-                "unit": "candidates/s",
-                "vs_baseline": round(vs, 3) if vs is not None else None,
-            }
-        )
-    )
+    print(json.dumps(_headline_line()))
 
 
 if __name__ == "__main__":

@@ -40,14 +40,47 @@ import jax.numpy as jnp
 # Default VMEM block: (64 lows x 128 highs) keeps the per-block int32
 # count matrices at 2 x 1 MB plus ~0.5 MB of operands — well under the
 # ~16 MB/core VMEM budget including pipeline double-buffering.
+# SBG_PALLAS_BLOCK="BLxBH" overrides (an on-chip A/B lever: bigger
+# blocks amortize the per-block operand unpack, at more VMEM).
 BLOCK_LOW = 64
 BLOCK_HIGH = 128
 
 
+def block_shape() -> tuple:
+    """The kernel's (block_low, block_high) — env-tunable for the
+    on-chip A/B (``SBG_PALLAS_BLOCK=128x128`` etc.).  Validates here so
+    a bad value fails at the lever, not as a shape assert deep inside
+    the jitted sweep."""
+    import os
+
+    v = os.environ.get("SBG_PALLAS_BLOCK")
+    if not v:
+        return BLOCK_LOW, BLOCK_HIGH
+    try:
+        bl_s, bh_s = v.lower().split("x")
+        bl, bh = int(bl_s), int(bh_s)
+    except ValueError:
+        raise ValueError(
+            f"SBG_PALLAS_BLOCK={v!r}: expected 'BLxBH', e.g. '64x128'"
+        ) from None
+    if bl <= 0 or bh <= 0 or bl & (bl - 1) or bh & (bh - 1):
+        raise ValueError(
+            f"SBG_PALLAS_BLOCK={v!r}: BL and BH must be positive powers "
+            "of two (tile shapes are powers of two, so any other value "
+            "cannot divide them)"
+        )
+    return bl, bh
+
+
 def _unpack_bits_i8(x):
-    """[..., W] uint32 -> [..., W*32] int8 of 0/1 bits (LSB-first); the
-    in-kernel twin of sweeps._expand_bits_i8."""
-    b = (x[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    """[..., W] int32 words -> [..., W*32] int8 of 0/1 bits (LSB-first);
+    the in-kernel twin of sweeps._expand_bits_i8.  All-int32 on purpose:
+    Mosaic does not implement unsigned-integer reductions (or several
+    other uint ops) on TPU, so the kernel computes in int32 throughout
+    and the caller bitcasts at the uint32 boundary.  The arithmetic
+    shift right sign-extends for bit 31, but the ``& 1`` keeps only the
+    extracted bit, so the unpack is exact for all 32 positions."""
+    b = (x[..., :, None] >> jnp.arange(32, dtype=jnp.int32)) & jnp.int32(1)
     return b.astype(jnp.int8).reshape(x.shape[:-1] + (x.shape[-1] * 32,))
 
 
@@ -73,16 +106,19 @@ def pivot_constraints_pallas(
     def kernel(l1_ref, l0_ref, hc_ref, pm_ref, r1_ref, r0_ref):
         pm = pm_ref[:]                       # [2, 256] i8
         hb = _unpack_bits_i8(hc_ref[:])      # [4, bh, 256] i8
-        rhs = hb.reshape(4 * bh, 256).T      # [256, 4*bh]
+        rhs = hb.reshape(4 * bh, 256)        # [4*bh, 256]
         # (s, j, c2) -> packed cell bit (j << 3) | (s << 2) | c2, the
         # shared 32-cell key order (sweeps._PIVOT_CELLBITS) — built with
         # iotas because pallas kernels cannot capture array constants.
         shp = (2, 4, 1, 4, 1)
-        s_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 0)
-        j_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 1)
-        c_i = jax.lax.broadcasted_iota(jnp.uint32, shp, 3)
+        s_i = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+        j_i = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+        c_i = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
         sh = (j_i << 3) | (s_i << 2) | c_i
-        dn = (((1,), (0,)), ((), ()))
+        # Contract both operands on their trailing 256-position axis
+        # ([M,256] x [N,256] -> [M,N]) so no transposed copy of the rhs
+        # is ever materialized in VMEM.
+        dn = (((1,), (1,)), ((), ()))
 
         def packed(lref):
             lb = _unpack_bits_i8(lref[:])    # [4, bl, 256] i8
@@ -90,15 +126,20 @@ def pivot_constraints_pallas(
             c = jax.lax.dot_general(
                 lhs, rhs, dn, preferred_element_type=jnp.int32
             ).reshape(2, 4, bl, 4, bh)
-            bits = (c > 0).astype(jnp.uint32)
-            # cell bits are disjoint: the sum over the 32 (s, j, c2)
-            # terms is exactly the bitwise OR
-            return (bits << sh).sum(axis=(0, 1, 3)).astype(jnp.uint32)
+            bits = (c > 0).astype(jnp.int32)
+            # cell bits are disjoint, so the int32 sum over the 32
+            # (s, j, c2) terms never carries and equals the bitwise OR —
+            # including the sign bit (cell 31), which two's-complement
+            # addition of disjoint patterns still lands exactly.
+            return (bits << sh).sum(axis=(0, 1, 3))
 
         r1_ref[:] = packed(l1_ref)
         r0_ref[:] = packed(l0_ref)
 
     grid = (tl // bl, th // bh)
+    # int32 in/out of the kernel (Mosaic's integer path), bitcast at the
+    # uint32 boundary on both sides — bit-identical words either way.
+    as_i32 = lambda a: jax.lax.bitcast_convert_type(a, jnp.int32)
     req1, req0 = pl.pallas_call(
         kernel,
         grid=grid,
@@ -113,9 +154,12 @@ def pivot_constraints_pallas(
             pl.BlockSpec((bl, bh), lambda i, j: (i, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((tl, th), jnp.uint32),
-            jax.ShapeDtypeStruct((tl, th), jnp.uint32),
+            jax.ShapeDtypeStruct((tl, th), jnp.int32),
+            jax.ShapeDtypeStruct((tl, th), jnp.int32),
         ],
         interpret=interpret,
-    )(l1, l0, hcs, pmsel)
-    return req1, req0
+    )(as_i32(l1), as_i32(l0), as_i32(hcs), pmsel)
+    return (
+        jax.lax.bitcast_convert_type(req1, jnp.uint32),
+        jax.lax.bitcast_convert_type(req0, jnp.uint32),
+    )

@@ -841,11 +841,13 @@ def _pivot_tile_from_packed(ops, tl, th):
     constraint words to _pivot_tile_from_operands (parity-tested)."""
     import jax as _jax
 
-    from .pallas_pivot import pivot_constraints_pallas
+    from .pallas_pivot import block_shape, pivot_constraints_pallas
 
     l1, l0, hcs, pmsel, valid = ops
+    bl, bh = block_shape()
     req1, req0 = pivot_constraints_pallas(
         l1, l0, hcs, pmsel, tl=tl, th=th,
+        bl=min(bl, tl), bh=min(bh, th),
         interpret=_jax.default_backend() == "cpu",
     )
     conflict = (req1 & req0) != 0

@@ -234,14 +234,28 @@ def pivot_tile_batch() -> int:
 
 
 def pivot_pipeline() -> bool:
-    """Double-buffer pivot tile operands (SBG_PIVOT_PIPELINE, default 1):
-    the stream loop carries the next round's int8 expansion so the TPU
-    scheduler can overlap that VPU/memory work with the current round's
-    MXU matmuls (ROOFLINE.md lever 1).  Bit-identical results either
-    way; set SBG_PIVOT_PIPELINE=0 for the A/B baseline."""
+    """Double-buffer pivot tile operands (SBG_PIVOT_PIPELINE): the
+    stream loop carries the next round's int8 expansion so the backend
+    can overlap that VPU/memory work with the current round's MXU
+    matmuls (ROOFLINE.md lever 1).  Bit-identical results either way.
+
+    The default is BACKEND-DEPENDENT because the round-4 A/B measured
+    opposite signs: on the v5e chip the carried operands LOSE 1.9x
+    (1.51 G vs 2.88 G cand/s, bench_pivot_tile_batch G=200 — the extra
+    live tile doubles the HBM working set and the hoped-for scheduler
+    overlap never materializes), while on XLA:CPU they WIN ~14x
+    (2.5 M vs 0.17 M cand/s, G=80 — the carried expansion breaks the
+    tile body into loop-invariant pieces XLA:CPU vectorizes far
+    better).  So: TPU default off, CPU default on; the env var
+    overrides either way."""
     import os
 
-    return os.environ.get("SBG_PIVOT_PIPELINE", "1") != "0"
+    v = os.environ.get("SBG_PIVOT_PIPELINE")
+    if v is not None:
+        return v != "0"
+    import jax
+
+    return jax.default_backend() != "tpu"
 
 
 def pivot_backend() -> str:
