@@ -46,30 +46,36 @@ BLOCK_LOW = 64
 BLOCK_HIGH = 128
 
 
-def block_shape() -> tuple:
-    """The kernel's (block_low, block_high) — env-tunable for the
-    on-chip A/B (``SBG_PALLAS_BLOCK=128x128`` etc.).  Validates here so
-    a bad value fails at the lever, not as a shape assert deep inside
-    the jitted sweep."""
-    import os
-
-    v = os.environ.get("SBG_PALLAS_BLOCK")
-    if not v:
-        return BLOCK_LOW, BLOCK_HIGH
+def parse_block(v: str, source: str = "SBG_PALLAS_BLOCK") -> tuple:
+    """Parse + validate a 'BLxBH' block spec (shared by the env lever
+    and the ``backend="pallas:BLxBH"`` stream variant).  Validates here
+    so a bad value fails at the lever, not as a shape assert deep
+    inside the jitted sweep."""
     try:
         bl_s, bh_s = v.lower().split("x")
         bl, bh = int(bl_s), int(bh_s)
     except ValueError:
         raise ValueError(
-            f"SBG_PALLAS_BLOCK={v!r}: expected 'BLxBH', e.g. '64x128'"
+            f"{source}={v!r}: expected 'BLxBH', e.g. '64x128'"
         ) from None
     if bl <= 0 or bh <= 0 or bl & (bl - 1) or bh & (bh - 1):
         raise ValueError(
-            f"SBG_PALLAS_BLOCK={v!r}: BL and BH must be positive powers "
+            f"{source}={v!r}: BL and BH must be positive powers "
             "of two (tile shapes are powers of two, so any other value "
             "cannot divide them)"
         )
     return bl, bh
+
+
+def block_shape() -> tuple:
+    """The kernel's default (block_low, block_high) — env-tunable for
+    the on-chip A/B (``SBG_PALLAS_BLOCK=128x128`` etc.)."""
+    import os
+
+    v = os.environ.get("SBG_PALLAS_BLOCK")
+    if not v:
+        return BLOCK_LOW, BLOCK_HIGH
+    return parse_block(v)
 
 
 def _unpack_bits_i8(x):

@@ -836,15 +836,17 @@ def _pivot_tile_packed_operands(
     return l1, l0, hcs, pmsel, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
 
 
-def _pivot_tile_from_packed(ops, tl, th):
+def _pivot_tile_from_packed(ops, tl, th, block=None):
     """Pallas-backend matmul half: the fused VMEM kernel; bit-identical
-    constraint words to _pivot_tile_from_operands (parity-tested)."""
+    constraint words to _pivot_tile_from_operands (parity-tested).
+    ``block`` overrides the kernel's (bl, bh) VMEM block; None follows
+    the SBG_PALLAS_BLOCK lever."""
     import jax as _jax
 
     from .pallas_pivot import block_shape, pivot_constraints_pallas
 
     l1, l0, hcs, pmsel, valid = ops
-    bl, bh = block_shape()
+    bl, bh = block if block is not None else block_shape()
     req1, req0 = pivot_constraints_pallas(
         l1, l0, hcs, pmsel, tl=tl, th=th,
         bl=min(bl, tl), bh=min(bh, th),
@@ -987,6 +989,17 @@ def lut5_pivot_stream(
     t_end = jnp.asarray(t_end, jnp.int32)
     z = jnp.int32(0)
     t_clamp = jnp.int32(descs.shape[0] - 1)
+    # "pallas:BLxBH" pins the kernel's VMEM block per-call (a STATIC arg,
+    # so each block shape is its own jit cache entry — an env var alone
+    # would be baked into whichever trace compiled first).
+    pallas_block = None
+    if backend.startswith("pallas:"):
+        from .pallas_pivot import parse_block
+
+        pallas_block = parse_block(
+            backend[len("pallas:"):], source="backend"
+        )
+        backend = "pallas"
     if backend not in ("xla", "pallas"):
         raise ValueError(f"unknown pivot backend {backend!r}")
     if backend == "pallas" and tile_batch != 1:
@@ -998,7 +1011,8 @@ def lut5_pivot_stream(
             else _pivot_tile_operands
         )
         tile_from_ops = (
-            _pivot_tile_from_packed if backend == "pallas"
+            functools.partial(_pivot_tile_from_packed, block=pallas_block)
+            if backend == "pallas"
             else _pivot_tile_from_operands
         )
 

@@ -533,6 +533,18 @@ def test_pivot_pallas_backend_bit_identical():
                 )
             )
             assert (base == got).all(), (tl, th, pipeline, base, got)
+        # The "pallas:BLxBH" static block variant (the bench's on-chip
+        # block-shape ladder) must hit the same bits as the default
+        # block — one non-default shape at the small tile suffices to
+        # cover the parse + partial plumbing.
+        if (tl, th) == (256, 512):
+            got = np.asarray(
+                sweeps.lut5_pivot_stream(
+                    *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
+                    backend="pallas:128x128",
+                )
+            )
+            assert (base == got).all(), (tl, th, "pallas:128x128")
         assert int(base[0]) == 1  # the planted decomposition was found
 
 
