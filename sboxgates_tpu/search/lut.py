@@ -445,7 +445,10 @@ def _lut5_search_pivot(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
                 jw, jm, ctx.next_seed(), tl=tl, th=th,
-                tile_batch=1 if backend == "pallas" else pivot_tile_batch(),
+                tile_batch=(
+                    1 if backend.startswith("pallas")
+                    else pivot_tile_batch()
+                ),
                 pipeline=pivot_pipeline(), backend=backend,
             )
         )

@@ -1,0 +1,59 @@
+"""The bench watchdog's breach path: a hung entry (the observed failure
+mode — tunnel dies mid-run, XLA RPC blocks forever) must salvage the
+partial capture and still print the driver-facing headline line.
+
+The no-breach path is exercised by every SBG_BENCH_SMOKE run; this test
+forces a breach by monkeypatching a bench entry into an infinite sleep
+with a tiny budget, in a subprocess (the watchdog exits via os._exit).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_watchdog_salvages_partial_and_prints_headline(tmp_path):
+    code = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["SBG_BENCH_SMOKE"] = "1"
+import bench
+
+bench.HERE = {out!r}          # keep salvage artifacts out of the repo
+bench.ENTRY_BUDGET_S = 1.0    # breach fast
+
+def hang():
+    # Stands in for a blocked device RPC: never returns, not
+    # interruptible by anything but process exit.
+    while True:
+        time.sleep(1)
+
+# First entry hangs; nothing else should ever run.
+bench.bench_cpu_baseline = hang
+bench.main()
+"""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         code.format(repo=os.path.dirname(HERE), out=str(tmp_path))],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    # Watchdog exit, not a hang and not a clean completion.
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    # The salvage file exists and records the aborted entry.
+    aborted = json.load(open(tmp_path / "BENCH_ABORTED.json"))
+    assert any("watchdog" in e.get("error", "") for e in aborted), aborted
+    # The driver-facing line is still a single valid JSON object with
+    # the headline metric name and an abort explanation.
+    lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
+    assert lines, r.stdout
+    head = json.loads(lines[-1])
+    assert head["metric"] == "lut5_candidates_per_sec_per_chip_aes"
+    assert head["value"] is None  # the headline entry never ran
+    assert "aborted" in head["error"]
+    # A breached smoke run must never promote its partial file to the
+    # completed BENCH_SMOKE.json.
+    assert not (tmp_path / "BENCH_SMOKE.json").exists()
