@@ -1,0 +1,63 @@
+"""Solution-quality parity vs the reference's showcased example.
+
+The reference README's one concrete quality figure is a 19-gate circuit
+for DES S1 output bit 0 (9 XOR, 4 AND, 3 OR, 3 NOT_A_AND_B — reference
+des_s1_bit0.svg, shown at README.md:33-34).  This framework's search
+finds a 17-gate circuit for the same target with the same gate family
+(gate-availability bitfield 214 = AND | ANDNOT both forms | XOR | OR).
+Both the committed artifact and its deterministic reproduction are
+checked, so the claim stays verifiable at head.
+"""
+
+import os
+
+import numpy as np
+
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import NO_GATE, State
+from sboxgates_tpu.graph.xmlio import load_state
+from sboxgates_tpu.utils.sbox import load_sbox
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+ARTIFACT = os.path.join(REPO, "examples", "des_s1_bit0_17gates.xml")
+
+
+def _target_and_mask():
+    sbox, n = load_sbox(os.path.join(REPO, "sboxes", "des_s1.txt"))
+    assert n == 6
+    return np.asarray(tt.target_table(sbox, 0)), np.asarray(tt.mask_table(6))
+
+
+def test_17_gate_artifact_is_correct_and_beats_reference_example():
+    target, mask = _target_and_mask()
+    st = load_state(ARTIFACT)
+    out = st.outputs[0]
+    assert out != NO_GATE
+    got = np.asarray(st.tables[out])
+    assert np.array_equal(got & mask, target & mask)
+    gates = st.num_gates - st.num_inputs
+    assert gates == 17  # reference showcase: 19
+    # Same gate family as the showcase (no free NOTs, no exotic funcs).
+    from sboxgates_tpu.core import boolfunc as bf
+
+    allowed = {bf.AND, bf.A_AND_NOT_B, bf.NOT_A_AND_B, bf.XOR, bf.OR}
+    used = {st.gates[i].type for i in range(st.num_inputs, st.num_gates)}
+    assert used <= allowed, used
+
+
+def test_17_gate_circuit_reproduces_from_seed():
+    """The artifact is not a lucky one-off: seed 18 under a 24-node
+    budget re-derives a 17-gate solution deterministically."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    target, mask = _target_and_mask()
+    st = State.init_inputs(6)
+    st.max_gates = 24
+    ctx = SearchContext(Options(seed=18, avail_gates_bitfield=214))
+    out = create_circuit(ctx, st, target, mask, [])
+    assert out != NO_GATE
+    assert st.num_gates - st.num_inputs == 17
+    got = np.asarray(st.tables[out])
+    assert np.array_equal(got & mask, target & mask)
