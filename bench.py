@@ -1666,17 +1666,13 @@ def main() -> None:
         watchdog["deadline"] = time.time() + budget
         try:
             r = fn(*a, **k)
+            entries = r if isinstance(r, list) else [r]
         except Exception as e:  # record, never break the headline line
-            with wd_lock:
-                watchdog["deadline"] = None
-                detail.append({"metric": fn.__name__, "error": repr(e)})
-                flush()
-            r = None
-        else:
-            with wd_lock:
-                watchdog["deadline"] = None
-                detail.extend(r if isinstance(r, list) else [r])
-                flush()
+            r, entries = None, [{"metric": fn.__name__, "error": repr(e)}]
+        with wd_lock:
+            watchdog["deadline"] = None
+            detail.extend(entries)
+            flush()
         print(
             f"[bench] {fn.__name__}: {time.perf_counter() - t0:.1f}s",
             file=sys.stderr,
