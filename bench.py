@@ -155,15 +155,20 @@ def bench_pivot_tile_batch() -> dict:
     variants = [
         (1, False, "xla"), (1, True, "xla"), (2, False, "xla"),
         (2, True, "xla"), (4, False, "xla"), (4, True, "xla"),
-        # pallas at its default block plus the block-shape ladder — each
-        # "pallas:BLxBH" is a distinct static jit config, so one tunnel
-        # window captures the whole kernel tuning surface.  The ladder
-        # is chip-only: in smoke the kernel runs INTERPRETED (minutes
-        # per sweep) and one pallas variant already covers the path.
-        (1, False, "pallas"), (1, True, "pallas"),
+        # pallas (fused unpack) and pallas_pre (pre-expanded operands,
+        # the minimal-Mosaic-surface hedge) at their default blocks,
+        # plus the block-shape ladder — each "pallas[_pre]:BLxBH" is a
+        # distinct static jit config, so one tunnel window captures the
+        # whole kernel tuning surface.  The ladder is chip-only: in
+        # smoke the kernels run INTERPRETED (minutes per sweep) and one
+        # variant of each already covers the paths.
+        (1, False, "pallas"), (1, False, "pallas_pre"),
     ] + ([] if SMOKE else [
+        (1, True, "pallas"),
         (1, False, "pallas:128x128"), (1, False, "pallas:128x256"),
-        (1, False, "pallas:256x128"),
+        (1, False, "pallas_pre:128x128"),
+        (1, False, "pallas_pre:128x256"),
+        (1, False, "pallas_pre:256x256"),
     ])
 
     def vkey(v):

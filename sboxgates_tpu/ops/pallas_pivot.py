@@ -113,14 +113,7 @@ def pivot_constraints_pallas(
         pm = pm_ref[:]                       # [2, 256] i8
         hb = _unpack_bits_i8(hc_ref[:])      # [4, bh, 256] i8
         rhs = hb.reshape(4 * bh, 256)        # [4*bh, 256]
-        # (s, j, c2) -> packed cell bit (j << 3) | (s << 2) | c2, the
-        # shared 32-cell key order (sweeps._PIVOT_CELLBITS) — built with
-        # iotas because pallas kernels cannot capture array constants.
-        shp = (2, 4, 1, 4, 1)
-        s_i = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
-        j_i = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
-        c_i = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
-        sh = (j_i << 3) | (s_i << 2) | c_i
+        sh = _cellbit_shifts()
         # Contract both operands on their trailing 256-position axis
         # ([M,256] x [N,256] -> [M,N]) so no transposed copy of the rhs
         # is ever materialized in VMEM.
@@ -165,6 +158,86 @@ def pivot_constraints_pallas(
         ],
         interpret=interpret,
     )(as_i32(l1), as_i32(l0), as_i32(hcs), pmsel)
+    return (
+        jax.lax.bitcast_convert_type(req1, jnp.uint32),
+        jax.lax.bitcast_convert_type(req0, jnp.uint32),
+    )
+
+
+def _cellbit_shifts():
+    """(s, j, c2) -> packed cell bit (j << 3) | (s << 2) | c2 — the
+    shared 32-cell key order (sweeps._PIVOT_CELLBITS), built with iotas
+    because pallas kernels cannot capture array constants."""
+    shp = (2, 4, 1, 4, 1)
+    s_i = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+    j_i = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+    c_i = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+    return (j_i << 3) | (s_i << 2) | c_i
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tl", "th", "bl", "bh", "interpret")
+)
+def pivot_constraints_pallas_pre(
+    lhs1, lhs0, rhsb, *, tl, th, bl=BLOCK_LOW, bh=BLOCK_HIGH,
+    interpret=False,
+):
+    """The PRE-EXPANDED variant of the fused tile kernel: operands are
+    already int8 bit lanes (built by the XLA expansion half the plain
+    backend uses), and the kernel only runs the MXU matmuls and packs
+    the constraint words in VMEM.  Rationale: the count matrices
+    (2 x 32 MB per 512 x 512 tile) are what the roofline shows the XLA
+    path is bound on; keeping just THOSE in VMEM cuts per-tile HBM
+    traffic ~14x while giving Mosaic the smallest possible kernel
+    surface (one dot_general + compare + shift-sum — no in-kernel
+    unpack, no lane-dimension reshapes).  A lowering hedge for the
+    fully-fused kernel above, and its A/B sibling on silicon.
+
+    ``lhs1``/``lhs0``: int8[2, 4, tl, 256] polarity-masked low-cell
+    lanes; ``rhsb``: int8[4, th, 256] high-cell lanes.  Returns
+    (req1, req0) uint32[tl, th], bit-identical to both other backends.
+    """
+    from jax.experimental import pallas as pl
+
+    assert tl % bl == 0 and th % bh == 0, (tl, th, bl, bh)
+
+    def kernel(l1_ref, l0_ref, rhs_ref, r1_ref, r0_ref):
+        # Leading-dims merge only (lane dim 256 untouched).
+        rhs = rhs_ref[:].reshape(4 * bh, 256)
+        sh = _cellbit_shifts()
+        dn = (((1,), (1,)), ((), ()))
+
+        def packed(lref):
+            lhs = lref[:].reshape(2 * 4 * bl, 256)
+            c = jax.lax.dot_general(
+                lhs, rhs, dn, preferred_element_type=jnp.int32
+            ).reshape(2, 4, bl, 4, bh)
+            bits = (c > 0).astype(jnp.int32)
+            # disjoint cell bits: int32 sum == bitwise OR (see above)
+            return (bits << sh).sum(axis=(0, 1, 3))
+
+        r1_ref[:] = packed(l1_ref)
+        r0_ref[:] = packed(l0_ref)
+
+    grid = (tl // bl, th // bh)
+    req1, req0 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((2, 4, bl, 256), lambda i, j: (0, 0, i, 0)),
+            pl.BlockSpec((2, 4, bl, 256), lambda i, j: (0, 0, i, 0)),
+            pl.BlockSpec((4, bh, 256), lambda i, j: (0, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bl, bh), lambda i, j: (i, j)),
+            pl.BlockSpec((bl, bh), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tl, th), jnp.int32),
+            jax.ShapeDtypeStruct((tl, th), jnp.int32),
+        ],
+        interpret=interpret,
+    )(lhs1, lhs0, rhsb)
     return (
         jax.lax.bitcast_convert_type(req1, jnp.uint32),
         jax.lax.bitcast_convert_type(req0, jnp.uint32),

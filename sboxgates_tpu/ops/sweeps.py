@@ -751,13 +751,7 @@ def _pivot_tile_operands(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
     the validity mask.  Pure VPU/memory work — factored from the matmul
     half so the pipelined stream can overlap tile t+1's expansion with
     tile t's MXU pass (ROOFLINE.md lever 1)."""
-    m, lo0, lo_end, hi0, hi_end = d[0], d[1], d[2], d[3], d[4]
-    pm = tables[m]
-    l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
-    l0 = jax.lax.dynamic_slice(lc0, (0, lo0, 0), (4, tl, lc0.shape[2]))
-    hcs = jax.lax.dynamic_slice(hc, (0, hi0, 0), (4, th, hc.shape[2]))
-    pmb = _expand_bits_i8(pm)                    # [256]
-    pmsel = jnp.stack([1 - pmb, pmb])            # [2, 256]: sbit=0 -> ~pm
+    l1, l0, hcs, pmsel = _pivot_tile_slices(tables, lc1, lc0, hc, d, tl, th)
     l1b = _expand_bits_i8(l1)                    # [4, tl, 256]
     l0b = _expand_bits_i8(l0)
     hb = _expand_bits_i8(hcs)                    # [4, th, 256]
@@ -821,39 +815,78 @@ def _pivot_tile_valid(lowvalid, highvalid, d, tl, th):
     return lv[:, None] & hv[None, :]
 
 
+def _pivot_tile_slices(tables, lc1, lc0, hc, d, tl, th):
+    """The packed uint32 tile slices + pivot polarity selectors shared
+    by every backend's operand half."""
+    m, lo0, hi0 = d[0], d[1], d[3]
+    l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
+    l0 = jax.lax.dynamic_slice(lc0, (0, lo0, 0), (4, tl, lc0.shape[2]))
+    hcs = jax.lax.dynamic_slice(hc, (0, hi0, 0), (4, th, hc.shape[2]))
+    pmb = _expand_bits_i8(tables[m])
+    pmsel = jnp.stack([1 - pmb, pmb])            # [2, 256]: sbit=0 -> ~pm
+    return l1, l0, hcs, pmsel
+
+
 def _pivot_tile_packed_operands(
     tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
 ):
     """Pallas-backend operand half: only the PACKED uint32 slices and the
     pivot polarity selectors leave this stage — the int8 expansion
     happens inside the kernel's VMEM blocks (pallas_pivot module doc)."""
-    m, lo0, hi0 = d[0], d[1], d[3]
-    l1 = jax.lax.dynamic_slice(lc1, (0, lo0, 0), (4, tl, lc1.shape[2]))
-    l0 = jax.lax.dynamic_slice(lc0, (0, lo0, 0), (4, tl, lc0.shape[2]))
-    hcs = jax.lax.dynamic_slice(hc, (0, hi0, 0), (4, th, hc.shape[2]))
-    pmb = _expand_bits_i8(tables[m])
-    pmsel = jnp.stack([1 - pmb, pmb])
+    l1, l0, hcs, pmsel = _pivot_tile_slices(tables, lc1, lc0, hc, d, tl, th)
     return l1, l0, hcs, pmsel, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
 
 
-def _pivot_tile_from_packed(ops, tl, th, block=None):
-    """Pallas-backend matmul half: the fused VMEM kernel; bit-identical
-    constraint words to _pivot_tile_from_operands (parity-tested).
-    ``block`` overrides the kernel's (bl, bh) VMEM block; None follows
-    the SBG_PALLAS_BLOCK lever."""
+def _pivot_tile_expanded_operands(
+    tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
+):
+    """pallas_pre-backend operand half: the same int8 bit-lane expansion
+    the XLA path does, left in block-tileable [2, 4, tl, 256] /
+    [4, th, 256] layout (no flat reshape or transpose — the kernel
+    merges leading dims per VMEM block)."""
+    l1, l0, hcs, pmsel = _pivot_tile_slices(tables, lc1, lc0, hc, d, tl, th)
+    l1m = _expand_bits_i8(l1)[None] * pmsel[:, None, None, :]
+    l0m = _expand_bits_i8(l0)[None] * pmsel[:, None, None, :]
+    hb = _expand_bits_i8(hcs)                    # [4, th, 256]
+    return l1m, l0m, hb, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
+
+
+def _pivot_tile_from_kernel(ops, tl, th, block, kernel_fn):
+    """Shared pallas-backend matmul half: run ``kernel_fn`` (one of the
+    two pallas_pivot kernels, taking the backend's operand tuple) and
+    derive the shared feasibility verdict.  ``block`` overrides the
+    kernel's (bl, bh) VMEM block; None follows the SBG_PALLAS_BLOCK
+    lever.  Bit-identical constraint words to _pivot_tile_from_operands
+    (parity-tested)."""
     import jax as _jax
 
-    from .pallas_pivot import block_shape, pivot_constraints_pallas
+    from .pallas_pivot import block_shape
 
-    l1, l0, hcs, pmsel, valid = ops
+    *operands, valid = ops
     bl, bh = block if block is not None else block_shape()
-    req1, req0 = pivot_constraints_pallas(
-        l1, l0, hcs, pmsel, tl=tl, th=th,
+    req1, req0 = kernel_fn(
+        *operands, tl=tl, th=th,
         bl=min(bl, tl), bh=min(bh, th),
         interpret=_jax.default_backend() == "cpu",
     )
     conflict = (req1 & req0) != 0
     return valid, valid & ~conflict, req1, req0
+
+
+def _pivot_tile_from_packed(ops, tl, th, block=None):
+    """Fused-pallas matmul half (in-kernel unpack; pallas_pivot doc)."""
+    from .pallas_pivot import pivot_constraints_pallas
+
+    return _pivot_tile_from_kernel(ops, tl, th, block, pivot_constraints_pallas)
+
+
+def _pivot_tile_from_expanded(ops, tl, th, block=None):
+    """pallas_pre matmul half (pre-expanded operands; pallas_pivot doc)."""
+    from .pallas_pivot import pivot_constraints_pallas_pre
+
+    return _pivot_tile_from_kernel(
+        ops, tl, th, block, pivot_constraints_pallas_pre
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("tl", "th"))
@@ -989,31 +1022,36 @@ def lut5_pivot_stream(
     t_end = jnp.asarray(t_end, jnp.int32)
     z = jnp.int32(0)
     t_clamp = jnp.int32(descs.shape[0] - 1)
-    # "pallas:BLxBH" pins the kernel's VMEM block per-call (a STATIC arg,
-    # so each block shape is its own jit cache entry — an env var alone
-    # would be baked into whichever trace compiled first).
+    # "pallas[_pre]:BLxBH" pins the kernel's VMEM block per-call (a
+    # STATIC arg, so each block shape is its own jit cache entry — an
+    # env var alone would be baked into whichever trace compiled first).
     pallas_block = None
-    if backend.startswith("pallas:"):
+    if ":" in backend:
         from .pallas_pivot import parse_block
 
-        pallas_block = parse_block(
-            backend[len("pallas:"):], source="backend"
-        )
-        backend = "pallas"
-    if backend not in ("xla", "pallas"):
+        backend, _, spec = backend.partition(":")
+        if not backend.startswith("pallas"):
+            raise ValueError(
+                f"block spec {spec!r} only applies to pallas backends"
+            )
+        pallas_block = parse_block(spec, source="backend")
+    if backend not in ("xla", "pallas", "pallas_pre"):
         raise ValueError(f"unknown pivot backend {backend!r}")
-    if backend == "pallas" and tile_batch != 1:
-        raise ValueError("backend='pallas' requires tile_batch=1")
+    if backend != "xla" and tile_batch != 1:
+        raise ValueError(f"backend={backend!r} requires tile_batch=1")
 
     if tile_batch == 1:
-        tile_operands = (
-            _pivot_tile_packed_operands if backend == "pallas"
-            else _pivot_tile_operands
-        )
+        tile_operands = {
+            "pallas": _pivot_tile_packed_operands,
+            "pallas_pre": _pivot_tile_expanded_operands,
+        }.get(backend, _pivot_tile_operands)
         tile_from_ops = (
-            functools.partial(_pivot_tile_from_packed, block=pallas_block)
-            if backend == "pallas"
-            else _pivot_tile_from_operands
+            _pivot_tile_from_operands if backend == "xla"
+            else functools.partial(
+                _pivot_tile_from_packed if backend == "pallas"
+                else _pivot_tile_from_expanded,
+                block=pallas_block,
+            )
         )
 
         def operands(t):

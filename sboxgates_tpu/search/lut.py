@@ -261,10 +261,14 @@ def pivot_pipeline() -> bool:
 def pivot_backend() -> str:
     """Pivot tile constraint backend (SBG_PIVOT_BACKEND, default xla):
     ``pallas`` fuses unpack + matmul + constraint packing in VMEM blocks
-    (ops/pallas_pivot.py) so the per-tile int32 count matrices never
-    round-trip HBM.  Bit-identical results (parity-tested); defaults to
-    the measured xla path until the pallas kernel's on-chip A/B
-    (bench_pivot_tile_batch) lands.  Forces tile_batch=1."""
+    (ops/pallas_pivot.py) so the per-tile int32 count matrices — the
+    traffic the XLA path is measurably bound on (ROOFLINE.md) — never
+    round-trip HBM; ``pallas_pre`` keeps the XLA operand expansion and
+    fuses only matmul + packing (the minimal-Mosaic-surface hedge).
+    Either may carry a ``:BLxBH`` VMEM block suffix.  Bit-identical
+    results (parity-tested); defaults to the measured xla path until a
+    pallas variant's on-chip A/B (bench_pivot_tile_batch) lands.
+    Forces tile_batch=1."""
     import os
 
     return os.environ.get("SBG_PIVOT_BACKEND", "xla")

@@ -525,26 +525,28 @@ def test_pivot_pallas_backend_bit_identical():
                 *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th
             )
         )
-        for pipeline in (False, True):
-            got = np.asarray(
-                sweeps.lut5_pivot_stream(
-                    *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
-                    backend="pallas", pipeline=pipeline,
+        for backend in ("pallas", "pallas_pre"):
+            for pipeline in (False, True):
+                got = np.asarray(
+                    sweeps.lut5_pivot_stream(
+                        *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
+                        backend=backend, pipeline=pipeline,
+                    )
                 )
-            )
-            assert (base == got).all(), (tl, th, pipeline, base, got)
-        # The "pallas:BLxBH" static block variant (the bench's on-chip
-        # block-shape ladder) must hit the same bits as the default
-        # block — one non-default shape at the small tile suffices to
-        # cover the parse + partial plumbing.
+                assert (base == got).all(), (tl, th, backend, pipeline)
+        # The "pallas[_pre]:BLxBH" static block variants (the bench's
+        # on-chip block-shape ladder) must hit the same bits as the
+        # default block — one non-default shape at the small tile
+        # suffices to cover the parse + partial plumbing.
         if (tl, th) == (256, 512):
-            got = np.asarray(
-                sweeps.lut5_pivot_stream(
-                    *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
-                    backend="pallas:128x128",
+            for backend in ("pallas:128x128", "pallas_pre:128x128"):
+                got = np.asarray(
+                    sweeps.lut5_pivot_stream(
+                        *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th,
+                        backend=backend,
+                    )
                 )
-            )
-            assert (base == got).all(), (tl, th, "pallas:128x128")
+                assert (base == got).all(), (tl, th, backend)
         assert int(base[0]) == 1  # the planted decomposition was found
 
 
