@@ -1,0 +1,75 @@
+"""Contract tests for the pivot-stream tuning levers: the env-var
+semantics and backend-string forms the README advertises (and the bench
+A/B relies on) stay pinned here.
+"""
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.ops import sweeps
+from sboxgates_tpu.ops.pallas_pivot import parse_block
+
+
+def test_pivot_pipeline_env_and_backend_default(monkeypatch):
+    from sboxgates_tpu.search.lut import pivot_pipeline
+
+    # Explicit env wins in both directions.
+    monkeypatch.setenv("SBG_PIVOT_PIPELINE", "0")
+    assert pivot_pipeline() is False
+    monkeypatch.setenv("SBG_PIVOT_PIPELINE", "1")
+    assert pivot_pipeline() is True
+    # Unset: per-backend default — tests run on CPU (conftest pins it),
+    # where the measured sign says pipeline ON.
+    monkeypatch.delenv("SBG_PIVOT_PIPELINE", raising=False)
+    assert pivot_pipeline() is True
+
+
+def test_parse_block_contract():
+    assert parse_block("64x128") == (64, 128)
+    assert parse_block("128X256") == (128, 256)
+    with pytest.raises(ValueError, match="SBG_PALLAS_BLOCK"):
+        parse_block("banana")
+    with pytest.raises(ValueError, match="powers"):
+        parse_block("96x128")
+    with pytest.raises(ValueError, match="powers"):
+        parse_block("0x64")
+    # The bench's backend-string form names the right lever in errors.
+    with pytest.raises(ValueError, match="backend"):
+        parse_block("65x128", source="backend")
+
+
+def _stream_args_tiny():
+    """Minimal well-formed arguments for backend-validation calls (the
+    stream raises before tracing for bad static configs)."""
+    z8 = np.zeros((4, 8, 8), np.uint32)
+    return dict(
+        tables=np.zeros((16, 8), np.uint32), lc1=z8, lc0=z8, hc=z8,
+        lowvalid=np.zeros(8, bool), highvalid=np.zeros(8, bool),
+        descs=np.zeros((1, 5), np.int32), start_t=0, t_end=0,
+        w_tab=np.zeros((10, 8), np.int32),
+        m_tab=np.zeros((10, 8), np.int32), seed=1,
+    )
+
+
+def test_stream_backend_validation():
+    a = _stream_args_tiny()
+
+    def call(**kw):
+        sweeps.lut5_pivot_stream(
+            a["tables"], a["lc1"], a["lc0"], a["hc"], a["lowvalid"],
+            a["highvalid"], a["descs"], a["start_t"], a["t_end"],
+            a["w_tab"], a["m_tab"], a["seed"], tl=8, th=8, **kw,
+        )
+
+    with pytest.raises(ValueError, match="unknown pivot backend"):
+        call(backend="cuda")
+    with pytest.raises(ValueError, match="tile_batch=1"):
+        call(backend="pallas", tile_batch=2)
+    with pytest.raises(ValueError, match="tile_batch=1"):
+        call(backend="pallas_pre:128x128", tile_batch=2)
+    with pytest.raises(ValueError, match="only applies to pallas"):
+        call(backend="xla:64x128")
+    with pytest.raises(ValueError, match="backend"):
+        call(backend="pallas:65x128")
+    with pytest.raises(ValueError, match="unknown pivot backend"):
+        call(backend="pallasx:64x128")
