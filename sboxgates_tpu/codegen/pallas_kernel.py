@@ -13,7 +13,7 @@ the VPU the same way LOP3 chains map to the CUDA integer pipe.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 from ..core import boolfunc as bf
 from ..core import ttable as tt
@@ -23,17 +23,24 @@ from .executor import output_bits
 BLOCK = 1024  # words per grid step; 32k evaluations per block
 
 
-def compile_pallas(st: State, block: int = BLOCK, interpret: bool = False) -> Callable:
+def compile_pallas(
+    st: State, block: int = BLOCK, interpret: Optional[bool] = None
+) -> Callable:
     """Builds ``fn(inputs) -> outputs`` backed by a Pallas TPU kernel.
 
     ``inputs``: uint32[num_inputs, W]; returns uint32[num_outputs, W] in
     ``output_bits(st)`` order.  W is padded to a multiple of ``block``
     internally (the pad is sliced off the output).  ``interpret=True``
-    runs the kernel in interpreter mode (CPU testing).
+    runs the kernel in interpreter mode; the default (None) follows the
+    backend — compiled on TPU, interpreted on CPU, where pallas_call
+    supports nothing else (so the README snippet runs anywhere).
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
 
     gates = [(g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates]
     n_in = st.num_inputs
