@@ -69,7 +69,13 @@ def parse_block(v: str, source: str = "SBG_PALLAS_BLOCK") -> tuple:
 
 def block_shape() -> tuple:
     """The kernel's default (block_low, block_high) — env-tunable for
-    the on-chip A/B (``SBG_PALLAS_BLOCK=128x128`` etc.)."""
+    the on-chip A/B (``SBG_PALLAS_BLOCK=128x128`` etc.).
+
+    Caveat: this is read at jit TRACE time inside ``lut5_pivot_stream``;
+    changing the env var between calls with identical static arguments
+    silently reuses the cached trace's block shape.  For per-call block
+    changes use the ``backend="pallas:BLxBH"`` form, which bakes the
+    shape into the jit static args (one cache entry per shape)."""
     import os
 
     v = os.environ.get("SBG_PALLAS_BLOCK")

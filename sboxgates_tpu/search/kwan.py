@@ -45,10 +45,14 @@ def _create_circuit(
     # The whole recursion runs in a native engine when available
     # (csrc sbg_gate_engine / sbg_lut_engine) — Python only replays the
     # final adopted gate additions and re-verifies.  Bit-identical to
-    # the Python path below when not randomizing; LUT-mode nodes that
+    # the Python path below when not randomizing.  LUT-mode nodes that
     # need device sweeps (pivot-sized 5-LUT, staged 7-LUT, solver
-    # overflow) make the engine bail, and the call falls through to the
-    # Python engine below.
+    # overflow) no longer bail: the engine blocks in a ctypes
+    # continuation callback (_lut_engine_service) that runs the exact
+    # Python search drivers, then the native recursion resumes in place
+    # — the C stack is the resumable state, no exploration is ever
+    # discarded.  A *failed* service (or an engine built without the
+    # callback) still degrades to the old bail-and-fall-through path.
     if ctx.uses_native_engine(st):
         if not opt.lut_graph:
             return _native_engine_search(ctx, st, target, mask, inbits)
