@@ -1,0 +1,122 @@
+"""Seed-swept best gate counts: DES S1 outputs 0-3 + crypto1 filters.
+
+Widens the round-4 quality showcase (17-gate DES S1 bit 0 vs the
+reference README's 19-gate des_s1_bit0.svg, reference README.md:33-34)
+from one data point to a table: for each target, sweep N seeds of the
+randomized gate-mode search under the showcase's gate family
+(avail_gates_bitfield=214 — AND, both ANDNOT forms, XOR, OR) with a
+ratcheting gate budget, and commit the best circuit found.
+
+Each row is deterministically reproducible: `best_seed` under a
+`max_gates` budget of (best+1 extra node) re-derives `best_gates` —
+that's what tests/test_quality.py asserts for every committed artifact.
+
+Usage:  JAX_PLATFORMS=cpu python examples/quality_sweep.py [seeds]
+Writes examples/quality_table.json and examples/<target>_best.xml.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Pin the CPU backend the way conftest.py/bench.py do: the axon
+# sitecustomize re-forces the tunnel platform at interpreter start, so
+# the env var alone is not reliable — set both before the package
+# (and so jax) initializes a backend.  A dead tunnel otherwise hangs
+# the first dispatch forever.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from sboxgates_tpu.core import ttable as tt  # noqa: E402
+from sboxgates_tpu.graph.state import NO_GATE, State  # noqa: E402
+from sboxgates_tpu.graph import xmlio  # noqa: E402
+from sboxgates_tpu.search import Options, SearchContext  # noqa: E402
+from sboxgates_tpu.search.kwan import create_circuit  # noqa: E402
+from sboxgates_tpu.utils.sbox import load_sbox  # noqa: E402
+
+GATE_FAMILY = 214  # the showcase family: AND | ANDNOT both | XOR | OR
+INITIAL_EXTRA = 18  # first-seed budget: inputs + 18 candidate nodes
+# (the round-4 showcase swept at max_gates = 24 total for the 6-input
+# target; larger first budgets make failing seeds exponentially slow)
+
+# (label, sbox file, output bit)
+TARGETS = [
+    ("des_s1_bit0", "des_s1.txt", 0),
+    ("des_s1_bit1", "des_s1.txt", 1),
+    ("des_s1_bit2", "des_s1.txt", 2),
+    ("des_s1_bit3", "des_s1.txt", 3),
+    ("crypto1_fa", "crypto1_fa.txt", 0),
+    ("crypto1_fb", "crypto1_fb.txt", 0),
+    ("crypto1_fc", "crypto1_fc.txt", 0),
+]
+
+
+def sweep_target(label, sbox_file, bit, seeds):
+    sbox, n = load_sbox(os.path.join(REPO, "sboxes", sbox_file))
+    target = np.asarray(tt.target_table(sbox, bit))
+    mask = np.asarray(tt.mask_table(n))
+    best = None  # (gates, seed, budget_at_best, state)
+    budget = n + INITIAL_EXTRA
+    while best is None:
+        for seed in range(seeds):
+            st = State.init_inputs(n)
+            st.max_gates = budget
+            ctx = SearchContext(
+                Options(seed=seed, avail_gates_bitfield=GATE_FAMILY)
+            )
+            out = create_circuit(ctx, st, target, mask, [])
+            if out == NO_GATE:
+                continue
+            got = np.asarray(st.tables[out])
+            assert np.array_equal(got & mask, target & mask), (label, seed)
+            st.outputs[bit] = out
+            gates = st.num_gates - st.num_inputs
+            if best is None or gates < best[0]:
+                # budget is what this seed's search actually ran under —
+                # recorded so the row is deterministically reproducible
+                # (seed + budget + family re-derive the circuit).
+                best = (gates, seed, budget, st.copy())
+                # Ratchet: later seeds must strictly improve, so their
+                # searches prune at the new bound and the sweep stays
+                # fast.
+                budget = st.num_gates - 1
+        if best is None:
+            # The target's minimum exceeds the tight initial budget:
+            # widen and re-sweep (slow, but only for hard targets).
+            budget += 4
+            assert budget <= n + 40, f"{label}: no circuit by budget 40"
+            print(f"{label}: widening budget to {budget}", flush=True)
+    return best
+
+
+def main():
+    seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    table = []
+    for label, sbox_file, bit in TARGETS:
+        gates, seed, budget, st = sweep_target(label, sbox_file, bit, seeds)
+        path = os.path.join(REPO, "examples", f"{label}_best.xml")
+        with open(path, "w") as f:
+            f.write(xmlio.state_to_xml(st))
+        table.append(
+            {"target": label, "sbox": sbox_file, "bit": bit,
+             "best_gates": gates, "best_seed": seed, "budget": budget,
+             "gate_family": GATE_FAMILY, "seeds_swept": seeds,
+             "artifact": os.path.basename(path)}
+        )
+        print(
+            f"{label}: {gates} gates (seed {seed}, budget {budget})",
+            flush=True,
+        )
+    with open(os.path.join(REPO, "examples", "quality_table.json"), "w") as f:
+        json.dump(table, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -761,7 +761,7 @@ def _pivot_tile_operands(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
     return lhs1, lhs0, rhs, _pivot_tile_valid(lowvalid, highvalid, d, tl, th)
 
 
-def _pivot_tile_from_operands(ops, tl, th):
+def _pivot_tile_from_operands(ops, tl, th, accum_dtype=jnp.int32):
     """Matmul half of one pivot tile: int8 operands -> (valid, feasible,
     req1, req0 packed uint32 [tl, th]).
 
@@ -772,14 +772,23 @@ def _pivot_tile_from_operands(ops, tl, th):
     int32 accumulation — the systolic-array path instead of the VPU.
     Measured ~3.5x faster per tile than the elementwise AND + any-reduce
     formulation on a v5 chip (and bit-identical to it).
+
+    ``accum_dtype`` is the count matrices' storage dtype (static at
+    trace time): int32 is the baseline; bfloat16 halves their bytes
+    with bit-identical ``> 0`` verdicts — see
+    _pivot_tile_from_operands_bf16 for the exactness argument.
     """
     lhs1, lhs0, rhs, valid = ops
     dn = (((1,), (0,)), ((), ()))
+    if accum_dtype != jnp.int32:
+        lhs1, lhs0, rhs = (
+            x.astype(accum_dtype) for x in (lhs1, lhs0, rhs)
+        )
     c1 = jax.lax.dot_general(
-        lhs1, rhs, dn, preferred_element_type=jnp.int32
+        lhs1, rhs, dn, preferred_element_type=accum_dtype
     ).reshape(2, 4, tl, 4, th)
     c0 = jax.lax.dot_general(
-        lhs0, rhs, dn, preferred_element_type=jnp.int32
+        lhs0, rhs, dn, preferred_element_type=accum_dtype
     ).reshape(2, 4, tl, 4, th)
     b1 = c1 > 0
     b0 = c0 > 0
@@ -790,6 +799,30 @@ def _pivot_tile_from_operands(ops, tl, th):
     req1 = (b1.astype(jnp.uint32) << sh).sum(axis=(0, 1, 3))
     req0 = (b0.astype(jnp.uint32) << sh).sum(axis=(0, 1, 3))
     return valid, valid & ~conflict, req1, req0
+
+
+def _pivot_tile_from_operands_bf16(ops, tl, th):
+    """bf16-accumulation variant of the XLA matmul half
+    (``backend="xla_bf16"``): same operands, but the two count matrices
+    are emitted as bfloat16 instead of int32.
+
+    Correctness: every matmul operand entry is 0/1 (bit lanes × a 0/1
+    polarity selector), so counts lie in [0, 256] — all exactly
+    representable in bfloat16 (8 significand bits reach 2^8).  The MXU
+    accumulates in f32 (exact) and converts on output, so the ``> 0``
+    verdicts — the only thing the epilogue consumes — are bit-identical
+    to the int32 path.
+
+    Why it can win: ROOFLINE.md pins the XLA path's 91 µs tile time to
+    the ~67 MB of materialized int32 count matrices (~84 µs at HBM
+    rate).  Halving their bytes halves the bound the path is measured
+    to sit on, with zero Mosaic risk — the one XLA-level lever the
+    round-4 arithmetic does not rule out, because it shrinks the
+    traffic instead of rescheduling it.  Chip sign unknown until the
+    A/B runs (bench_pivot_tile_batch, variant t1_xla_bf16)."""
+    return _pivot_tile_from_operands(
+        ops, tl, th, accum_dtype=jnp.bfloat16
+    )
 
 
 def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
@@ -1017,6 +1050,11 @@ def lut5_pivot_stream(
     expansion + matmul + pack pipeline — same bits, radically less HBM
     traffic per tile.  Composes with ``pipeline`` (the carried operands
     are then just the packed slices), not with ``tile_batch``.
+
+    ``backend="xla_bf16"`` keeps the whole XLA pipeline but emits the
+    count matrices — the traffic the path is roofline-bound on — as
+    bfloat16 (exact for counts <= 256, so verdicts are bit-identical;
+    see _pivot_tile_from_operands_bf16).  Composes with both levers.
     """
     start_t = jnp.asarray(start_t, jnp.int32)
     t_end = jnp.asarray(t_end, jnp.int32)
@@ -1035,10 +1073,17 @@ def lut5_pivot_stream(
                 f"block spec {spec!r} only applies to pallas backends"
             )
         pallas_block = parse_block(spec, source="backend")
-    if backend not in ("xla", "pallas", "pallas_pre"):
+    if backend not in ("xla", "xla_bf16", "pallas", "pallas_pre"):
         raise ValueError(f"unknown pivot backend {backend!r}")
-    if backend != "xla" and tile_batch != 1:
+    if backend.startswith("pallas") and tile_batch != 1:
         raise ValueError(f"backend={backend!r} requires tile_batch=1")
+    # Both XLA backends share the operand expansion; they differ only in
+    # the matmul half's accumulation dtype (bit-identical verdicts —
+    # see _pivot_tile_from_operands_bf16).
+    xla_from_ops = (
+        _pivot_tile_from_operands_bf16 if backend == "xla_bf16"
+        else _pivot_tile_from_operands
+    )
 
     if tile_batch == 1:
         tile_operands = {
@@ -1046,7 +1091,7 @@ def lut5_pivot_stream(
             "pallas_pre": _pivot_tile_expanded_operands,
         }.get(backend, _pivot_tile_operands)
         tile_from_ops = (
-            _pivot_tile_from_operands if backend == "xla"
+            xla_from_ops if not backend.startswith("pallas")
             else functools.partial(
                 _pivot_tile_from_packed if backend == "pallas"
                 else _pivot_tile_from_expanded,
@@ -1084,9 +1129,7 @@ def lut5_pivot_stream(
                 tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th
             )
         )
-        from_ops = jax.vmap(
-            lambda ops: _pivot_tile_from_operands(ops, tl, th)
-        )
+        from_ops = jax.vmap(lambda ops: xla_from_ops(ops, tl, th))
         solve = jax.vmap(
             lambda feas, r1, r0, d, s_t: _pivot_tile_solve(
                 feas, r1, r0, d, w_tab, m_tab, s_t, th, solve_rows

@@ -265,10 +265,13 @@ def pivot_backend() -> str:
     traffic the XLA path is measurably bound on (ROOFLINE.md) — never
     round-trip HBM; ``pallas_pre`` keeps the XLA operand expansion and
     fuses only matmul + packing (the minimal-Mosaic-surface hedge).
-    Either may carry a ``:BLxBH`` VMEM block suffix.  Bit-identical
-    results (parity-tested); defaults to the measured xla path until a
-    pallas variant's on-chip A/B (bench_pivot_tile_batch) lands.
-    Forces tile_batch=1."""
+    Either may carry a ``:BLxBH`` VMEM block suffix.  ``xla_bf16``
+    keeps the XLA pipeline but halves the count-matrix bytes (bf16
+    accumulation, exact for counts <= 256 — the Mosaic-risk-free
+    traffic lever).  Bit-identical results for every backend
+    (parity-tested); defaults to the measured xla path until a
+    variant's on-chip A/B (bench_pivot_tile_batch) lands.
+    Pallas backends force tile_batch=1."""
     import os
 
     return os.environ.get("SBG_PIVOT_BACKEND", "xla")

@@ -303,3 +303,13 @@ def test_pivot_tile_batch_parity(monkeypatch):
     monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "1")
     p_hit, p_miss = run()
     assert base_hit == p_hit and p_miss is None
+    # The bf16-accumulation backend must be bit-identical too: counts
+    # <= 256 are exact in bfloat16, so its > 0 verdicts match the int32
+    # path's (sweeps._pivot_tile_from_operands_bf16) — alone and
+    # composed with both levers.
+    monkeypatch.setenv("SBG_PIVOT_BACKEND", "xla_bf16")
+    bf_hit, bf_miss = run()
+    assert base_hit == bf_hit and bf_miss is None
+    monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "2")
+    bfb_hit, bfb_miss = run()
+    assert base_hit == bfb_hit and bfb_miss is None
