@@ -89,32 +89,55 @@ def build_state(g):
     return st, tt.target_table(sbox, 0), tt.mask_table(n)
 
 
-def bench_lut5_device(g) -> dict:
+def bench_lut5_device(g, config=None) -> dict:
     """Full C(g,5) sweep through the real search path (candidates/s/chip).
-    AES bit 0 over XOR layers admits no 5-LUT, so the whole space is swept."""
+    AES bit 0 over XOR layers admits no 5-LUT, so the whole space is swept.
+
+    ``config`` (a bench_pivot_tile_batch ``best_config`` dict) re-drives
+    the sweep under the A/B's winning lever settings via the production
+    env levers — the capture half of the armed decision rule."""
     from sboxgates_tpu.search import Options, SearchContext
     from sboxgates_tpu.search.lut import lut5_search
 
     st, target, mask = build_state(g)
     ctx = SearchContext(Options(seed=1, lut_graph=True))
+    env = {}
+    if config:
+        env = {
+            "SBG_PIVOT_TILE_BATCH": str(config["tile_batch"]),
+            "SBG_PIVOT_PIPELINE": "1" if config["pipeline"] else "0",
+            "SBG_PIVOT_BACKEND": config["backend"],
+        }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        def run():
+            if lut5_search(ctx, st, target, mask, []) is not None:
+                raise RuntimeError("unexpected 5-LUT hit in bench state")
 
-    def run():
-        if lut5_search(ctx, st, target, mask, []) is not None:
-            raise RuntimeError("unexpected 5-LUT hit in bench state")
+        run()  # warmup/compile
 
-    run()  # warmup/compile
+        def one():
+            base = ctx.stats["lut5_candidates"]
+            t0 = time.perf_counter()
+            run()
+            dt = time.perf_counter() - t0
+            return (ctx.stats["lut5_candidates"] - base) / dt
 
-    def one():
-        base = ctx.stats["lut5_candidates"]
-        t0 = time.perf_counter()
-        run()
-        dt = time.perf_counter() - t0
-        return (ctx.stats["lut5_candidates"] - base) / dt
-
-    s = _spread(one)
-    return {"metric": f"lut5_sweep_g{g}", **s, "unit": "cand/s",
-            "space": math.comb(g, 5),
-            "seconds_per_sweep": math.comb(g, 5) / s["value"]}
+        s = _spread(one)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    suffix = "_best" if config else ""
+    entry = {"metric": f"lut5_sweep_g{g}{suffix}", **s, "unit": "cand/s",
+             "space": math.comb(g, 5),
+             "seconds_per_sweep": math.comb(g, 5) / s["value"]}
+    if config:
+        entry["config"] = config
+    return entry
 
 
 def bench_pivot_tile_batch() -> dict:
@@ -213,18 +236,25 @@ def bench_pivot_tile_batch() -> dict:
     for _ in range(REPEATS):
         for v in variants:
             rates[v].append(one(*v))
-    best = None
+    best = best_v = None
     for v in variants:
         vals = sorted(rates[v])
         key = vkey(v)
         out[key] = vals[len(vals) // 2]
         out[f"{key}_spread"] = [vals[0], vals[-1]]
         if best is None or out[key] > out[best]:
-            best = key
+            best, best_v = key, v
     # value = the t1 baseline when it survived, else the best variant
     # (a None value would NaN-poison ratio consumers).
     out["best"] = out[best]
     out["best_variant"] = best
+    # Structured form of the winner so main() can re-drive the headline
+    # sweep under it without reverse-parsing the key (the armed decision
+    # rule: any variant beating t1 flips the production default).
+    out["best_config"] = {
+        "tile_batch": best_v[0], "pipeline": best_v[1],
+        "backend": best_v[2],
+    }
     out["value"] = out.get("t1", out[best])
     return out
 
@@ -1528,8 +1558,13 @@ def main() -> None:
                 return out
             for e in json.loads(r.stdout):
                 m = str(e.get("metric", ""))
+                # Same promote-only-if-greater rule as _headline_line: a
+                # committed _best entry that lost end-to-end must not
+                # override the committed plain headline.
                 if (m.startswith("lut5_sweep_g") and "slice" not in m
-                        and e.get("value") is not None):
+                        and e.get("value") is not None
+                        and e["value"] > out.get(
+                            "last_committed_value", float("-inf"))):
                     out["last_committed_value"] = e["value"]
                     out["last_committed_metric"] = m
         except Exception:
@@ -1645,19 +1680,33 @@ def main() -> None:
         """The ONE driver-facing JSON line, computed from whatever
         entries have been captured so far (so the watchdog can emit it
         from a partial run)."""
-        dev = cpu_rate = float("nan")
+        dev = best = cpu_rate = float("nan")
+        cfg = None
         for e in detail:
             if e.get("metric") == f"lut5_sweep_g{G_HEAD}" and "value" in e:
                 dev = e["value"]
+            if (e.get("metric") == f"lut5_sweep_g{G_HEAD}_best"
+                    and "value" in e):
+                best, cfg = e["value"], e.get("config")
             if e.get("metric") == "cpu_core_lut5" and "value" in e:
                 cpu_rate = e["value"]
+        # The headline is the production configuration's rate: when the
+        # A/B's winner was re-captured through the real driver and beats
+        # plain, that IS the production config (the decision rule flips
+        # the default to it).
+        line_cfg = None
+        if best == best and (dev != dev or best > dev):
+            dev, line_cfg = best, cfg
         finite = dev == dev and cpu_rate == cpu_rate and cpu_rate > 0
-        return {
+        line = {
             "metric": "lut5_candidates_per_sec_per_chip_aes",
             "value": round(dev, 1) if dev == dev else None,
             "unit": "candidates/s",
             "vs_baseline": round(dev / cpu_rate, 3) if finite else None,
         }
+        if line_cfg:
+            line["config"] = line_cfg
+        return line
 
     # Mid-run tunnel death watchdog (observed live in round 4: the
     # start-of-run probe passed, the first four entries captured, then
@@ -1743,13 +1792,31 @@ def main() -> None:
             )
         return r
 
+    # The CPU baseline is seconds of pure-native work and supplies the
+    # headline's vs_baseline — run it first so ANY later salvage (the
+    # watchdog os._exit path never returns to this function) still
+    # carries the ratio.  Then the chip-decisive entries: tunnel windows
+    # can be minutes long (round-4 lesson), and the lever A/B is the
+    # round's armed decision.  16 variants x (warm + reps) of full
+    # sweeps; in SMOKE the pallas variants run INTERPRETED at minutes
+    # per sweep — either way this is the long multi-variant entry, so
+    # give it the subprocess-tier budget rather than the single-sweep
+    # default.
     run(bench_cpu_baseline)
+    ab = run(bench_pivot_tile_batch, budget=3600.0)
     run(bench_lut5_device, G_HEAD)
-    # 11 variants x (warm + reps) of full sweeps; in SMOKE the pallas
-    # variants run INTERPRETED at minutes per sweep — either way this is
-    # the long multi-variant entry, so give it the subprocess-tier
-    # budget rather than the single-sweep default.
-    run(bench_pivot_tile_batch, budget=3600.0)
+    cfg = (ab or {}).get("best_config")
+    t1 = (ab or {}).get("t1")
+    if (
+        cfg
+        and (ab.get("best_variant") != "t1")
+        and (t1 is None or ab["best"] > t1)
+    ):
+        # The armed decision rule's capture half: a variant beat plain,
+        # so record the headline sweep under the winning config in the
+        # same window (the default flip itself is a reviewed code
+        # change; this preserves the evidence even if the tunnel dies).
+        run(bench_lut5_device, G_HEAD, cfg)
     run(bench_lut5_g500_slice)
     run(bench_gate_mode_sweeps)
     run(bench_lut7)

@@ -13,6 +13,11 @@ that's what tests/test_quality.py asserts for every committed artifact.
 
 Usage:  JAX_PLATFORMS=cpu python examples/quality_sweep.py [seeds]
 Writes examples/quality_table.json and examples/<target>_best.xml.
+
+Curation note: the committed table points the des_s1_bit0 row at the
+round-4 showcase artifact (des_s1_bit0_17gates.xml) — the sweep
+re-derives the identical circuit, so the *_best.xml it writes for that
+row is a duplicate and is not committed.
 """
 
 import json

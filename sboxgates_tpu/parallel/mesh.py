@@ -260,7 +260,8 @@ def sharded_feasible_stream(
 
 @functools.lru_cache(maxsize=None)
 def _sharded_pivot_fn(
-    mesh: Mesh, tl: int, th: int, solve_rows: int, pipeline: bool
+    mesh: Mesh, tl: int, th: int, solve_rows: int, pipeline: bool,
+    accum_dtype=jnp.int32,
 ):
     """Compiled SPMD pivot-tile stream for one (mesh, tile-shape).
 
@@ -301,7 +302,7 @@ def _sharded_pivot_fn(
             t = base + d
             active = t < t_end
             _, feas2d, req1, req0 = sweeps._pivot_tile_from_operands(
-                ops, tl, th
+                ops, tl, th, accum_dtype=accum_dtype
             )
             status, mm, lo_abs, hi_abs, sigma, fo, r1b, r0b = (
                 sweeps._pivot_tile_solve_or_skip(
@@ -364,16 +365,40 @@ def sharded_pivot_stream(
     plan: "MeshPlan", tables, lc1, lc0, hc, lowvalid, highvalid, descs,
     start_t, t_end, w_tab, m_tab, seed, *, tl: int, th: int,
     solve_rows: int = 64, pipeline: Optional[bool] = None,
+    backend: Optional[str] = None,
 ):
     """Mesh-sharded counterpart of sweeps.lut5_pivot_stream.  Returns
     verdict rows [n_devices, 10]: (status, tile, m, lo_abs, hi_abs, sigma,
-    func_outer, req1, req0, next_base).  ``pipeline=None`` follows the
-    SBG_PIVOT_PIPELINE lever like the single-device stream."""
+    func_outer, req1, req0, next_base).  ``pipeline=None`` /
+    ``backend=None`` follow the SBG_PIVOT_PIPELINE / SBG_PIVOT_BACKEND
+    levers like the single-device stream.  The sharded path honors the
+    ``xla`` and ``xla_bf16`` backends (same matmul half, bit-identical
+    verdicts); the pallas kernels are single-device-only for now, so a
+    pallas setting falls back to the XLA matmul half with a warning
+    rather than silently — or erroring a production mesh run whose
+    global default was flipped by the single-chip A/B."""
     if pipeline is None:
         from ..search.lut import pivot_pipeline
 
         pipeline = pivot_pipeline()
-    fn = _sharded_pivot_fn(plan.mesh, tl, th, solve_rows, bool(pipeline))
+    if backend is None:
+        from ..search.lut import pivot_backend
+
+        backend = pivot_backend()
+    if backend.startswith("pallas"):
+        import warnings
+
+        warnings.warn(
+            f"SBG_PIVOT_BACKEND={backend!r} is single-device-only; the "
+            "mesh-sharded pivot stream falls back to the XLA matmul "
+            "half (bit-identical results)",
+            stacklevel=2,
+        )
+        backend = "xla"
+    accum_dtype = jnp.bfloat16 if backend == "xla_bf16" else jnp.int32
+    fn = _sharded_pivot_fn(
+        plan.mesh, tl, th, solve_rows, bool(pipeline), accum_dtype
+    )
     return fn(
         tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
         w_tab, m_tab, seed,

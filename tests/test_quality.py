@@ -61,3 +61,83 @@ def test_17_gate_circuit_reproduces_from_seed():
     assert st.num_gates - st.num_inputs == 17
     got = np.asarray(st.tables[out])
     assert np.array_equal(got & mask, target & mask)
+
+
+# -- round 5: the quality TABLE (examples/quality_sweep.py) ---------------
+#
+# One data point beats an anecdote; a table beats the reference's entire
+# published quality story (its README showcases only des_s1_bit0.svg).
+# Every committed row must (a) be a correct circuit for its target and
+# (b) re-derive deterministically from its recorded (seed, budget,
+# gate family).
+
+import json
+
+import pytest
+
+TABLE_PATH = os.path.join(REPO, "examples", "quality_table.json")
+
+
+def _table_rows():
+    if not os.path.exists(TABLE_PATH):
+        return []
+    with open(TABLE_PATH) as f:
+        return json.load(f)
+
+
+def _row_target(row):
+    sbox, n = load_sbox(os.path.join(REPO, "sboxes", row["sbox"]))
+    target = np.asarray(tt.target_table(sbox, row["bit"]))
+    return n, target, np.asarray(tt.mask_table(n))
+
+
+@pytest.mark.parametrize(
+    "row", _table_rows(), ids=lambda r: r["target"]
+)
+def test_quality_table_artifact_is_correct(row):
+    n, target, mask = _row_target(row)
+    st = load_state(os.path.join(REPO, "examples", row["artifact"]))
+    out = st.outputs[row["bit"]]
+    assert out != NO_GATE
+    got = np.asarray(st.tables[out])
+    assert np.array_equal(got & mask, target & mask)
+    assert st.num_gates - st.num_inputs == row["best_gates"]
+    # The showcase 2-input family (bitfield 214) plus NOT: Kwan step 2
+    # reuses an existing gate's complement as a NOT gate, which the
+    # reference's own gate model includes and counts toward the total —
+    # no free inverters.
+    from sboxgates_tpu.core import boolfunc as bf
+
+    allowed = {bf.AND, bf.A_AND_NOT_B, bf.NOT_A_AND_B, bf.XOR, bf.OR,
+               bf.NOT}
+    used = {st.gates[i].type for i in range(st.num_inputs, st.num_gates)}
+    assert used <= allowed, used
+
+
+@pytest.mark.parametrize(
+    "row", _table_rows(), ids=lambda r: r["target"]
+)
+def test_quality_table_row_reproduces(row):
+    """seed + budget + family re-derive the row's gate count."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    n, target, mask = _row_target(row)
+    st = State.init_inputs(n)
+    st.max_gates = row["budget"]
+    ctx = SearchContext(
+        Options(seed=row["best_seed"],
+                avail_gates_bitfield=row["gate_family"])
+    )
+    out = create_circuit(ctx, st, target, mask, [])
+    assert out != NO_GATE
+    assert st.num_gates - st.num_inputs == row["best_gates"]
+    got = np.asarray(st.tables[out])
+    assert np.array_equal(got & mask, target & mask)
+
+
+def test_quality_table_exists():
+    """The committed table must be present and cover the advertised
+    targets (4 DES S1 outputs + 3 crypto1 filters)."""
+    rows = _table_rows()
+    assert len(rows) == 7, "quality_table.json missing or incomplete"

@@ -109,6 +109,37 @@ def test_lut5_pivot_sharded_equals_single():
     assert verify_lut5_result(st, target, mask, res1)
 
 
+def test_lut5_pivot_sharded_backend_levers(monkeypatch):
+    """The sharded stream honors the backend lever: xla_bf16 selects the
+    identical decomposition (counts <= 256 are exact in bf16), and a
+    pallas setting falls back to the XLA matmul half with a warning
+    instead of silently no-opping (round-5 review finding)."""
+    import warnings
+
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search.lut import lut5_search
+
+    st, target, mask = build_planted_lut5()
+    plan = MeshPlan(make_mesh())
+
+    def run():
+        ctx = SearchContext(
+            Options(lut_graph=True, randomize=False), mesh_plan=plan
+        )
+        return lut5_search(ctx, st, target, mask, [])
+
+    base = run()
+    assert base is not None
+    monkeypatch.setenv("SBG_PIVOT_BACKEND", "xla_bf16")
+    assert run() == base
+    monkeypatch.setenv("SBG_PIVOT_BACKEND", "pallas")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert run() == base
+    assert any("single-device-only" in str(x.message) for x in w)
+
+
 def test_engine_continuation_under_mesh_matches_unmeshed():
     """Under a local 8-device mesh the native engine drives pivot-sized
     LUT nodes too (uses_native_engine: no rendezvous under a mesh), with
