@@ -14,10 +14,10 @@ that's what tests/test_quality.py asserts for every committed artifact.
 Usage:  JAX_PLATFORMS=cpu python examples/quality_sweep.py [seeds]
 Writes examples/quality_table.json and examples/<target>_best.xml.
 
-Curation note: the committed table points the des_s1_bit0 row at the
-round-4 showcase artifact (des_s1_bit0_17gates.xml) — the sweep
-re-derives the identical circuit, so the *_best.xml it writes for that
-row is a duplicate and is not committed.
+The des_s1_bit0 row canonicalizes to the round-4 showcase artifact
+(des_s1_bit0_17gates.xml) when the sweep re-derives the identical
+circuit — no duplicate file, and a regenerated table keeps pointing at
+the committed artifact.
 """
 
 import json
@@ -50,6 +50,10 @@ GATE_FAMILY = 214  # the showcase family: AND | ANDNOT both | XOR | OR
 INITIAL_EXTRA = 18  # first-seed budget: inputs + 18 candidate nodes
 # (the round-4 showcase swept at max_gates = 24 total for the 6-input
 # target; larger first budgets make failing seeds exponentially slow)
+
+# Rows whose circuit may already exist under a committed canonical
+# name (see the module docstring's curation note).
+CANONICAL_ARTIFACTS = {"des_s1_bit0": "des_s1_bit0_17gates.xml"}
 
 # (label, sbox file, output bit)
 TARGETS = [
@@ -106,9 +110,20 @@ def main():
     table = []
     for label, sbox_file, bit in TARGETS:
         gates, seed, budget, st = sweep_target(label, sbox_file, bit, seeds)
+        xml = xmlio.state_to_xml(st)
         path = os.path.join(REPO, "examples", f"{label}_best.xml")
-        with open(path, "w") as f:
-            f.write(xmlio.state_to_xml(st))
+        # Canonicalize onto an already-committed identical artifact
+        # (e.g. the round-4 bit-0 showcase) so regeneration never
+        # produces a duplicate file or re-points the table away from
+        # the committed name.
+        canonical = CANONICAL_ARTIFACTS.get(label)
+        if canonical is not None:
+            cpath = os.path.join(REPO, "examples", canonical)
+            if os.path.exists(cpath) and open(cpath).read() == xml:
+                path = cpath
+        if path.endswith(f"{label}_best.xml"):
+            with open(path, "w") as f:
+                f.write(xml)
         table.append(
             {"target": label, "sbox": sbox_file, "bit": bit,
              "best_gates": gates, "best_seed": seed, "budget": budget,
