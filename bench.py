@@ -140,14 +140,14 @@ def bench_lut5_device(g, config=None) -> dict:
     return entry
 
 
-# The decisive variant set: plain vs the three traffic levers (the
-# fused kernel, its minimal-surface hedge, and the bf16 count
+# The decisive variant set: plain vs the four traffic levers (the
+# fused kernel, its minimal-surface hedge, and the bf16 / fp8 count
 # matrices).  Small enough that a minutes-long tunnel window warms and
 # measures ALL of it — the armed decision (flip pivot_backend()'s
 # default to any winner) needs nothing else.
 CORE_VARIANTS = [
     (1, False, "xla"),
-    (1, False, "xla_bf16"),
+    (1, False, "xla_bf16"), (1, False, "xla_f8"),
     (1, False, "pallas"), (1, False, "pallas_pre"),
 ]
 # The tuning ladder: the round-4-measured xla levers (re-measurement,
@@ -1813,7 +1813,7 @@ def main() -> None:
     # watchdog os._exit path never returns to this function) still
     # carries the ratio.  Then the chip-decisive entries: tunnel windows
     # can be minutes long (round-4 lesson), so the armed decision runs
-    # as a small CORE A/B first (4 variants), the headline next, and
+    # as a small CORE A/B first (5 variants), the headline next, and
     # the block-shape tuning ladder after — a short window decides even
     # if it dies before the ladder.  In SMOKE the pallas variants run
     # INTERPRETED at minutes per sweep, so the multi-variant entries

@@ -825,6 +825,22 @@ def _pivot_tile_from_operands_bf16(ops, tl, th):
     )
 
 
+def _pivot_tile_from_operands_f8(ops, tl, th):
+    """fp8 (e4m3) variant (``backend="xla_f8"``): quarters the count
+    matrices' bytes vs int32.  Unlike bf16, counts above 16 DO round in
+    e4m3 — but the ``> 0`` verdicts stay bit-identical anyway: a count
+    is a sum of nonnegative 0/1 products, 0 converts to exactly 0, and
+    any positive count is >= 1 (exactly representable), which no
+    rounding mode maps to 0 (e4m3fn max 448 also covers 256, so no
+    overflow-to-inf/nan).  The epilogue consumes only the verdicts, so
+    the rounding is invisible.  Riskier than bf16 only in the sense
+    that TPU dot-with-fp8-output support must lower; the A/B's warm
+    failure isolation covers that (variant t1_xla_f8)."""
+    return _pivot_tile_from_operands(
+        ops, tl, th, accum_dtype=jnp.float8_e4m3fn
+    )
+
+
 def _pivot_tile_constraints(tables, lc1, lc0, hc, lowvalid, highvalid, d, tl, th):
     """Shared per-tile constraint computation (expansion + matmul halves).
     d: descriptor int32[5].  Returns (valid [tl,th], feasible, req1, req0
@@ -1073,17 +1089,17 @@ def lut5_pivot_stream(
                 f"block spec {spec!r} only applies to pallas backends"
             )
         pallas_block = parse_block(spec, source="backend")
-    if backend not in ("xla", "xla_bf16", "pallas", "pallas_pre"):
+    if backend not in ("xla", "xla_bf16", "xla_f8", "pallas", "pallas_pre"):
         raise ValueError(f"unknown pivot backend {backend!r}")
     if backend.startswith("pallas") and tile_batch != 1:
         raise ValueError(f"backend={backend!r} requires tile_batch=1")
-    # Both XLA backends share the operand expansion; they differ only in
+    # The XLA backends share the operand expansion; they differ only in
     # the matmul half's accumulation dtype (bit-identical verdicts —
-    # see _pivot_tile_from_operands_bf16).
-    xla_from_ops = (
-        _pivot_tile_from_operands_bf16 if backend == "xla_bf16"
-        else _pivot_tile_from_operands
-    )
+    # see _pivot_tile_from_operands_bf16 / _f8).
+    xla_from_ops = {
+        "xla_bf16": _pivot_tile_from_operands_bf16,
+        "xla_f8": _pivot_tile_from_operands_f8,
+    }.get(backend, _pivot_tile_from_operands)
 
     if tile_batch == 1:
         tile_operands = {

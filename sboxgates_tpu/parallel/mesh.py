@@ -372,11 +372,12 @@ def sharded_pivot_stream(
     func_outer, req1, req0, next_base).  ``pipeline=None`` /
     ``backend=None`` follow the SBG_PIVOT_PIPELINE / SBG_PIVOT_BACKEND
     levers like the single-device stream.  The sharded path honors the
-    ``xla`` and ``xla_bf16`` backends (same matmul half, bit-identical
-    verdicts); the pallas kernels are single-device-only for now, so a
-    pallas setting falls back to the XLA matmul half with a warning
-    rather than silently — or erroring a production mesh run whose
-    global default was flipped by the single-chip A/B."""
+    ``xla`` / ``xla_bf16`` / ``xla_f8`` backends (same matmul half,
+    bit-identical verdicts); the pallas kernels are single-device-only
+    for now, so a pallas setting falls back to the XLA matmul half with
+    a warning rather than silently — or erroring a production mesh run
+    whose global default was flipped by the single-chip A/B.  Unknown
+    backend strings raise, matching lut5_pivot_stream's validation."""
     if pipeline is None:
         from ..search.lut import pivot_pipeline
 
@@ -395,7 +396,11 @@ def sharded_pivot_stream(
             stacklevel=2,
         )
         backend = "xla"
-    accum_dtype = jnp.bfloat16 if backend == "xla_bf16" else jnp.int32
+    if backend not in ("xla", "xla_bf16", "xla_f8"):
+        raise ValueError(f"unknown pivot backend {backend!r}")
+    accum_dtype = {
+        "xla_bf16": jnp.bfloat16, "xla_f8": jnp.float8_e4m3fn,
+    }.get(backend, jnp.int32)
     fn = _sharded_pivot_fn(
         plan.mesh, tl, th, solve_rows, bool(pipeline), accum_dtype
     )

@@ -265,13 +265,14 @@ def pivot_backend() -> str:
     traffic the XLA path is measurably bound on (ROOFLINE.md) — never
     round-trip HBM; ``pallas_pre`` keeps the XLA operand expansion and
     fuses only matmul + packing (the minimal-Mosaic-surface hedge).
-    Either may carry a ``:BLxBH`` VMEM block suffix.  ``xla_bf16``
-    keeps the XLA pipeline but halves the count-matrix bytes (bf16
-    accumulation, exact for counts <= 256 — the Mosaic-risk-free
-    traffic lever).  Bit-identical results for every backend
-    (parity-tested); defaults to the measured xla path until a
-    variant's on-chip A/B (bench_pivot_tile_batch) lands.
-    Pallas backends force tile_batch=1."""
+    Either may carry a ``:BLxBH`` VMEM block suffix.  ``xla_bf16`` /
+    ``xla_f8`` keep the XLA pipeline but emit bf16 / fp8-e4m3 count
+    matrices (half / quarter the roofline-bound bytes; > 0 verdicts
+    provably unchanged — sweeps._pivot_tile_from_operands_bf16/_f8).
+    Bit-identical results for every backend (parity-tested); defaults
+    to the measured xla path until a variant's on-chip A/B
+    (bench_pivot_tile_batch) lands.  Pallas backends force
+    tile_batch=1."""
     import os
 
     return os.environ.get("SBG_PIVOT_BACKEND", "xla")

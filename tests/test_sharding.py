@@ -341,6 +341,10 @@ def test_pivot_tile_batch_parity(monkeypatch):
     monkeypatch.setenv("SBG_PIVOT_BACKEND", "xla_bf16")
     bf_hit, bf_miss = run()
     assert base_hit == bf_hit and bf_miss is None
+    monkeypatch.setenv("SBG_PIVOT_BACKEND", "xla_f8")
+    f8_hit, f8_miss = run()
+    assert base_hit == f8_hit and f8_miss is None
+    monkeypatch.setenv("SBG_PIVOT_BACKEND", "xla_bf16")
     monkeypatch.setenv("SBG_PIVOT_TILE_BATCH", "2")
     bfb_hit, bfb_miss = run()
     assert base_hit == bfb_hit and bfb_miss is None

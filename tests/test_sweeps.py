@@ -525,7 +525,7 @@ def test_pivot_pallas_backend_bit_identical():
                 *args, 0, ops.t_real, jw, jm, -1, tl=tl, th=th
             )
         )
-        for backend in ("pallas", "pallas_pre", "xla_bf16"):
+        for backend in ("pallas", "pallas_pre", "xla_bf16", "xla_f8"):
             for pipeline in (False, True):
                 got = np.asarray(
                     sweeps.lut5_pivot_stream(
