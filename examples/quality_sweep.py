@@ -64,7 +64,7 @@ TARGETS = [
     ("crypto1_fa", "crypto1_fa.txt", 0),
     ("crypto1_fb", "crypto1_fb.txt", 0),
     ("crypto1_fc", "crypto1_fc.txt", 0),
-]
+] + [(f"des_s{i}_bit0", f"des_s{i}.txt", 0) for i in range(2, 9)]
 
 
 def sweep_target(label, sbox_file, bit, seeds):
@@ -107,8 +107,21 @@ def sweep_target(label, sbox_file, bit, seeds):
 
 def main():
     seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    # Optional label filter (argv[2:]): sweep only the named targets and
+    # MERGE their rows into the existing table, so extending the table
+    # never re-runs (or clobbers) the committed rows.
+    only = set(sys.argv[2:])
+    known = {t[0] for t in TARGETS}
+    if not only <= known:
+        sys.exit(f"unknown target labels: {sorted(only - known)}; "
+                 f"known: {sorted(known)}")
+    table_path = os.path.join(REPO, "examples", "quality_table.json")
     table = []
-    for label, sbox_file, bit in TARGETS:
+    if only and os.path.exists(table_path):
+        with open(table_path) as f:
+            table = [r for r in json.load(f) if r["target"] not in only]
+    targets = [t for t in TARGETS if not only or t[0] in only]
+    for label, sbox_file, bit in targets:
         gates, seed, budget, st = sweep_target(label, sbox_file, bit, seeds)
         xml = xmlio.state_to_xml(st)
         path = os.path.join(REPO, "examples", f"{label}_best.xml")
@@ -134,7 +147,9 @@ def main():
             f"{label}: {gates} gates (seed {seed}, budget {budget})",
             flush=True,
         )
-    with open(os.path.join(REPO, "examples", "quality_table.json"), "w") as f:
+    order = {label: i for i, (label, _, _) in enumerate(TARGETS)}
+    table.sort(key=lambda r: order.get(r["target"], len(order)))
+    with open(table_path, "w") as f:
         json.dump(table, f, indent=1)
 
 

@@ -137,7 +137,12 @@ def test_quality_table_row_reproduces(row):
 
 
 def test_quality_table_exists():
-    """The committed table must be present and cover the advertised
-    targets (4 DES S1 outputs + 3 crypto1 filters)."""
-    rows = _table_rows()
-    assert len(rows) == 7, "quality_table.json missing or incomplete"
+    """The committed table must be present and cover at least the
+    advertised core targets (4 DES S1 outputs + 3 crypto1 filters;
+    further rows — e.g. DES S2-S8 — are additive)."""
+    rows = {r["target"] for r in _table_rows()}
+    need = {
+        "des_s1_bit0", "des_s1_bit1", "des_s1_bit2", "des_s1_bit3",
+        "crypto1_fa", "crypto1_fb", "crypto1_fc",
+    }
+    assert need <= rows, f"missing rows: {need - rows}"
