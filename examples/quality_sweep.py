@@ -1,11 +1,14 @@
-"""Seed-swept best gate counts: DES S1 outputs 0-3 + crypto1 filters.
+"""Seed-swept best gate counts: the quality table.
 
 Widens the round-4 quality showcase (17-gate DES S1 bit 0 vs the
 reference README's 19-gate des_s1_bit0.svg, reference README.md:33-34)
 from one data point to a table: for each target, sweep N seeds of the
-randomized gate-mode search under the showcase's gate family
+randomized search under the showcase's gate family
 (avail_gates_bitfield=214 — AND, both ANDNOT forms, XOR, OR) with a
-ratcheting gate budget, and commit the best circuit found.
+ratcheting gate budget, and commit the best circuit found.  Rows cover
+DES S1 outputs 0-3 and the crypto1 filters in gate mode, DES S2-S8
+bit 0 in gate mode, and all eight DES boxes' bit 0 in LUT mode
+(3-input LUT graphs; rows carry lut_mode=true and count LUTs).
 
 Each row is deterministically reproducible: `best_seed` under a
 `max_gates` budget of (best+1 extra node) re-derives `best_gates` —
@@ -50,35 +53,44 @@ GATE_FAMILY = 214  # the showcase family: AND | ANDNOT both | XOR | OR
 INITIAL_EXTRA = 18  # first-seed budget: inputs + 18 candidate nodes
 # (the round-4 showcase swept at max_gates = 24 total for the 6-input
 # target; larger first budgets make failing seeds exponentially slow)
+INITIAL_EXTRA_LUT = 12  # LUT graphs are ~2x denser (a 3-LUT subsumes
+# several 2-input gates), so the tight first budget is lower
 
 # Rows whose circuit may already exist under a committed canonical
 # name (see the module docstring's curation note).
 CANONICAL_ARTIFACTS = {"des_s1_bit0": "des_s1_bit0_17gates.xml"}
 
-# (label, sbox file, output bit)
+# (label, sbox file, output bit, lut_mode)
 TARGETS = [
-    ("des_s1_bit0", "des_s1.txt", 0),
-    ("des_s1_bit1", "des_s1.txt", 1),
-    ("des_s1_bit2", "des_s1.txt", 2),
-    ("des_s1_bit3", "des_s1.txt", 3),
-    ("crypto1_fa", "crypto1_fa.txt", 0),
-    ("crypto1_fb", "crypto1_fb.txt", 0),
-    ("crypto1_fc", "crypto1_fc.txt", 0),
-] + [(f"des_s{i}_bit0", f"des_s{i}.txt", 0) for i in range(2, 9)]
+    ("des_s1_bit0", "des_s1.txt", 0, False),
+    ("des_s1_bit1", "des_s1.txt", 1, False),
+    ("des_s1_bit2", "des_s1.txt", 2, False),
+    ("des_s1_bit3", "des_s1.txt", 3, False),
+    ("crypto1_fa", "crypto1_fa.txt", 0, False),
+    ("crypto1_fb", "crypto1_fb.txt", 0, False),
+    ("crypto1_fc", "crypto1_fc.txt", 0, False),
+] + [
+    (f"des_s{i}_bit0", f"des_s{i}.txt", 0, False) for i in range(2, 9)
+] + [
+    # LUT-mode rows (3-input LUT graphs, the reference front page's own
+    # headline mode for AES): counted in LUTs, not 2-input gates.
+    (f"des_s{i}_bit0_lut", f"des_s{i}.txt", 0, True) for i in range(1, 9)
+]
 
 
-def sweep_target(label, sbox_file, bit, seeds):
+def sweep_target(label, sbox_file, bit, seeds, lut_mode=False):
     sbox, n = load_sbox(os.path.join(REPO, "sboxes", sbox_file))
     target = np.asarray(tt.target_table(sbox, bit))
     mask = np.asarray(tt.mask_table(n))
     best = None  # (gates, seed, budget_at_best, state)
-    budget = n + INITIAL_EXTRA
+    budget = n + (INITIAL_EXTRA_LUT if lut_mode else INITIAL_EXTRA)
     while best is None:
         for seed in range(seeds):
             st = State.init_inputs(n)
             st.max_gates = budget
             ctx = SearchContext(
-                Options(seed=seed, avail_gates_bitfield=GATE_FAMILY)
+                Options(seed=seed, avail_gates_bitfield=GATE_FAMILY,
+                        lut_graph=lut_mode)
             )
             out = create_circuit(ctx, st, target, mask, [])
             if out == NO_GATE:
@@ -121,8 +133,10 @@ def main():
         with open(table_path) as f:
             table = [r for r in json.load(f) if r["target"] not in only]
     targets = [t for t in TARGETS if not only or t[0] in only]
-    for label, sbox_file, bit in targets:
-        gates, seed, budget, st = sweep_target(label, sbox_file, bit, seeds)
+    for label, sbox_file, bit, lut_mode in targets:
+        gates, seed, budget, st = sweep_target(
+            label, sbox_file, bit, seeds, lut_mode
+        )
         xml = xmlio.state_to_xml(st)
         path = os.path.join(REPO, "examples", f"{label}_best.xml")
         # Canonicalize onto an already-committed identical artifact
@@ -141,13 +155,14 @@ def main():
             {"target": label, "sbox": sbox_file, "bit": bit,
              "best_gates": gates, "best_seed": seed, "budget": budget,
              "gate_family": GATE_FAMILY, "seeds_swept": seeds,
+             "lut_mode": lut_mode,
              "artifact": os.path.basename(path)}
         )
         print(
             f"{label}: {gates} gates (seed {seed}, budget {budget})",
             flush=True,
         )
-    order = {label: i for i, (label, _, _) in enumerate(TARGETS)}
+    order = {t[0]: i for i, t in enumerate(TARGETS)}
     table.sort(key=lambda r: order.get(r["target"], len(order)))
     with open(table_path, "w") as f:
         json.dump(table, f, indent=1)

@@ -102,14 +102,17 @@ def test_quality_table_artifact_is_correct(row):
     got = np.asarray(st.tables[out])
     assert np.array_equal(got & mask, target & mask)
     assert st.num_gates - st.num_inputs == row["best_gates"]
-    # The showcase 2-input family (bitfield 214) plus NOT: Kwan step 2
-    # reuses an existing gate's complement as a NOT gate, which the
-    # reference's own gate model includes and counts toward the total —
-    # no free inverters.
+    # Gate-mode rows: the showcase 2-input family (bitfield 214) plus
+    # NOT — Kwan step 2 reuses an existing gate's complement as a NOT
+    # gate, which the reference's own gate model includes and counts
+    # toward the total (no free inverters).  LUT-mode rows: 3-input
+    # LUTs plus the same step-1/2 reuse gates.
     from sboxgates_tpu.core import boolfunc as bf
 
     allowed = {bf.AND, bf.A_AND_NOT_B, bf.NOT_A_AND_B, bf.XOR, bf.OR,
                bf.NOT}
+    if row.get("lut_mode"):
+        allowed = allowed | {bf.LUT}
     used = {st.gates[i].type for i in range(st.num_inputs, st.num_gates)}
     assert used <= allowed, used
 
@@ -127,7 +130,8 @@ def test_quality_table_row_reproduces(row):
     st.max_gates = row["budget"]
     ctx = SearchContext(
         Options(seed=row["best_seed"],
-                avail_gates_bitfield=row["gate_family"])
+                avail_gates_bitfield=row["gate_family"],
+                lut_graph=bool(row.get("lut_mode")))
     )
     out = create_circuit(ctx, st, target, mask, [])
     assert out != NO_GATE
