@@ -75,6 +75,10 @@ TARGETS = [
     # LUT-mode rows (3-input LUT graphs, the reference front page's own
     # headline mode for AES): counted in LUTs, not 2-input gates.
     (f"des_s{i}_bit0_lut", f"des_s{i}.txt", 0, True) for i in range(1, 9)
+] + [
+    ("crypto1_fa_lut", "crypto1_fa.txt", 0, True),
+    ("crypto1_fb_lut", "crypto1_fb.txt", 0, True),
+    ("crypto1_fc_lut", "crypto1_fc.txt", 0, True),
 ]
 
 
