@@ -135,6 +135,9 @@ class Options:
     # dispatch latency, is the bottleneck and vmapped early-exit chains
     # execute both branches).
     parallel_mux: Optional[bool] = None
+    # Progress-heartbeat period for verbosity >= 2 runs (seconds; <= 0
+    # disables).  See SearchContext.heartbeat().
+    heartbeat_s: float = 60.0
     # Route gate-mode search nodes with <= NATIVE_STEP_MAX_G gates to the
     # native host runtime (csrc sbg_gate_step) instead of a device
     # dispatch.  At those sizes the full steps-1-4 space is microseconds
@@ -315,8 +318,59 @@ class SearchContext:
             "lut7_candidates": 0,
             "lut7_solved": 0,
         }
+        # Heartbeat state: a RUN-LEVEL mutable shared BY REFERENCE with
+        # every RestartContext view (their __dict__.update snapshot
+        # copies the reference, batched.py), so concurrent mux branches
+        # and engine-service calls share one throttle — one line per
+        # period per run, counting every view's activity.
+        import threading as _threading
+
+        self._hb = {"next": None, "t0": 0.0, "calls": 0}
+        self._hb_lock = _threading.Lock()
 
     # -- helpers ----------------------------------------------------------
+
+    def heartbeat(self, st: Optional[State] = None) -> None:
+        """Time-throttled progress line for hour-scale searches: at
+        verbosity >= 2, prints a liveness line every
+        ``Options.heartbeat_s`` seconds.  The reference has no live
+        progress signal at all (SURVEY §5) — an AES-class LUT search can
+        run for hours between find lines, and without this the only
+        liveness evidence is the process table.
+
+        ``steps`` counts every heartbeat call across ALL context views
+        (Python search nodes + engine device-work services, any
+        thread), so it advances during native-engine runs too.
+        ``cand`` is the CALLING view's candidate total — exact for the
+        common single-threaded run; branch-local (a lower bound) when
+        mux threads or the threaded engine service are active.  ``G``
+        is the calling branch's graph size.  The first beat fires one
+        period in, so short searches stay silent."""
+        if self.opt.verbosity < 2 or self.opt.heartbeat_s <= 0:
+            return
+        import time
+
+        now = time.monotonic()
+        hb = self._hb
+        with self._hb_lock:
+            hb["calls"] += 1
+            if hb["next"] is None:
+                hb["next"] = now + self.opt.heartbeat_s
+                hb["t0"] = now
+                return
+            if now < hb["next"]:
+                return
+            hb["next"] = now + self.opt.heartbeat_s
+            line = "[ hb ] t=%5ds steps=%d cand=%.4g G=%s" % (
+                int(now - hb["t0"]),
+                hb["calls"],
+                float(sum(
+                    v for k, v in self.stats.items()
+                    if k.endswith("_candidates")
+                )),
+                "?" if st is None else st.num_gates,
+            )
+        print(line, flush=True)
 
     def next_seed(self) -> int:
         """Per-dispatch kernel seed.  Negative when not randomizing: the

@@ -62,6 +62,7 @@ def _create_circuit(
     # Node driven by the Python engine (vs stats["engine_nodes"]): the
     # two counters give the engine-active node fraction of a run.
     ctx.stats["python_nodes"] = ctx.stats.get("python_nodes", 0) + 1
+    ctx.heartbeat(st)
 
     # Steps 1-4 in ONE fused device dispatch; budget gates are applied
     # host-side in the reference's order (sboxgates.c:301-435).  LUT mode
@@ -244,6 +245,7 @@ def _lut_engine_service(ctx: SearchContext, threaded: bool = False):
     merge_lock = threading.Lock()
 
     def run(cctx, kind, st, target, mask, inbits, arg0):
+        cctx.heartbeat(st)
         if kind == 1:  # pivot-sized space: full 5-LUT search
             with cctx.prof.phase("lut5"):
                 res = lutmod.lut5_search(cctx, st, target, mask, inbits)

@@ -123,3 +123,49 @@ def test_search_populates_phases():
     assert snap["kwan_host"][0] > 0
     # Phases appear in the report with the candidate-rate column.
     assert head in ctx.prof.report(ctx.stats)
+
+
+def test_heartbeat_throttled(capsys, monkeypatch):
+    """heartbeat() prints a progress line at most once per period, only
+    at verbosity >= 2; the throttle is RUN-level — RestartContext views
+    share it by reference, so concurrent branches can't each print."""
+    import time as _time
+
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.batched import Rendezvous, RestartContext
+
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(_time, "monotonic", lambda: clock["t"])
+
+    st = State.init_inputs(4)
+    ctx = SearchContext(Options(verbosity=2, heartbeat_s=60.0))
+    ctx.stats["lut5_candidates"] = 12345
+    ctx.heartbeat(st)  # arms; silent
+    clock["t"] += 30
+    ctx.heartbeat(st)  # mid-period; silent
+    assert capsys.readouterr().out == ""
+    clock["t"] += 31
+    ctx.heartbeat(st)  # past the period; prints
+    out = capsys.readouterr().out
+    assert "[ hb ]" in out and "steps=3" in out and "G=4" in out
+    ctx.heartbeat(st)  # re-armed; silent again
+    assert capsys.readouterr().out == ""
+
+    # A RestartContext view (mux branch / threaded engine service)
+    # shares the run-level throttle: its call right after the base's
+    # beat stays silent, and when the period passes it prints the
+    # RUN-level step count (5 calls so far + its own).
+    view = RestartContext(ctx, 7, Rendezvous(1))
+    view.heartbeat(st)
+    assert capsys.readouterr().out == ""
+    clock["t"] += 61
+    view.heartbeat(st)
+    out = capsys.readouterr().out
+    assert "steps=6" in out
+
+    quiet = SearchContext(Options(verbosity=1, heartbeat_s=60.0))
+    quiet.heartbeat(st)
+    clock["t"] += 120
+    quiet.heartbeat(st)
+    assert capsys.readouterr().out == ""
