@@ -599,6 +599,69 @@ def bench_lut5_g500_slice(n_tiles=8 if SMOKE else 1500) -> dict:
     }
 
 
+def bench_host_stream_pipeline(g=None) -> list:
+    """Serial-vs-pipelined A/B of the host-chunked 5-LUT fallback
+    (search.lut._lut5_search_host): the same full no-hit C(g,5) sweep
+    driven at pipeline_depth=1 (the historical strictly-serial driver)
+    and at the default depth 2 (async double-buffered chunk pipeline —
+    background unrank/filter/pad producer + multiple filter dispatches
+    in flight), interleaved in one window so throttle drift hits both
+    arms equally.  Reports host-stream candidates/sec for each arm, the
+    speedup, and the profiler's overlap accounting (device-wait,
+    host-produce, consumer-stall, and off-critical-path seconds) — the
+    latter shows the pipeline working even where raw rates are noisy
+    (e.g. CPU-only CI): off_critical_path_s -> host_produce_s means the
+    consumer never waited for combination generation.
+
+    Production only routes here past int32 rank arithmetic
+    (C(g,5) >= 2**31, i.e. g >= 386); driving the driver directly at a
+    small g keeps the entry minutes-scale while exercising the identical
+    code path and per-chunk work shape."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search import lut as slut
+
+    if g is None:
+        g = 40 if SMOKE else 64
+    st, target, mask = build_state(g)
+
+    def sweep(depth):
+        ctx = SearchContext(Options(seed=1, lut_graph=True,
+                                    pipeline_depth=depth))
+        t0 = time.perf_counter()
+        res = slut._lut5_search_host(ctx, st, target, mask, [])
+        dt = time.perf_counter() - t0
+        assert res is None, "unexpected 5-LUT hit in bench state"
+        return ctx.stats["lut5_candidates"] / dt, ctx
+
+    sweep(2)  # warmup/compile (depth 1 shares the jitted filter)
+    rates = {1: [], 2: []}
+    overlap = None
+    for _ in range(REPEATS):
+        rates[1].append(sweep(1)[0])
+        r2, c2 = sweep(2)
+        rates[2].append(r2)
+        overlap = c2.prof.overlap().get("lut5.host_stream")
+
+    def spread(vals):
+        vals = sorted(vals)
+        return {"value": vals[len(vals) // 2], "min": vals[0],
+                "max": vals[-1], "reps": len(vals)}
+
+    s1, s2 = spread(rates[1]), spread(rates[2])
+    space = math.comb(g, 5)
+    return [
+        {"metric": "lut5_host_stream_serial", **s1, "unit": "cand/s",
+         "space": space, "pipeline_depth": 1},
+        {"metric": "lut5_host_stream_pipelined", **s2, "unit": "cand/s",
+         "space": space, "pipeline_depth": 2,
+         "speedup_vs_serial": round(s2["value"] / s1["value"], 3),
+         # Last pipelined sweep's per-phase overlap accounting:
+         # off_critical_path_s -> host_produce_s means the consumer
+         # never waited for combination generation.
+         "overlap": overlap},
+    ]
+
+
 def bench_cpu_baseline() -> list:
     """Reference-shaped C++ loop, candidates/sec — measured on the SAME
     G=200 state as the headline device sweep (the per-candidate cost
@@ -1553,6 +1616,28 @@ def main() -> None:
         i = sys.argv.index("--gather-bench-worker")
         _gather_bench_worker(int(sys.argv[i + 1]), sys.argv[i + 2])
         return
+    if "--host-stream" in sys.argv:
+        # Standalone mode: just the serial-vs-pipelined host-stream A/B
+        # (the before/after evidence for the async chunk pipeline),
+        # written to BENCH_PIPELINE.json.  Honors JAX_PLATFORMS — on a
+        # CPU-only box run `JAX_PLATFORMS=cpu python bench.py
+        # --host-stream` (optionally SBG_BENCH_SMOKE=1 for the small g).
+        if SMOKE:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_host_stream_pipeline()
+        with open(os.path.join(HERE, "BENCH_PIPELINE.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+        pipelined = detail[-1]
+        print(json.dumps({
+            "metric": "lut5_host_stream_speedup",
+            "value": pipelined.get("speedup_vs_serial"),
+            "unit": "x (pipelined vs serial cand/s)",
+            "overlap": pipelined.get("overlap"),
+        }))
+        return
 
     def _last_committed_onchip():
         """Provenance of the last *committed* on-chip headline: value,
@@ -1709,7 +1794,7 @@ def main() -> None:
         # A/B's winner was re-captured through the real driver and beats
         # plain, that IS the production config (the decision rule flips
         # the default to it).
-        line_cfg = None
+        line_cfg, plain = None, dev
         if best == best and (dev != dev or best > dev):
             dev, line_cfg = best, cfg
         finite = dev == dev and cpu_rate == cpu_rate and cpu_rate > 0
@@ -1721,6 +1806,13 @@ def main() -> None:
         }
         if line_cfg:
             line["config"] = line_cfg
+            # The default flip is a separate reviewed code change, so a
+            # promoted best can overstate CURRENT production-default
+            # throughput — carry the plain-default rate too, making the
+            # line self-describing without chasing BENCH_DETAIL
+            # (ADVICE round 5).
+            if plain == plain:
+                line["value_plain"] = round(plain, 1)
         return line
 
     # Mid-run tunnel death watchdog (observed live in round 4: the
@@ -1838,16 +1930,23 @@ def main() -> None:
         # prevent.  On-chip t1 (tile_batch=1, pipeline off) IS the
         # production default, so "beats t1" = "beats production".
         if SMOKE:
-            return None, 0.0
+            return None, 0.0, 0.0
         e = entry or {}
         cfg, t1 = e.get("best_config"), e.get("t1")
         if cfg and e.get("best_variant") != "t1" and (
             t1 is None or e["best"] > t1
         ):
-            return cfg, e["best"]
-        return None, 0.0
+            # Third element: the winner's t1-normalized ratio (entry best
+            # / entry t1).  Each entry re-measures t1 in its own window
+            # precisely because throttle drift between windows skews raw
+            # cand/s; cross-entry promotion decisions must compare these
+            # ratios, not raw rates (ADVICE round 5).  0.0 when the entry
+            # has no t1 baseline — such a winner never supersedes one
+            # measured against its own baseline.
+            return cfg, e["best"], (e["best"] / t1 if t1 else 0.0)
+        return None, 0.0, 0.0
 
-    cfg, cfg_rate = _winning_cfg(ab)
+    cfg, _cfg_rate, cfg_ratio = _winning_cfg(ab)
     if cfg:
         # The armed decision rule's capture half: a variant beat plain,
         # so record the headline sweep under the winning config in the
@@ -1858,10 +1957,15 @@ def main() -> None:
         bench_pivot_tile_batch, LADDER_VARIANTS, "pivot_block_ladder",
         budget=3600.0, label="pivot_block_ladder",
     )
-    lcfg, lrate = _winning_cfg(lad)
-    if lcfg and lrate > cfg_rate and lcfg != cfg:
+    lcfg, _lrate, lratio = _winning_cfg(lad)
+    # t1-normalized promotion: the ladder ran in a different window than
+    # the core A/B, so raw cand/s across the two entries is throttle-
+    # drift-contaminated; compare each winner against its own window's
+    # t1 baseline instead (ADVICE round 5).
+    if lcfg and lratio > cfg_ratio and lcfg != cfg:
         run(bench_lut5_device, G_HEAD, lcfg)
     run(bench_lut5_g500_slice)
+    run(bench_host_stream_pipeline)
     run(bench_gate_mode_sweeps)
     run(bench_lut7)
     best = None

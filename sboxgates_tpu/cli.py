@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "sweep across processes (each process searches its "
                         "own slice on a local-device mesh) instead of "
                         "running every search as one pod-wide collective")
+    p.add_argument("--pipeline-depth", type=int, default=2, metavar="N",
+                   help="in-flight dispatches / prefetched chunks for the "
+                        "streaming sweep drivers (default 2; 1 = serial "
+                        "drivers, results are bit-identical either way)")
     p.add_argument("--serial-mux", action="store_true",
                    help="disable concurrent exploration of mux select bits "
                         "(single in-flight device sweep at a time)")
@@ -114,6 +118,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _err(f"Bad output value: {args.single_output}")
     if not (0 <= args.permute <= 255):
         return _err(f"Bad permutation value: {args.permute}")
+    if args.pipeline_depth < 1:
+        return _err(f"Bad pipeline depth value: {args.pipeline_depth}")
     if args.convert_c and args.convert_dot:
         return _err("Cannot combine c and d options.")
     if args.lut and args.sat_metric:
@@ -220,6 +226,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed,
         batch_restarts=args.batch_iterations,
         parallel_mux=False if args.serial_mux else None,
+        pipeline_depth=args.pipeline_depth,
     )
     mesh_plan = None
     if args.shard_sweep or args.mesh:

@@ -11,8 +11,11 @@ ranges because chunks themselves are split across devices.
 from __future__ import annotations
 
 import itertools
+import queue as _queue
+import threading
+import time
 from math import comb
-from typing import Iterator, Optional, Sequence
+from typing import Callable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -152,3 +155,139 @@ def pad_rows(a: np.ndarray, size: int, fill: int = 0) -> tuple:
         return a, valid
     pad = np.full((size - valid,) + a.shape[1:], fill, dtype=a.dtype)
     return np.concatenate([a, pad], axis=0), valid
+
+
+class ChunkPrefetcher:
+    """Background producer for the host-side streaming sweep drivers.
+
+    Runs ``CombinationStream.next_chunk`` + :func:`filter_exclude` +
+    :func:`pad_rows` in a worker thread, up to ``depth`` chunks ahead of
+    the consumer (bounded queue), so combination generation overlaps the
+    consumer's device dispatches instead of serializing with them.
+    ``depth <= 1`` degenerates to inline synchronous production — no
+    thread, exactly the historical serial behavior.
+
+    Chunk boundaries, contents, order, and padding are identical to the
+    serial loop for every depth: the producer is the only reader of the
+    stream and the queue preserves order, so first-hit semantics of the
+    consuming drivers stay deterministic.
+
+    ``get()`` returns ``(padded [chunk, k] int32, valid_count)`` tuples,
+    then ``None`` forever once the stream is exhausted.  A producer-side
+    exception is re-raised by the ``get()`` that would have returned the
+    failed chunk.  ``close()`` shuts the worker down promptly (used on an
+    early hit, and by ``__exit__`` on a consumer exception); it is
+    idempotent.
+
+    ``on_produce`` (``callable(start, end)``, perf_counter timestamps)
+    receives each chunk's host-side production span; ``on_stall`` (same
+    signature) receives each span the CONSUMER spent blocked inside
+    ``get()`` — waiting on the queue, or running the inline production
+    itself when ``depth <= 1``.  The profiler's overlap accounting uses
+    the pair to measure how much production time stayed off the
+    consumer's critical path: serial production is all stall (produce ==
+    stall), a fully warmed pipeline stalls ~0.
+    """
+
+    def __init__(
+        self,
+        stream: CombinationStream,
+        chunk_size: int,
+        exclude: Sequence[int] = (),
+        depth: int = 2,
+        on_produce: Optional[Callable[[float, float], None]] = None,
+        on_stall: Optional[Callable[[float, float], None]] = None,
+    ):
+        self.stream = stream
+        self.chunk_size = chunk_size
+        self.exclude = [int(b) for b in exclude]
+        self.depth = max(1, int(depth))
+        self.on_produce = on_produce
+        self.on_stall = on_stall
+        self._done = False
+        self._inline = self.depth <= 1
+        if not self._inline:
+            self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
+            self._exc: Optional[BaseException] = None
+            self._thread = threading.Thread(
+                target=self._work, name="sbg-chunk-prefetch", daemon=True
+            )
+            self._thread.start()
+
+    def _produce_one(self) -> Optional[Tuple[np.ndarray, int]]:
+        t0 = time.perf_counter()
+        chunk = self.stream.next_chunk(self.chunk_size)
+        if chunk is None:
+            item = None
+        else:
+            chunk = filter_exclude(chunk, self.exclude)
+            item = pad_rows(chunk, self.chunk_size)
+        if self.on_produce is not None:
+            self.on_produce(t0, time.perf_counter())
+        return item
+
+    def _work(self) -> None:
+        try:
+            while not self._stop.is_set():
+                item = self._produce_one()
+                self._put(item)
+                if item is None:
+                    return
+        except BaseException as e:  # surfaced by the consumer's get()
+            self._exc = e
+            self._put(None)
+
+    def _put(self, item) -> None:
+        # Bounded-blocking put that stays responsive to close(): a plain
+        # q.put would deadlock the join when the consumer stops reading.
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return
+            except _queue.Full:
+                continue
+
+    def get(self) -> Optional[Tuple[np.ndarray, int]]:
+        """Next (padded, valid_count) in stream order; None at the end."""
+        if self._done:
+            return None
+        t0 = time.perf_counter()
+        if self._inline:
+            item = self._produce_one()
+        else:
+            item = self._q.get()
+        if self.on_stall is not None:
+            self.on_stall(t0, time.perf_counter())
+        if item is None:
+            self._done = True
+            if not self._inline and self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+        return item
+
+    def close(self) -> None:
+        """Stops the worker promptly and joins it (idempotent)."""
+        self._done = True
+        if self._inline:
+            return
+        self._stop.set()
+        # Drain so a producer blocked on a full queue can observe _stop.
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+
+    @property
+    def closed(self) -> bool:
+        """True once no worker thread is running (inline mode: always)."""
+        return self._inline or not self._thread.is_alive()
+
+    def __enter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -22,6 +22,15 @@ filter and solve then needs process-local gathers
 (:func:`lut5_fused_step`) which avoids the host round-trip entirely —
 wiring the gather path is tracked for a later round.
 
+The sharded streams compose with the async chunk pipeline
+(``Options.pipeline_depth``): :func:`sharded_feasible_stream` dispatches
+return immediately under JAX async dispatch, so the drivers in
+``search/lut.py`` keep a speculative resume collective in flight while the
+host consumes the previous window, and the multi-host compact gather
+resolves inside ``SearchContext._multihost_dispatch``'s deferred
+``resolve()`` — dispatch now, DCN sync only when the consumer needs the
+verdict.
+
 A second mesh axis (``"restarts"``) batches independent randomized search
 restarts — parallelism the reference lacks (SURVEY.md §2.10): ``vmap`` over
 per-restart targets/seeds composes with the candidate sharding.
@@ -31,6 +40,8 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -361,11 +372,56 @@ def _sharded_pivot_fn(
     )
 
 
+# Process-wide pallas->xla fallback tally (sharded_pivot_stream): the
+# previous warnings.warn fired per call but Python's default filter
+# deduplicates it to ONE line per process, so a production mesh run that
+# silently inherited a flipped pallas default was easy to miss in long
+# logs (ADVICE round 5).  Every call increments this counter (mirrored
+# into the caller's ctx.stats when passed, so long runs can report it in
+# the -vv summary); the stderr line is rate-limited — the stream sits in
+# the per-tile-round hot loop, so printing every call would flood a
+# production log with identical lines.
+_PALLAS_FALLBACKS = 0
+_PALLAS_LOCK = threading.Lock()
+_PALLAS_PRINT_FIRST = 5
+_PALLAS_PRINT_EVERY = 1000
+
+
+def pallas_fallback_count() -> int:
+    """How many sharded pivot dispatches fell back from a pallas backend
+    to the XLA matmul half in this process."""
+    return _PALLAS_FALLBACKS
+
+
+def _note_pallas_fallback(backend: str, stats) -> None:
+    # Locked: parallel mux-branch threads reach the sharded pivot stream
+    # concurrently, and a lost read-modify-write would both under-count
+    # and break the rate-limit milestones (same n printed twice).  The
+    # caller's stats dict is shared across those threads too.
+    global _PALLAS_FALLBACKS
+    with _PALLAS_LOCK:
+        _PALLAS_FALLBACKS += 1
+        n = _PALLAS_FALLBACKS
+        if stats is not None:
+            stats["pivot_pallas_fallbacks"] = (
+                stats.get("pivot_pallas_fallbacks", 0) + 1
+            )
+    if n <= _PALLAS_PRINT_FIRST or n % _PALLAS_PRINT_EVERY == 0:
+        print(
+            f"sboxgates_tpu: SBG_PIVOT_BACKEND={backend!r} is "
+            "single-device-only; the mesh-sharded pivot stream falls "
+            "back to the XLA matmul half (bit-identical results) "
+            f"[fallback #{n} this process]",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def sharded_pivot_stream(
     plan: "MeshPlan", tables, lc1, lc0, hc, lowvalid, highvalid, descs,
     start_t, t_end, w_tab, m_tab, seed, *, tl: int, th: int,
     solve_rows: int = 64, pipeline: Optional[bool] = None,
-    backend: Optional[str] = None,
+    backend: Optional[str] = None, stats=None,
 ):
     """Mesh-sharded counterpart of sweeps.lut5_pivot_stream.  Returns
     verdict rows [n_devices, 10]: (status, tile, m, lo_abs, hi_abs, sigma,
@@ -375,9 +431,13 @@ def sharded_pivot_stream(
     ``xla`` / ``xla_bf16`` / ``xla_f8`` backends (same matmul half,
     bit-identical verdicts); the pallas kernels are single-device-only
     for now, so a pallas setting falls back to the XLA matmul half with
-    a warning rather than silently — or erroring a production mesh run
-    whose global default was flipped by the single-chip A/B.  Unknown
-    backend strings raise, matching lut5_pivot_stream's validation."""
+    a rate-limited stderr line (first few occurrences, then every
+    1000th — the exact count rides in :func:`pallas_fallback_count` and
+    in the per-call ``pivot_pallas_fallbacks`` counter of ``stats`` when
+    the caller passes its ctx.stats) rather than silently — or erroring
+    a production mesh run whose global default was flipped by the
+    single-chip A/B.  Unknown backend strings raise, matching
+    lut5_pivot_stream's validation."""
     if pipeline is None:
         from ..search.lut import pivot_pipeline
 
@@ -387,14 +447,7 @@ def sharded_pivot_stream(
 
         backend = pivot_backend()
     if backend.startswith("pallas"):
-        import warnings
-
-        warnings.warn(
-            f"SBG_PIVOT_BACKEND={backend!r} is single-device-only; the "
-            "mesh-sharded pivot stream falls back to the XLA matmul "
-            "half (bit-identical results)",
-            stacklevel=2,
-        )
+        _note_pallas_fallback(backend, stats)
         backend = "xla"
     if backend not in ("xla", "xla_bf16", "xla_f8"):
         raise ValueError(f"unknown pivot backend {backend!r}")

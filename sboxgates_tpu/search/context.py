@@ -9,8 +9,10 @@ candidate spaces through the jitted kernels in :mod:`sboxgates_tpu.ops.sweeps`.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -147,6 +149,16 @@ class Options:
     # results do not depend on the routing.  Disabled automatically when
     # the native library is unavailable.
     host_small_steps: bool = True
+    # In-flight dispatches / prefetched chunks for the streaming sweep
+    # drivers (the >int32-rank host fallbacks and the feasible-stream
+    # resume loops).  >= 2 keeps the device fed while the host
+    # unranks/filters/pads the next chunk (JAX async dispatch; the
+    # drivers sync only on compact verdicts) and overlaps host work
+    # under device waits; 1 reproduces the strictly serial drivers.
+    # First-hit results are bit-identical for every depth: chunks keep
+    # stream order, in-flight work issued after a hit is discarded, and
+    # the accepted hit is always the lowest-ranked feasible chunk.
+    pipeline_depth: int = 2
     # Run the WHOLE create_circuit recursion in a native engine
     # (csrc sbg_gate_engine / sbg_lut_engine) instead of Python driving
     # the per-node native steps: profiling showed ~64% of gate-mode
@@ -317,6 +329,10 @@ class SearchContext:
             "lut5_solved": 0,
             "lut7_candidates": 0,
             "lut7_solved": 0,
+            # pallas->xla fallbacks taken by the sharded pivot stream
+            # (mesh.py routes the once-per-call stderr signal here too
+            # so long runs can report it in the -vv summary).
+            "pivot_pallas_fallbacks": 0,
         }
         # Heartbeat state: a RUN-LEVEL mutable shared BY REFERENCE with
         # every RestartContext view (their __dict__.update snapshot
@@ -408,6 +424,44 @@ class SearchContext:
             return jnp.asarray(arr)
         return self.mesh_plan.replicate(np.asarray(arr))
 
+    @property
+    def pipeline_depth(self) -> int:
+        """In-flight dispatch / prefetch depth for the streaming sweep
+        drivers (Options.pipeline_depth, clamped to >= 1)."""
+        return max(1, int(self.opt.pipeline_depth))
+
+    def host_prefetcher(self, stream, chunk_size: int, exclude, phase: str):
+        """A :class:`sboxgates_tpu.ops.combinatorics.ChunkPrefetcher`
+        wired to this context: depth from Options.pipeline_depth, host-
+        produce and consumer-stall spans recorded against ``phase`` in
+        the profiler's overlap accounting.  The creating (consumer)
+        thread is the overlap stream's key, so the producer thread's
+        spans land in the right stream even when concurrent mux branches
+        share a phase name."""
+        ckey = threading.get_ident()
+        return comb.ChunkPrefetcher(
+            stream,
+            chunk_size,
+            exclude,
+            depth=self.pipeline_depth,
+            on_produce=lambda t0, t1: self.prof.add_produce(
+                phase, t0, t1, consumer=ckey
+            ),
+            on_stall=lambda t0, t1: self.prof.add_stall(
+                phase, t0, t1, consumer=ckey
+            ),
+        )
+
+    def sync_verdict(self, phase: Optional[str], value) -> np.ndarray:
+        """Blocks on a (compact) device value, recording the blocked span
+        as a ``phase`` device-wait interval for the overlap accounting."""
+        if phase is None:
+            return np.asarray(value)
+        t0 = time.perf_counter()
+        out = np.asarray(value)
+        self.prof.add_wait(phase, t0, time.perf_counter())
+        return out
+
     def _pair_combos_np(self, bucket: int) -> np.ndarray:
         """Host-side pair index grid per bucket (decode lookups must not
         touch the device — fetching the grid costs a full link round trip)."""
@@ -476,6 +530,21 @@ class SearchContext:
         device operands instead of re-uploading them every iteration.
         Returns (found, chunk_start, feasible, req1, req0, examined, chunk).
         """
+        return self.feasible_stream_dispatch(
+            st, target, mask, inbits, k, start=start, prebuilt=prebuilt
+        )()
+
+    def feasible_stream_dispatch(
+        self, st: State, target, mask, inbits, k: int, start: int = 0,
+        prebuilt=None, phase: Optional[str] = None,
+    ) -> Callable[[], tuple]:
+        """Async half of :meth:`feasible_stream_driver`: issues the device
+        dispatch immediately (JAX async dispatch — the kernel starts
+        running without blocking the host) and returns a zero-argument
+        ``resolve`` callable producing the driver's 7-tuple.  The
+        pipelined drivers keep >= 2 of these in flight, syncing only on
+        the compact verdict inside resolve(); ``phase`` names the
+        profiler overlap row the blocked time is charged to."""
         if prebuilt is None:
             prebuilt = self.stream_args(st, target, mask, inbits, k)
         base_args, total, chunk = prebuilt
@@ -489,7 +558,7 @@ class SearchContext:
             n = self.mesh_plan.n_candidate_shards
             chunk = -(-chunk // n) * n
             if self.mesh_plan.spans_processes:
-                return self._multihost_stream(args, k, chunk, n)
+                return self._multihost_dispatch(args, k, chunk, n, phase)
             verdict, feas, r1, r0 = sharded_feasible_stream(
                 self.mesh_plan, *args, k=k, chunk=chunk
             )
@@ -497,19 +566,29 @@ class SearchContext:
             verdict, feas, r1, r0 = sweeps.feasible_stream(
                 *args, k=k, chunk=chunk
             )
-        # ONE verdict fetch; the big per-chunk arrays stay on device and are
-        # pulled by callers only on a hit (each fetch pays a full host link
-        # round trip).
-        found, cstart, examined = (int(x) for x in np.asarray(verdict))
-        return bool(found), cstart, feas, r1, r0, examined, chunk
 
-    def _multihost_stream(self, args, k: int, chunk: int, n: int):
-        """Multi-host branch of :meth:`feasible_stream_driver`: the
+        def resolve():
+            # ONE verdict fetch; the big per-chunk arrays stay on device
+            # and are pulled by callers only on a hit (each fetch pays a
+            # full host link round trip).
+            found, cstart, examined = (
+                int(x) for x in self.sync_verdict(phase, verdict)
+            )
+            return bool(found), cstart, feas, r1, r0, examined, chunk
+
+        return resolve
+
+    def _multihost_dispatch(
+        self, args, k: int, chunk: int, n: int, phase: Optional[str] = None
+    ) -> Callable[[], tuple]:
+        """Multi-host branch of :meth:`feasible_stream_dispatch`: the
         compacted gather ships O(GATHER_ROWS) rows per device over DCN
         instead of the whole chunk; per-device feasible counts ride in the
         verdict, and the rare over-budget chunk is re-driven through the
         full gather so no feasible row is ever dropped (completeness is
-        identical to the single-host stream)."""
+        identical to the single-host stream).  The collective is issued
+        now; the verdict sync and (rare) overflow re-drive happen inside
+        the returned resolve()."""
         from ..parallel.mesh import GATHER_ROWS, sharded_feasible_stream
 
         per = chunk // n
@@ -517,30 +596,34 @@ class SearchContext:
         verdict, row_idx, feas_c, r1_c, r0_c = sharded_feasible_stream(
             self.mesh_plan, *args, k=k, chunk=chunk, compact=True
         )
-        vec = np.asarray(verdict)
-        found, cstart, examined = (int(x) for x in vec[:3])
-        counts = vec[3:]
-        if not found:
-            return False, cstart, None, None, None, examined, chunk
-        if counts.max() > cap:
-            # Overflow: fetch this exact chunk in full (start=cstart).
-            _, feas, r1, r0 = sharded_feasible_stream(
-                self.mesh_plan, *args[:-2], cstart, args[-1], k=k,
-                chunk=chunk, compact=False,
-            )
+
+        def resolve():
+            vec = self.sync_verdict(phase, verdict)
+            found, cstart, examined = (int(x) for x in vec[:3])
+            counts = vec[3:]
+            if not found:
+                return False, cstart, None, None, None, examined, chunk
+            if counts.max() > cap:
+                # Overflow: fetch this exact chunk in full (start=cstart).
+                _, feas, r1, r0 = sharded_feasible_stream(
+                    self.mesh_plan, *args[:-2], cstart, args[-1], k=k,
+                    chunk=chunk, compact=False,
+                )
+                return True, cstart, feas, r1, r0, examined, chunk
+            # Reconstruct the dense per-chunk arrays from the compacted
+            # rows.
+            ridx = np.asarray(row_idx)
+            fc = np.asarray(feas_c)
+            r1c, r0c = np.asarray(r1_c), np.asarray(r0_c)
+            feas = np.zeros(chunk, dtype=bool)
+            r1 = np.zeros((chunk,) + r1c.shape[1:], dtype=r1c.dtype)
+            r0 = np.zeros_like(r1)
+            feas[ridx[fc]] = True
+            r1[ridx[fc]] = r1c[fc]
+            r0[ridx[fc]] = r0c[fc]
             return True, cstart, feas, r1, r0, examined, chunk
-        # Reconstruct the dense per-chunk arrays from the compacted rows.
-        row_idx = np.asarray(row_idx)
-        feas_c = np.asarray(feas_c)
-        r1_c, r0_c = np.asarray(r1_c), np.asarray(r0_c)
-        feas = np.zeros(chunk, dtype=bool)
-        r1 = np.zeros((chunk,) + r1_c.shape[1:], dtype=r1_c.dtype)
-        r0 = np.zeros_like(r1)
-        sel = feas_c
-        feas[row_idx[sel]] = True
-        r1[row_idx[sel]] = r1_c[sel]
-        r0[row_idx[sel]] = r0_c[sel]
-        return True, cstart, feas, r1, r0, examined, chunk
+
+        return resolve
 
     # -- sweep drivers ----------------------------------------------------
 

@@ -19,6 +19,8 @@ the same kernels.
 
 from __future__ import annotations
 
+from collections import deque
+from contextlib import closing
 from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -428,7 +430,7 @@ def _lut5_search_pivot(
                 sharded_pivot_stream(
                     ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
                     start_t, t_real, jw, jm, ctx.next_seed(),
-                    tl=tl, th=th,
+                    tl=tl, th=th, stats=ctx.stats,
                 )
             )
             next_t = int(verdicts[0, 9])
@@ -507,21 +509,47 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         )
 
     prebuilt = ctx.stream_args(st, target, mask, inbits, 5)
-    start = 0
-    while start < total:
-        found, cstart, feas, r1, r0, examined, chunk = ctx.feasible_stream_driver(
-            st, target, mask, inbits, k=5, start=start, prebuilt=prebuilt
+    phase = "lut5.stream"
+    depth = ctx.pipeline_depth
+
+    def dispatch(start):
+        if start >= total:
+            return None
+        return ctx.feasible_stream_dispatch(
+            st, target, mask, inbits, k=5, start=start, prebuilt=prebuilt,
+            phase=phase,
         )
+
+    resolve = dispatch(0)
+    solve_failed = False
+    while resolve is not None:
+        found, cstart, feas, r1, r0, examined, chunk = resolve()
         ctx.stats["lut5_candidates"] += examined
         if not found:
             return None
+        # Speculative resume: the next rank window's stream launches
+        # before the host solves this chunk's feasible tuples (its start
+        # depends only on the verdict).  A successful solve below simply
+        # discards the in-flight dispatch, so the accepted hit is still
+        # the lowest-ranked feasible chunk — identical to the serial
+        # loop.  Gated on a prior failed solve: feasible chunks usually
+        # solve, and an abandoned resume stream still scans (possibly
+        # the whole remaining space) on device, delaying the next node's
+        # dispatches — so speculation arms only once this search has
+        # shown the failure-heavy pattern it pays off in.
+        resolve = (
+            dispatch(cstart + chunk) if depth >= 2 and solve_failed
+            else None
+        )
         res = _lut5_solve_feasible_chunk(
             ctx, st, target, mask, cstart, feas, r1, r0, jw, jm,
             splits, w_tab, m_tab,
         )
         if res is not None:
             return res
-        start = cstart + chunk
+        solve_failed = True
+        if resolve is None:
+            resolve = dispatch(cstart + chunk)
     return None
 
 
@@ -631,40 +659,86 @@ def lut5_resume_overflow(
     return res
 
 
-def _lut5_search_host(
-    ctx: SearchContext, st: State, target, mask, inbits
-) -> Optional[dict]:
-    """Host-chunked fallback for spaces beyond int32 rank arithmetic."""
+def _host_feasible_chunks(
+    ctx: SearchContext, st: State, target, mask, inbits,
+    k: int, chunk_cap: int, stat_key: str, phase: str,
+):
+    """Pipelined host-chunked feasibility stream shared by the lut5 and
+    lut7 host fallbacks (spaces beyond int32 rank arithmetic).
+
+    A background producer (Options.pipeline_depth) streams unrank +
+    filter-exclude + pad up to ``depth`` chunks ahead while as many
+    ``lut_filter`` dispatches stay in flight on the device; the consumer
+    side syncs only a per-chunk any-feasible scalar (the big feas/req
+    arrays stay on device until a hit).  Yields
+    ``(padded, feas[:csize] bool, req1p, req0p)`` for verdict-true
+    chunks, in strict stream order.  Candidates are charged to
+    ``ctx.stats[stat_key]`` as each chunk is consumed, so a driver that
+    stops early (hit / cap) leaves in-flight chunks uncounted — the
+    accounting and yielded sequence are bit-identical to the serial
+    (depth=1) loops.  Drivers iterate under ``contextlib.closing`` so an
+    early exit unwinds the generator and joins the producer promptly."""
     g = st.num_gates
-    splits, w_tab, m_tab = sweeps.lut5_split_tables()
-    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
     tables, _ = ctx.device_tables(st)
     jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
     excl = [b for b in inbits if b >= 0]
-    stream = comb.CombinationStream(g, 5)
-    csize = pick_chunk(stream.total, LUT5_CHUNK)
-    while True:
-        chunk = stream.next_chunk(csize)
-        if chunk is None:
-            return None
-        chunk = comb.filter_exclude(chunk, excl)
-        padded, nvalid = comb.pad_rows(chunk, csize)
-        ctx.stats["lut5_candidates"] += nvalid
-        valid = ctx.place_chunk(np.arange(csize) < nvalid)
-        feas, req1p, req0p = sweeps.lut_filter(
-            tables, ctx.place_chunk(padded), valid, jtarget, jmask
-        )
-        feas = np.asarray(feas)[:csize]
-        if not feas.any():
-            continue
-        fidx = np.nonzero(feas)[0]
-        res = _solve_lut5_rows(
-            ctx, st, target, mask, padded[fidx],
-            np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
-            jw, jm, splits, w_tab, m_tab,
-        )
-        if res is not None:
-            return res
+    stream = comb.CombinationStream(g, k)
+    csize = pick_chunk(stream.total, chunk_cap)
+    depth = ctx.pipeline_depth
+    with ctx.host_prefetcher(stream, csize, excl, phase) as pf:
+        inflight: deque = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < depth:
+                item = pf.get()
+                if item is None:
+                    exhausted = True
+                    break
+                padded, nvalid = item
+                valid = ctx.place_chunk(np.arange(csize) < nvalid)
+                feas, req1p, req0p = sweeps.lut_filter(
+                    tables, ctx.place_chunk(padded), valid, jtarget, jmask
+                )
+                # Compact per-chunk verdict: pad rows are invalid and so
+                # never feasible, so any(feas) == any(feas[:csize]).
+                inflight.append(
+                    (padded, nvalid, jnp.any(feas), feas, req1p, req0p)
+                )
+            if not inflight:
+                return
+            padded, nvalid, hit, feas, req1p, req0p = inflight.popleft()
+            ctx.stats[stat_key] += nvalid
+            if not bool(ctx.sync_verdict(phase, hit)):
+                continue
+            yield padded, np.asarray(feas)[:csize], req1p, req0p
+
+
+def _lut5_search_host(
+    ctx: SearchContext, st: State, target, mask, inbits
+) -> Optional[dict]:
+    """Host-chunked fallback for spaces beyond int32 rank arithmetic.
+
+    Pipelined via :func:`_host_feasible_chunks`; chunks resolve strictly
+    in stream order and in-flight work past a hit is discarded, so the
+    returned decomposition — and the candidate statistics — are
+    bit-identical to the serial (depth=1) driver."""
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+    chunks = _host_feasible_chunks(
+        ctx, st, target, mask, inbits, k=5, chunk_cap=LUT5_CHUNK,
+        stat_key="lut5_candidates", phase="lut5.host_stream",
+    )
+    with closing(chunks):
+        for padded, feas, req1p, req0p in chunks:
+            fidx = np.nonzero(feas)[0]
+            res = _solve_lut5_rows(
+                ctx, st, target, mask, padded[fidx],
+                np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
+                jw, jm, splits, w_tab, m_tab,
+            )
+            if res is not None:
+                return res
+    return None
 
 
 # -------------------------------------------------------------------------
@@ -675,7 +749,19 @@ def _lut5_search_host(
 def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
     """Stage A: stream the C(G,7) space through the feasibility filter,
     collecting up to LUT7_CAP feasible tuples (reference: lut.c:290-327).
-    Returns (combos, req1, req0) arrays, possibly empty."""
+    Returns (combos, req1, req0) arrays, possibly empty.
+
+    Both branches pipeline under Options.pipeline_depth >= 2: the device
+    stream issues the resume dispatch for the next rank window before the
+    host unranks the current window's hit rows (gated on demonstrated
+    LUT7_CAP headroom, so a dispatch abandoned at the cap crossing is
+    rare), and the host-chunk
+    fallback runs the background chunk producer with up to ``depth``
+    filter dispatches in flight, syncing per-chunk any-feasible scalars.
+    Hit collection stays in strict rank order either way, and speculative
+    work past the LUT7_CAP crossing is discarded uncounted, so the
+    returned hit list and the candidate statistics are identical to the
+    serial (depth=1) driver's."""
     g = st.num_gates
     use_device_stream = sweeps.device_rank_limit(g, 7)
 
@@ -683,20 +769,51 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
     hit_req1: List[np.ndarray] = []
     hit_req0: List[np.ndarray] = []
     nhits = 0
+    depth = ctx.pipeline_depth
+    phase = "lut7.stageA"
 
     if use_device_stream:
         total = comb.n_choose_k(g, 7)
         prebuilt = ctx.stream_args(st, target, mask, inbits, 7)
-        start = 0
-        while start < total and nhits < LUT7_CAP:
-            found, cstart, feas, r1, r0, examined, chunk = (
-                ctx.feasible_stream_driver(
-                    st, target, mask, inbits, k=7, start=start, prebuilt=prebuilt
-                )
+
+        def dispatch(start):
+            if start >= total:
+                return None
+            return ctx.feasible_stream_dispatch(
+                st, target, mask, inbits, k=7, start=start,
+                prebuilt=prebuilt, phase=phase,
             )
+
+        resolve = dispatch(0)
+        # Worst per-window row count seen so far — the speculation gate's
+        # headroom estimate (None until the first window resolves).
+        max_rows = None
+        while resolve is not None and nhits < LUT7_CAP:
+            found, cstart, feas, r1, r0, examined, chunk = resolve()
             ctx.stats["lut7_candidates"] += examined
             if not found:
                 break
+            # Keep the device busy during the host-side fetch + unrank of
+            # this window's hit rows: the resume stream's start depends
+            # only on the verdict, so it can launch right now.  When the
+            # rows below cross LUT7_CAP the in-flight dispatch is simply
+            # dropped (its candidates intentionally uncounted — the
+            # serial driver never swept them) — but the device still runs
+            # the abandoned stream, which in a hit-sparse tail can scan
+            # the whole remaining C(G,7) space before stage B and the
+            # next node's sweeps get the device (the same cost
+            # lut5_search's solve_failed gate guards against).  So
+            # speculate only with demonstrated cap headroom: this
+            # window's rows are unknown until the expensive feas fetch
+            # below, so assume it and the next window each bring the
+            # worst row count seen so far and require the cap to survive
+            # both.  The first window always resolves serially (no
+            # history), matching lut5's initially-unarmed speculation.
+            speculate = (
+                depth >= 2 and max_rows is not None
+                and nhits + 2 * max_rows < LUT7_CAP
+            )
+            resolve = dispatch(cstart + chunk) if speculate else None
             feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
             rows = np.nonzero(feas)[0]
             hit_combos.append(
@@ -707,31 +824,30 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
             hit_req1.append(r1[rows])
             hit_req0.append(r0[rows])
             nhits += len(rows)
-            start = cstart + chunk
+            max_rows = max(max_rows or 0, len(rows))
+            if resolve is None and nhits < LUT7_CAP:
+                # No speculative dispatch was in flight (serial depth,
+                # first window, or insufficient headroom): resume only
+                # now that this window is fully consumed — and never
+                # past the cap.
+                resolve = dispatch(cstart + chunk)
     else:
-        tables, _ = ctx.device_tables(st)
-        jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
-        excl = [b for b in inbits if b >= 0]
-        stream = comb.CombinationStream(g, 7)
-        csize = pick_chunk(stream.total, LUT7_CHUNK)
-        while nhits < LUT7_CAP:
-            chunk = stream.next_chunk(csize)
-            if chunk is None:
-                break
-            chunk = comb.filter_exclude(chunk, excl)
-            padded, nvalid = comb.pad_rows(chunk, csize)
-            ctx.stats["lut7_candidates"] += nvalid
-            valid = ctx.place_chunk(np.arange(csize) < nvalid)
-            feas, req1p, req0p = sweeps.lut_filter(
-                tables, ctx.place_chunk(padded), valid, jtarget, jmask
-            )
-            feas = np.asarray(feas)[:csize]
-            if feas.any():
+        chunks = _host_feasible_chunks(
+            ctx, st, target, mask, inbits, k=7, chunk_cap=LUT7_CHUNK,
+            stat_key="lut7_candidates", phase=phase,
+        )
+        with closing(chunks):
+            for padded, feas, req1p, req0p in chunks:
                 fidx = np.nonzero(feas)[0]
                 hit_combos.append(padded[fidx])
                 hit_req1.append(np.asarray(req1p)[fidx])
                 hit_req0.append(np.asarray(req0p)[fidx])
                 nhits += len(fidx)
+                if nhits >= LUT7_CAP:
+                    # Same stopping rule as the serial loop's while-check:
+                    # chunks past the cap crossing are never consumed (and
+                    # their candidates never counted).
+                    break
 
     if nhits == 0:
         empty = np.zeros((0,), np.uint32)

@@ -1,0 +1,404 @@
+"""Async double-buffered chunk pipeline (Options.pipeline_depth).
+
+Covers the ISSUE-1 acceptance points: (a) the pipelined host-stream
+drivers return bit-identical first hits (rank, gate ids) and identical
+candidate statistics vs the serial path, (b) the prefetch queue shuts
+down cleanly on an early hit and on a consumer/producer exception, and
+(c) pipeline_depth=1 reproduces the historical strictly-serial drivers
+(no background thread at all).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.ops import combinatorics as comb
+from sboxgates_tpu.ops import sweeps
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search import lut as slut
+
+
+def _prefetch_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name.startswith("sbg-chunk-prefetch")
+    ]
+
+
+def _serial_chunks(g, k, csize, exclude):
+    """The historical serial loop's exact chunk sequence."""
+    stream = comb.CombinationStream(g, k)
+    out = []
+    while True:
+        chunk = stream.next_chunk(csize)
+        if chunk is None:
+            return out
+        chunk = comb.filter_exclude(chunk, exclude)
+        out.append(comb.pad_rows(chunk, csize))
+
+
+# -- ChunkPrefetcher unit tests -------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_prefetcher_matches_serial_chunks(depth):
+    g, k, csize, excl = 14, 5, 256, [3, 7]
+    expect = _serial_chunks(g, k, csize, excl)
+    got = []
+    with comb.ChunkPrefetcher(
+        comb.CombinationStream(g, k), csize, excl, depth=depth
+    ) as pf:
+        while True:
+            item = pf.get()
+            if item is None:
+                break
+            got.append(item)
+        # Exhausted streams keep returning None (drivers may over-poll).
+        assert pf.get() is None
+    assert len(got) == len(expect)
+    for (pa, na), (pb, nb) in zip(got, expect):
+        assert na == nb
+        np.testing.assert_array_equal(pa, pb)
+
+
+def test_prefetcher_depth1_is_inline():
+    """pipeline_depth=1 must reproduce the serial driver exactly: no
+    producer thread is ever spawned."""
+    before = _prefetch_threads()
+    pf = comb.ChunkPrefetcher(comb.CombinationStream(12, 5), 128, (), depth=1)
+    assert pf.get() is not None
+    assert _prefetch_threads() == before
+    assert pf.closed
+    pf.close()
+    assert pf.closed
+
+
+def test_prefetcher_early_close_joins_thread():
+    """Early hit: the consumer stops reading mid-stream; close() must
+    promptly unblock a producer stuck on the bounded queue and join it
+    (and stay idempotent)."""
+    pf = comb.ChunkPrefetcher(
+        comb.CombinationStream(30, 5), 64, (), depth=2
+    )
+    assert pf.get() is not None  # stream far from exhausted
+    pf.close()
+    assert pf.closed
+    assert pf.get() is None  # closed prefetcher yields nothing
+    pf.close()  # idempotent
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_producer_exception_propagates():
+    class Boom(RuntimeError):
+        pass
+
+    class FailingStream:
+        def __init__(self):
+            self.inner = comb.CombinationStream(20, 5)
+            self.calls = 0
+
+        def next_chunk(self, n):
+            self.calls += 1
+            if self.calls > 2:
+                raise Boom("producer died")
+            return self.inner.next_chunk(n)
+
+    pf = comb.ChunkPrefetcher(FailingStream(), 128, (), depth=2)
+    got = 0
+    with pytest.raises(Boom):
+        while True:
+            if pf.get() is None:
+                break
+            got += 1
+    assert got == 2  # the chunks produced before the failure arrived intact
+    assert pf.get() is None  # the failure ends the stream
+    pf.close()
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_consumer_exception_cleans_up():
+    """A consumer error inside the with-block must still join the worker
+    (the driver loops wrap the prefetcher in a context manager)."""
+    with pytest.raises(ValueError):
+        with comb.ChunkPrefetcher(
+            comb.CombinationStream(30, 5), 64, (), depth=3
+        ) as pf:
+            assert pf.get() is not None
+            raise ValueError("consumer died")
+    assert not _prefetch_threads()
+
+
+# -- Driver determinism on planted instances ------------------------------
+
+
+def _force_host_path(monkeypatch, chunk5=1024, chunk7=8192):
+    """Route lut5/lut7 searches through the host-chunked fallbacks with
+    small chunks so the planted spaces span many chunks."""
+    monkeypatch.setattr(sweeps, "device_rank_limit", lambda g, k: False)
+    monkeypatch.setattr(slut, "LUT5_CHUNK", chunk5)
+    monkeypatch.setattr(slut, "LUT7_CHUNK", chunk7)
+
+
+def _run_lut5(depth):
+    from planted import build_planted_lut5_small
+
+    st, target, mask = build_planted_lut5_small()
+    ctx = SearchContext(Options(seed=7, pipeline_depth=depth))
+    res = slut.lut5_search(ctx, st, target, mask, [])
+    return res, ctx
+
+
+def test_lut5_host_pipelined_identical_hit(monkeypatch):
+    from planted import build_planted_lut5_small, verify_lut5_result
+
+    _force_host_path(monkeypatch)
+    (serial, sctx) = _run_lut5(1)
+    assert serial is not None
+    st, target, mask = build_planted_lut5_small()
+    assert verify_lut5_result(st, target, mask, serial)
+    for depth in (2, 4):
+        piped, pctx = _run_lut5(depth)
+        assert piped is not None
+        # Bit-identical first hit: same decomposition, same gate ids.
+        assert tuple(piped["gates"]) == tuple(serial["gates"])
+        assert piped["func_outer"] == serial["func_outer"]
+        assert piped["func_inner"] == serial["func_inner"]
+        # Identical candidate accounting: in-flight chunks issued after
+        # the hit are discarded uncounted.
+        assert (
+            pctx.stats["lut5_candidates"] == sctx.stats["lut5_candidates"]
+        )
+        # Early hit mid-stream: the prefetcher thread must be gone.
+        assert not _prefetch_threads()
+
+
+def test_lut5_host_no_hit_exhausts_identically(monkeypatch):
+    """No-hit sweeps must examine the identical candidate set."""
+    from planted import build_planted_lut5_small
+
+    _force_host_path(monkeypatch)
+    st, _, mask = build_planted_lut5_small()
+    rng = np.random.default_rng(99)
+    # A random target is (overwhelmingly) not a 5-LUT of this state.
+    target = rng.integers(0, 2**32, size=8, dtype=np.uint32)
+    stats = []
+    for depth in (1, 3):
+        ctx = SearchContext(Options(seed=7, pipeline_depth=depth))
+        assert slut.lut5_search(ctx, st, target, mask, []) is None
+        stats.append(ctx.stats["lut5_candidates"])
+        assert not _prefetch_threads()
+    assert stats[0] == stats[1] > 0
+
+
+def test_lut7_host_collect_identical_hits(monkeypatch):
+    from planted import build_planted_lut7
+
+    _force_host_path(monkeypatch)
+    # A small cap exercises the discard-past-cap semantics: the planted
+    # instance has ~1.5k feasible tuples, far beyond 64.
+    monkeypatch.setattr(slut, "LUT7_CAP", 64)
+    st, target, mask = build_planted_lut7()
+    results = []
+    for depth in (1, 3):
+        ctx = SearchContext(Options(seed=7, pipeline_depth=depth))
+        combos, r1, r0 = slut._lut7_collect_hits(ctx, st, target, mask, [])
+        results.append((combos, r1, r0, ctx.stats["lut7_candidates"]))
+        assert not _prefetch_threads()
+    (ca, r1a, r0a, na), (cb, r1b, r0b, nb) = results
+    assert len(ca) > 0
+    np.testing.assert_array_equal(ca, cb)
+    np.testing.assert_array_equal(r1a, r1b)
+    np.testing.assert_array_equal(r0a, r0b)
+    assert na == nb
+
+
+def test_host_driver_consumer_error_joins_prefetcher(monkeypatch):
+    """lut_filter blowing up mid-sweep must not leak the producer."""
+    from planted import build_planted_lut5_small
+
+    _force_host_path(monkeypatch)
+
+    def boom(*a, **k):
+        raise RuntimeError("filter died")
+
+    monkeypatch.setattr(sweeps, "lut_filter", boom)
+    st, target, mask = build_planted_lut5_small()
+    ctx = SearchContext(Options(seed=7, pipeline_depth=3))
+    with pytest.raises(RuntimeError, match="filter died"):
+        slut.lut5_search(ctx, st, target, mask, [])
+    assert not _prefetch_threads()
+
+
+# -- Overlap accounting ----------------------------------------------------
+
+
+def test_profiler_overlap_accounting(monkeypatch):
+    from planted import build_planted_lut5_small
+
+    _force_host_path(monkeypatch)
+    st, _, mask = build_planted_lut5_small()
+    rng = np.random.default_rng(99)
+    target = rng.integers(0, 2**32, size=8, dtype=np.uint32)
+    # Deterministic producer-ahead: on a loaded CI box a starved
+    # producer can end up producing every chunk while the consumer sits
+    # blocked in get() — the produce spans then nest inside stall spans
+    # and off_critical_path_s legitimately reads ~0.  So between get()
+    # calls the consumer explicitly waits (outside any stall span) until
+    # the prefetch queue is full — guaranteeing chunks get produced off
+    # its critical path no matter how the threads are scheduled.
+    captured = {}
+    real_prefetcher = SearchContext.host_prefetcher
+
+    def capture_prefetcher(self, stream, chunk_size, exclude, phase):
+        pf = real_prefetcher(self, stream, chunk_size, exclude, phase)
+        captured["pf"] = pf
+        return pf
+
+    monkeypatch.setattr(SearchContext, "host_prefetcher", capture_prefetcher)
+    real_filter = sweeps.lut_filter
+
+    def queue_full_filter(*a, **k):
+        pf = captured.get("pf")
+        if pf is not None and not pf._inline:
+            deadline = time.perf_counter() + 10.0
+            while (
+                not pf._q.full() and pf._thread.is_alive()
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.001)
+        return real_filter(*a, **k)
+
+    monkeypatch.setattr(sweeps, "lut_filter", queue_full_filter)
+    ctx = SearchContext(Options(seed=7, pipeline_depth=2))
+    assert slut.lut5_search(ctx, st, target, mask, []) is None
+    ov = ctx.prof.overlap()
+    assert "lut5.host_stream" in ov
+    row = ov["lut5.host_stream"]
+    assert row["host_produce_s"] > 0.0
+    assert row["device_wait_s"] >= 0.0
+    # hidden_s is a measured intersection, so it can never exceed
+    # either side.
+    assert (
+        0.0 <= row["hidden_s"]
+        <= min(row["host_produce_s"], row["device_wait_s"]) + 1e-9
+    )
+    # Pipelined: the producer runs ahead, so most production time stays
+    # off the consumer's critical path.
+    assert row["off_critical_path_s"] > 0.0
+    # The overlap rows render in the -vv report.
+    assert "pipeline overlap" in ctx.prof.report(ctx.stats)
+    # Serial driver: production is inline inside get() — every produce
+    # span is also a stall span, so nothing reads as hidden or off the
+    # critical path.
+    sctx = SearchContext(Options(seed=7, pipeline_depth=1))
+    assert slut.lut5_search(sctx, st, target, mask, []) is None
+    srow = sctx.prof.overlap()["lut5.host_stream"]
+    assert srow["host_produce_s"] > 0.0
+    assert srow["consumer_stall_s"] >= srow["host_produce_s"]
+    assert srow["hidden_s"] == 0.0
+    assert srow["off_critical_path_s"] == 0.0
+
+
+def test_overlap_interval_intersection():
+    """The intersection is measured, not bounded: disjoint produce/wait
+    spans hide nothing even when both totals are large."""
+    from sboxgates_tpu.utils.profile import PhaseProfiler
+
+    prof = PhaseProfiler()
+    prof.add_wait("p", 0.0, 1.0)
+    prof.add_produce("p", 2.0, 3.0)  # disjoint
+    assert prof.overlap()["p"]["hidden_s"] == 0.0
+    prof.add_produce("p", 0.25, 0.75)  # nested in the wait
+    row = prof.overlap()["p"]
+    assert row["hidden_s"] == pytest.approx(0.5)
+    assert row["host_produce_s"] == pytest.approx(1.5)
+    # Overlapping produce spans are merged before intersecting.
+    prof.add_produce("p", 0.5, 0.9)
+    assert prof.overlap()["p"]["hidden_s"] == pytest.approx(0.65)
+    # off_critical_path = merged produce time that did NOT elapse inside
+    # a consumer stall — an interval measurement, so a disjoint stall
+    # (however long) eats nothing...
+    prof.add_stall("p", 5.0, 6.0)
+    row = prof.overlap()["p"]
+    assert row["consumer_stall_s"] == pytest.approx(1.0)
+    # merged produce: (0.25, 0.9) + (2, 3) = 1.65 s, none of it stalled.
+    assert row["off_critical_path_s"] == pytest.approx(1.65)
+    # ...a stall covering the (2, 3) produce span eats exactly it...
+    prof.add_stall("p", 1.5, 3.5)
+    assert prof.overlap()["p"]["off_critical_path_s"] == pytest.approx(0.65)
+    # ...and a stall blanket over every produce span zeroes the metric.
+    prof.add_stall("p", 0.0, 16.0)
+    assert prof.overlap()["p"]["off_critical_path_s"] == 0.0
+
+
+def test_overlap_folding_bounded_and_exact(monkeypatch):
+    """Long runs must not hold one interval tuple per chunk forever:
+    settled intervals fold into scalar accumulators, and folding must
+    not change any overlap number (each produce span is folded exactly
+    once, so summed per-fold intersections are exact)."""
+    from sboxgates_tpu.utils.profile import PhaseProfiler, _OverlapStream
+
+    monkeypatch.setattr(_OverlapStream, "FOLD_AT", 8)
+    n = 500
+    prof = PhaseProfiler()
+    # Pipelined-shaped pattern: produce (i, i+0.5) overlaps wait
+    # (i+0.25, i+0.75) by 0.25 and is disjoint from stall (i+0.8, i+0.9).
+    for i in range(n):
+        prof.add_produce("p", i, i + 0.5)
+        prof.add_wait("p", i + 0.25, i + 0.75)
+        prof.add_stall("p", i + 0.8, i + 0.9)
+    row = prof.overlap()["p"]
+    assert row["host_produce_s"] == pytest.approx(0.5 * n)
+    assert row["device_wait_s"] == pytest.approx(0.5 * n)
+    assert row["consumer_stall_s"] == pytest.approx(0.1 * n)
+    assert row["hidden_s"] == pytest.approx(0.25 * n)
+    assert row["off_critical_path_s"] == pytest.approx(0.5 * n)
+    stream = prof._overlap[("p", threading.get_ident())]
+    assert stream.pending_size() <= 3 * _OverlapStream.FOLD_AT
+    # Serial-shaped pattern: produce nested in stall — the exact-zero
+    # property must survive folding too.
+    sprof = PhaseProfiler()
+    for i in range(n):
+        sprof.add_stall("s", i, i + 0.6)
+        sprof.add_produce("s", i + 0.1, i + 0.5)
+        sprof.add_wait("s", i + 0.7, i + 0.9)
+    srow = sprof.overlap()["s"]
+    assert srow["off_critical_path_s"] == 0.0
+    assert srow["hidden_s"] == 0.0
+    # Producer-less pattern (device-stream drivers record only waits):
+    # the pending list is shed, the total is kept.
+    wprof = PhaseProfiler()
+    for i in range(n):
+        wprof.add_wait("w", i, i + 0.5)
+    assert wprof.overlap()["w"]["device_wait_s"] == pytest.approx(0.5 * n)
+    wstream = wprof._overlap[("w", threading.get_ident())]
+    assert wstream.pending_size() <= 3 * _OverlapStream.FOLD_AT
+
+
+def test_overlap_streams_keyed_per_consumer():
+    """Concurrent drivers sharing a phase name must not cross-pollinate:
+    consumer A's produce span inside consumer B's device wait is NOT
+    hidden work (it saved B nothing)."""
+    from sboxgates_tpu.utils.profile import PhaseProfiler
+
+    prof = PhaseProfiler()
+    # Consumer A: strictly serial (produce inside its own stall).
+    prof.add_stall("p", 0.0, 1.0, consumer=1)
+    prof.add_produce("p", 0.2, 0.8, consumer=1)
+    # Consumer B: waiting on the device over that same wall-clock span.
+    prof.add_wait("p", 0.0, 1.0, consumer=2)
+    row = prof.overlap()["p"]
+    # One phase row, summed over consumers — but A's produce does not
+    # intersect B's wait, and A's own stall keeps it on-critical-path.
+    assert row["host_produce_s"] == pytest.approx(0.6)
+    assert row["device_wait_s"] == pytest.approx(1.0)
+    assert row["hidden_s"] == 0.0
+    assert row["off_critical_path_s"] == 0.0
+
+
+def test_cli_rejects_bad_pipeline_depth():
+    from sboxgates_tpu.cli import main
+
+    assert main(["--pipeline-depth", "0"]) != 0
