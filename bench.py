@@ -599,7 +599,7 @@ def bench_lut5_g500_slice(n_tiles=8 if SMOKE else 1500) -> dict:
     }
 
 
-def bench_host_stream_pipeline(g=None) -> list:
+def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
     """Serial-vs-pipelined A/B of the host-chunked 5-LUT fallback
     (search.lut._lut5_search_host): the same full no-hit C(g,5) sweep
     driven at pipeline_depth=1 (the historical strictly-serial driver)
@@ -636,11 +636,31 @@ def bench_host_stream_pipeline(g=None) -> list:
     sweep(2)  # warmup/compile (depth 1 shares the jitted filter)
     rates = {1: [], 2: []}
     overlap = None
-    for _ in range(REPEATS):
-        rates[1].append(sweep(1)[0])
-        r2, c2 = sweep(2)
-        rates[2].append(r2)
-        overlap = c2.prof.overlap().get("lut5.host_stream")
+    # Runtime jaxlint complements over the measured window: steady state
+    # must not recompile (a varying static arg here would silently halve
+    # the pipelined arm), and the per-chunk verdict syncs are tallied so
+    # a regression that adds hidden per-chunk transfers shows up in the
+    # report.  --sync-guard makes both fail loudly instead of reporting.
+    from sboxgates_tpu.utils import recompile_guard, sync_guard
+
+    compile_budget = 0 if strict_guards else (1 << 30)
+    sync_budget = 0 if strict_guards else (1 << 30)
+    if strict_guards:
+        # strict mode still permits the deliberate per-chunk verdict
+        # syncs: every chunk resolves one compact feasibility verdict
+        # (see the jaxlint R2 suppressions in search/lut.py), so budget
+        # proportional to the swept space, not zero.
+        per_sweep_chunks = -(-math.comb(g, 5) // slut.LUT5_CHUNK) + 2
+        sync_budget = 4 * REPEATS * 2 * per_sweep_chunks
+    with recompile_guard(allowed=compile_budget, label="host-stream bench") \
+            as creport, \
+            sync_guard(allowed=sync_budget, action="raise",
+                       label="host-stream bench") as sreport:
+        for _ in range(REPEATS):
+            rates[1].append(sweep(1)[0])
+            r2, c2 = sweep(2)
+            rates[2].append(r2)
+            overlap = c2.prof.overlap().get("lut5.host_stream")
 
     def spread(vals):
         vals = sorted(vals)
@@ -658,7 +678,13 @@ def bench_host_stream_pipeline(g=None) -> list:
          # Last pipelined sweep's per-phase overlap accounting:
          # off_critical_path_s -> host_produce_s means the consumer
          # never waited for combination generation.
-         "overlap": overlap},
+         "overlap": overlap,
+         # Runtime-guard tallies over the measured window (jaxlint's
+         # runtime complement): compiles after warmup mean a static arg
+         # is churning; syncs are the deliberate per-chunk verdicts.
+         "steady_state_compiles": creport.compiles,
+         "steady_state_syncs": sreport.syncs,
+         "guard_mode": "strict" if strict_guards else "count"},
     ]
 
 
@@ -1622,12 +1648,18 @@ def main() -> None:
         # written to BENCH_PIPELINE.json.  Honors JAX_PLATFORMS — on a
         # CPU-only box run `JAX_PLATFORMS=cpu python bench.py
         # --host-stream` (optionally SBG_BENCH_SMOKE=1 for the small g).
+        # Add --sync-guard to run the measured window under strict
+        # runtime guards: zero steady-state recompiles, syncs bounded by
+        # the deliberate per-chunk verdict count — violations raise
+        # instead of being tallied into the report.
         if SMOKE:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        detail = bench_host_stream_pipeline()
+        detail = bench_host_stream_pipeline(
+            strict_guards="--sync-guard" in sys.argv
+        )
         with open(os.path.join(HERE, "BENCH_PIPELINE.json"), "w") as f:
             json.dump(detail, f, indent=1)
         pipelined = detail[-1]

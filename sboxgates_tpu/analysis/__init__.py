@@ -1,0 +1,32 @@
+"""jaxlint — JAX-aware static analysis for this codebase.
+
+``python -m sboxgates_tpu.analysis [paths...]`` scans for the failure
+modes that silently erase streaming-search throughput: recompilation
+hazards (R1), host-device syncs inside hot loops (R2), tracer escapes
+(R3), lock-discipline violations in thread targets (R4), and swallowed
+exceptions (R5).  Configuration lives in ``[tool.jaxlint]`` in
+pyproject.toml; suppressions are inline
+``# jaxlint: ignore[RULE] reason`` comments (reason mandatory).
+
+The runtime complements — :func:`sboxgates_tpu.utils.guards.recompile_guard`
+and :func:`sboxgates_tpu.utils.guards.sync_guard` — catch what a static
+pass cannot see; the tier-1 gate (tests/test_jaxlint.py) holds the tree
+at zero unsuppressed findings.
+"""
+
+from .config import ALL_RULES, JaxlintConfig, load_config
+from .rules import RULE_DOCS, FileReport, Finding, lint_source
+from .cli import iter_python_files, lint_paths, main
+
+__all__ = [
+    "ALL_RULES",
+    "JaxlintConfig",
+    "load_config",
+    "RULE_DOCS",
+    "FileReport",
+    "Finding",
+    "lint_source",
+    "iter_python_files",
+    "lint_paths",
+    "main",
+]

@@ -1,0 +1,193 @@
+"""``python -m sboxgates_tpu.analysis`` — the jaxlint CLI.
+
+Scans the given paths (default: the ``paths`` from ``[tool.jaxlint]``),
+prints findings in human or JSON form, and exits non-zero when any
+unsuppressed finding remains.  ``--write-baseline``/``--baseline`` manage
+the committed zero-findings baseline the tier-1 gate compares against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterable, List, Optional
+
+from .config import ALL_RULES, JaxlintConfig, load_config
+from .rules import RULE_DOCS, FileReport, Finding, lint_source
+
+BASELINE_SCHEMA = 1
+
+
+def iter_python_files(root: str, paths: Iterable[str], config: JaxlintConfig):
+    """Yields (abspath, relpath) for every .py under the scan paths, in
+    sorted order, minus the config's ``exclude`` globs."""
+    seen = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            cands = [ap]
+        else:
+            cands = []
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames.sort()
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        cands.append(os.path.join(dirpath, fn))
+        for ap_file in cands:
+            rel = os.path.relpath(ap_file, root).replace(os.sep, "/")
+            if rel in seen or config.is_excluded(rel):
+                continue
+            seen.add(rel)
+            yield ap_file, rel
+
+
+def lint_paths(
+    paths: Optional[List[str]] = None,
+    config: Optional[JaxlintConfig] = None,
+) -> List[FileReport]:
+    """Library entry point: lint ``paths`` (default from config) and
+    return per-file reports."""
+    if config is None:
+        config = load_config(paths[0] if paths else ".")
+    scan = paths or config.paths
+    reports: List[FileReport] = []
+    for ap, rel in iter_python_files(config.root, scan, config):
+        with open(ap, "r", encoding="utf-8") as f:
+            source = f.read()
+        reports.append(lint_source(source, rel, config))
+    return reports
+
+
+def _flatten(reports: List[FileReport]):
+    findings = [f for r in reports for f in r.findings]
+    suppressed = [f for r in reports for f in r.suppressed]
+    return findings, suppressed
+
+
+def _as_payload(reports: List[FileReport]) -> dict:
+    findings, suppressed = _flatten(reports)
+    return {
+        "schema": BASELINE_SCHEMA,
+        "files_scanned": len(reports),
+        "findings": [f.as_json() for f in findings],
+        "suppressed": [f.as_json() for f in suppressed],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sboxgates_tpu.analysis",
+        description="jaxlint: JAX-aware static analysis for sboxgates_tpu "
+        "(recompile hazards, hot-loop syncs, tracer escapes, lock "
+        "discipline, swallowed errors)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: [tool.jaxlint] paths)",
+    )
+    ap.add_argument(
+        "--format",
+        "-f",
+        choices=("human", "json"),
+        default="human",
+        help="output format",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule ids to enable (default: config)",
+    )
+    ap.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="compare against a committed baseline: exit 0 iff the "
+        "unsuppressed findings exactly match the baseline's",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in (*ALL_RULES, "SUP", "ERR"):
+            print(f"{rid:4s} {RULE_DOCS[rid]}")
+        return 0
+
+    start = args.paths[0] if args.paths else "."
+    try:
+        config = load_config(start)
+    except ValueError as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        bad = [r for r in wanted if r not in ALL_RULES]
+        if bad:
+            print(f"jaxlint: unknown rule ids {bad}", file=sys.stderr)
+            return 2
+        config.rules = wanted
+
+    reports = lint_paths(args.paths or None, config)
+    findings, suppressed = _flatten(reports)
+    payload = _as_payload(reports)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(
+            f"jaxlint: wrote baseline ({len(findings)} findings) to "
+            f"{args.write_baseline}"
+        )
+        return 0
+
+    if args.format == "json":
+        json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"jaxlint: {len(findings)} finding(s), "
+            f"{len(suppressed)} suppressed, "
+            f"{len(reports)} file(s) scanned"
+        )
+
+    if args.baseline:
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as f:
+                base = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"jaxlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        base_set = {
+            (d["path"], d["line"], d["rule"]) for d in base.get("findings", ())
+        }
+        now_set = {(f.path, f.line, f.rule) for f in findings}
+        new = now_set - base_set
+        fixed = base_set - now_set
+        if new:
+            print(
+                f"jaxlint: {len(new)} finding(s) not in baseline",
+                file=sys.stderr,
+            )
+        if fixed:
+            # Exact match, both directions: a fixed-but-not-regenerated
+            # baseline entry would silently mask a later regression at the
+            # same (path, line, rule).
+            print(
+                f"jaxlint: {len(fixed)} baseline finding(s) no longer "
+                "present — regenerate with --write-baseline",
+                file=sys.stderr,
+            )
+        return 1 if (new or fixed) else 0
+
+    return 1 if findings else 0

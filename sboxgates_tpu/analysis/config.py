@@ -1,0 +1,168 @@
+"""`[tool.jaxlint]` configuration.
+
+One source of truth for the CLI and the tier-1 gate test: both load the
+``[tool.jaxlint]`` table from the project's ``pyproject.toml``.  Python
+3.10 has no ``tomllib``, so a minimal TOML-subset reader (string lists,
+strings, booleans — exactly what the table uses) backs it up; when
+``tomllib`` is importable it is preferred.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+
+#: Defaults mirror the committed pyproject table so API callers that never
+#: touch a pyproject (unit tests on fixture snippets) see the same rules.
+DEFAULT_HOT_MODULES = (
+    "sboxgates_tpu/ops/*",
+    "sboxgates_tpu/search/lut.py",
+    "sboxgates_tpu/parallel/mesh.py",
+)
+
+
+@dataclass
+class JaxlintConfig:
+    """Resolved analyzer configuration.
+
+    ``hot_modules``: glob patterns (posix, relative to the project root)
+    naming the modules where R2 (host-device sync inside a loop) applies.
+    ``rules``: enabled rule IDs.  ``exclude``: glob patterns skipped when
+    scanning directories.  ``paths``: default scan roots when the CLI is
+    invoked without positional paths.
+    """
+
+    hot_modules: List[str] = field(default_factory=lambda: list(DEFAULT_HOT_MODULES))
+    rules: List[str] = field(default_factory=lambda: list(ALL_RULES))
+    exclude: List[str] = field(default_factory=list)
+    paths: List[str] = field(default_factory=lambda: ["sboxgates_tpu"])
+    root: str = "."
+
+    def is_hot(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rp, pat) for pat in self.hot_modules)
+
+    def is_excluded(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rp, pat) for pat in self.exclude)
+
+
+_STR = r'"((?:[^"\\]|\\.)*)"'
+
+
+def _parse_value(text: str):
+    text = text.strip()
+    if text.startswith("["):
+        return re.findall(_STR, text)
+    m = re.fullmatch(_STR, text)
+    if m:
+        return m.group(1)
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def _read_table_fallback(text: str, table: str) -> Dict[str, object]:
+    """Line-oriented TOML-subset reader for one ``[table]``.
+
+    Handles ``key = "str"``, ``key = ["a", "b", ...]`` (possibly spanning
+    lines), booleans, and integers; comments outside strings are dropped.
+    """
+    out: Dict[str, object] = {}
+    in_table = False
+    pending_key: Optional[str] = None
+    pending_val = ""
+    for raw in text.splitlines():
+        line = raw
+        # strip comments (a '#' not inside a quoted string)
+        quoted = False
+        for i, ch in enumerate(line):
+            if ch == '"' and (i == 0 or line[i - 1] != "\\"):
+                quoted = not quoted
+            elif ch == "#" and not quoted:
+                line = line[:i]
+                break
+        stripped = line.strip()
+        if not stripped:
+            continue
+        if stripped.startswith("["):
+            if pending_key is None:
+                in_table = stripped == f"[{table}]"
+                continue
+            # else: '[' continues a multiline list value below
+        if not in_table:
+            continue
+        if pending_key is not None:
+            pending_val += " " + stripped
+            if pending_val.count("[") <= pending_val.count("]"):
+                out[pending_key] = _parse_value(pending_val)
+                pending_key, pending_val = None, ""
+            continue
+        if "=" in stripped:
+            key, _, val = stripped.partition("=")
+            key, val = key.strip(), val.strip()
+            if val.startswith("[") and val.count("[") > val.count("]"):
+                pending_key, pending_val = key, val
+            else:
+                out[key] = _parse_value(val)
+    return out
+
+
+def _read_table(text: str, table: str) -> Dict[str, object]:
+    try:
+        import tomllib  # Python >= 3.11
+
+        data = tomllib.loads(text)
+        for part in table.split("."):
+            data = data.get(part, {})
+        return dict(data)
+    except ImportError:
+        return _read_table_fallback(text, table)
+
+
+def find_pyproject(start: str) -> Optional[str]:
+    """Nearest pyproject.toml at or above ``start``."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def load_config(start: str = ".") -> JaxlintConfig:
+    """Config from the nearest pyproject.toml's ``[tool.jaxlint]`` table
+    (defaults when absent).  ``root`` is the directory holding the
+    pyproject, so hot-module globs resolve against the project root no
+    matter where the CLI is invoked from."""
+    cfg = JaxlintConfig()
+    pyproject = find_pyproject(start)
+    if pyproject is None:
+        cfg.root = os.path.abspath(start)
+        return cfg
+    cfg.root = os.path.dirname(pyproject)
+    with open(pyproject, "r", encoding="utf-8") as f:
+        table = _read_table(f.read(), "tool.jaxlint")
+    for key in ("hot_modules", "rules", "exclude", "paths"):
+        val = table.get(key)
+        if isinstance(val, list) and all(isinstance(x, str) for x in val):
+            setattr(cfg, key, list(val))
+    bad = [r for r in cfg.rules if r not in ALL_RULES]
+    if bad:
+        raise ValueError(
+            f"[tool.jaxlint] unknown rule ids {bad}; known: {list(ALL_RULES)}"
+        )
+    return cfg

@@ -1,0 +1,877 @@
+"""jaxlint rule implementations (stdlib ``ast`` only — no new deps).
+
+The five rules target the JAX failure modes that erase streaming-search
+throughput on real hardware:
+
+R1  recompilation hazards — ``jax.jit`` wrapping inside a loop (a fresh
+    compile cache per iteration), and call sites of jitted functions that
+    pass an unhashable literal or a per-iteration-varying expression as a
+    *static* argument (every distinct value is a full recompile).
+R2  host-device synchronization inside a loop in a *hot* module
+    (``[tool.jaxlint] hot_modules``): ``.block_until_ready()``,
+    ``jax.device_get``, ``np.asarray``/``np.array`` on a non-host
+    expression, ``.item()``, and ``int()``/``float()`` wrapped directly
+    around a ``jax.*``/``jnp.*`` call.  Each sync stalls the dispatch
+    pipeline; inside the streaming sweeps that is the whole ballgame.
+R3  tracer escape — storing to ``self``/``global`` state, or creating a
+    ``threading.Thread``, inside a jit-traced function; tracers that
+    leak out of the trace die later with opaque errors (or silently
+    capture a stale constant).
+R4  lock discipline — module-level mutable state mutated inside a
+    ``threading.Thread`` target without holding a ``Lock``/``Condition``
+    belonging to the same module.
+R5  swallowed errors — ``except Exception`` / bare ``except`` whose body
+    neither re-raises nor logs.
+
+Findings are suppressed inline with ``# jaxlint: ignore[R2] reason`` (the
+reason is mandatory; a reason-less marker suppresses nothing and is itself
+reported as SUP).  The suppression comment lives on the offending line or
+on its own line directly above.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, List, Optional, Set, Tuple
+
+from .config import JaxlintConfig
+
+#: Rule used for invalid/reason-less suppression markers; never
+#: suppressible itself.
+SUPPRESSION_RULE = "SUP"
+#: Rule used for files that fail to parse.
+PARSE_RULE = "ERR"
+
+RULE_DOCS = {
+    "R1": "recompilation hazard (jit-in-loop / unhashable or varying static arg)",
+    "R2": "host-device sync inside a loop in a hot module",
+    "R3": "tracer escape (self/global store or thread hand-off under jit trace)",
+    "R4": "module state mutated in a thread target without its module lock",
+    "R5": "except Exception/bare except that neither re-raises nor logs",
+    SUPPRESSION_RULE: "malformed jaxlint suppression (reason is mandatory)",
+    PARSE_RULE: "file failed to parse",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # project-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node that *creates* a jitted function, if ``node`` is one.
+
+    Matches ``jax.jit(...)`` / ``pjit(...)`` and
+    ``functools.partial(jax.jit, ...)`` (decorator form).
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    if name in _JIT_NAMES:
+        return node
+    if name in _PARTIAL_NAMES and node.args:
+        if dotted(node.args[0]) in _JIT_NAMES:
+            return node
+    return None
+
+
+def _is_jit_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        if dotted(dec) in _JIT_NAMES or _jit_call_of(dec) is not None:
+            return True
+    return False
+
+
+def _static_params(fn: ast.FunctionDef, jit_call: ast.Call) -> Set[str]:
+    """Parameter names marked static by a jit decorator Call."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for el in _const_strs(kw.value):
+                out.add(el)
+        elif kw.arg == "static_argnums":
+            for n in _const_ints(kw.value):
+                if 0 <= n < len(params):
+                    out.add(params[n])
+    return out
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        ]
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+    return []
+
+
+_UNHASHABLE_NODES = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Loop-target names: ``for i in ...`` / ``for a, (b, c) in ...``."""
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+# --------------------------------------------------------------------------
+# R1 — recompilation hazards
+
+
+class _R1(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+        self._loop_vars: List[Set[str]] = []  # one frame per enclosing For
+        self._in_loop = 0
+        #: name -> static parameter names, for jit-decorated module/class fns
+        self._static: Dict[str, Tuple[Set[str], List[str]]] = {}
+
+    # -- pass 1: collect jitted defs with static args (any nesting level)
+    def collect(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for dec in node.decorator_list:
+                call = _jit_call_of(dec)
+                if call is None:
+                    continue
+                statics = _static_params(node, call)
+                if statics:
+                    params = [
+                        a.arg for a in node.args.posonlyargs + node.args.args
+                    ]
+                    self._static[node.name] = (statics, params)
+
+    # -- pass 2: walk, tracking loops
+    def visit_For(self, node: ast.For) -> None:
+        self._loop_vars.append(_target_names(node.target))
+        self._in_loop += 1
+        for child in node.body + node.orelse:
+            self.visit(child)
+        self._in_loop -= 1
+        self._loop_vars.pop()
+        # iterable expression is evaluated once, outside the loop body
+        self.visit(node.iter)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop_vars.append(set())
+        self._in_loop += 1
+        self.generic_visit(node)
+        self._in_loop -= 1
+        self._loop_vars.pop()
+
+    def _all_loop_vars(self) -> Set[str]:
+        out: Set[str] = set()
+        for frame in self._loop_vars:
+            out |= frame
+        return out
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_loop and _jit_call_of(node) is not None:
+            self.findings.append(
+                (
+                    node.lineno,
+                    node.col_offset,
+                    "jit wrapper created inside a loop: each iteration gets a "
+                    "fresh callable with an empty compile cache — hoist the "
+                    "jax.jit(...) out of the loop (memoize by config key)",
+                )
+            )
+        name = dotted(node.func)
+        if name in self._static:
+            statics, params = self._static[name]
+            self._check_static_args(node, statics, params)
+        self.generic_visit(node)
+
+    def _check_static_args(
+        self, call: ast.Call, statics: Set[str], params: List[str]
+    ) -> None:
+        bound: List[Tuple[str, ast.AST]] = []
+        for i, arg in enumerate(call.args):
+            if i < len(params):
+                bound.append((params[i], arg))
+        for kw in call.keywords:
+            if kw.arg is not None:
+                bound.append((kw.arg, kw.value))
+        loop_vars = self._all_loop_vars()
+        for pname, expr in bound:
+            if pname not in statics:
+                continue
+            if isinstance(expr, _UNHASHABLE_NODES):
+                self.findings.append(
+                    (
+                        expr.lineno,
+                        expr.col_offset,
+                        f"unhashable literal passed as static argument "
+                        f"'{pname}': jit static args must be hashable "
+                        "(use a tuple), and every new value recompiles",
+                    )
+                )
+            elif loop_vars and (_names_in(expr) & loop_vars):
+                self.findings.append(
+                    (
+                        expr.lineno,
+                        expr.col_offset,
+                        f"static argument '{pname}' varies with the "
+                        "enclosing loop variable: every iteration triggers "
+                        "a recompile — pass it as a traced arg or hoist it",
+                    )
+                )
+
+
+# --------------------------------------------------------------------------
+# R2 — host-device sync inside loops (hot modules only)
+
+_SYNC_FUNCS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+_ASARRAY_FUNCS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_HOSTY_CALLS = {"list", "tuple", "sorted", "range", "len", "dict", "zip"}
+
+
+def _hosty_arg(node: ast.AST) -> bool:
+    """True when the expression is clearly host data already (a display,
+    a comprehension, or a list()/range()-style builtin call) — converting
+    it cannot trigger a device sync."""
+    if isinstance(
+        node,
+        (
+            ast.List,
+            ast.Tuple,
+            ast.Dict,
+            ast.Set,
+            ast.ListComp,
+            ast.SetComp,
+            ast.GeneratorExp,
+            ast.Constant,
+        ),
+    ):
+        return True
+    if isinstance(node, ast.Call) and dotted(node.func) in _HOSTY_CALLS:
+        return True
+    return False
+
+
+def _contains_jax_call(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = dotted(n.func)
+            if name and (name.startswith("jnp.") or name.startswith("jax.")):
+                return True
+    return False
+
+
+class _R2(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+        self._in_loop = 0
+
+    def visit_For(self, node: ast.For) -> None:
+        self._in_loop += 1
+        for child in node.body + node.orelse:
+            self.visit(child)
+        self._in_loop -= 1
+        self.visit(node.iter)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._in_loop += 1
+        self.generic_visit(node)
+        self._in_loop -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_loop:
+            self._check(node)
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append((node.lineno, node.col_offset, msg))
+
+    def _check(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name in _SYNC_FUNCS:
+            self._flag(
+                node,
+                f"{name}() inside a loop in a hot module blocks on the "
+                "device every iteration — batch the transfer or move the "
+                "sync out of the loop",
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready":
+                self._flag(
+                    node,
+                    ".block_until_ready() inside a loop in a hot module "
+                    "serializes host and device — sync once after the loop",
+                )
+                return
+            if node.func.attr == "item" and not node.args:
+                self._flag(
+                    node,
+                    ".item() inside a loop in a hot module is a scalar "
+                    "device->host transfer per iteration",
+                )
+                return
+        if name in _ASARRAY_FUNCS and node.args:
+            if not _hosty_arg(node.args[0]):
+                self._flag(
+                    node,
+                    f"{name}() on a possibly-device value inside a loop in "
+                    "a hot module forces a blocking device->host copy each "
+                    "iteration",
+                )
+            return
+        if name in ("int", "float") and len(node.args) == 1:
+            if _contains_jax_call(node.args[0]):
+                self._flag(
+                    node,
+                    f"{name}() wrapped around a jax/jnp call inside a loop "
+                    "is a per-iteration device sync — keep the reduction on "
+                    "device and convert once after the loop",
+                )
+
+
+# --------------------------------------------------------------------------
+# R3 — tracer escape
+
+
+class _R3(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+
+    def run(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and _is_jit_decorated(node):
+                self._scan_jitted(node)
+
+    def _scan_jitted(self, fn: ast.FunctionDef) -> None:
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.findings.append(
+                            (
+                                t.lineno,
+                                t.col_offset,
+                                f"store to self.{t.attr} inside jit-traced "
+                                f"'{fn.name}': the tracer outlives the trace "
+                                "and poisons later calls — return the value "
+                                "instead",
+                            )
+                        )
+                    elif isinstance(t, ast.Name) and t.id in globals_declared:
+                        self.findings.append(
+                            (
+                                t.lineno,
+                                t.col_offset,
+                                f"store to global '{t.id}' inside jit-traced "
+                                f"'{fn.name}': tracers must not escape the "
+                                "trace",
+                            )
+                        )
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in ("threading.Thread", "Thread"):
+                    self.findings.append(
+                        (
+                            node.lineno,
+                            node.col_offset,
+                            f"threading.Thread created inside jit-traced "
+                            f"'{fn.name}': traced values crossing a thread "
+                            "boundary are undefined — spawn threads outside "
+                            "the traced function",
+                        )
+                    )
+
+
+# --------------------------------------------------------------------------
+# R4 — lock discipline in thread targets
+
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+_MUTABLE_CTORS = {
+    "list",
+    "dict",
+    "set",
+    "collections.defaultdict",
+    "defaultdict",
+    "collections.deque",
+    "deque",
+    "collections.Counter",
+    "Counter",
+}
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "remove",
+    "discard",
+    "pop",
+    "popitem",
+    "popleft",
+    "appendleft",
+    "clear",
+    "setdefault",
+}
+
+
+class _R4:
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+
+    def run(self, tree: ast.Module) -> None:
+        module_locks: Set[str] = set()
+        lock_attrs: Set[str] = set()
+        module_mutables: Set[str] = set()
+        module_names: Set[str] = set()
+        funcs: Dict[str, ast.FunctionDef] = {}
+
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    module_names.add(t.id)
+                    val = node.value
+                    vname = dotted(val.func) if isinstance(val, ast.Call) else None
+                    if vname in _LOCK_CTORS:
+                        module_locks.add(t.id)
+                    elif isinstance(val, (ast.List, ast.Dict, ast.Set)) or (
+                        vname in _MUTABLE_CTORS
+                    ):
+                        module_mutables.add(t.id)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                funcs[node.name] = node
+            elif isinstance(node, ast.Assign):
+                # self._lock = threading.Lock() anywhere in the module
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and isinstance(node.value, ast.Call)
+                        and dotted(node.value.func) in _LOCK_CTORS
+                    ):
+                        lock_attrs.add(t.attr)
+            elif isinstance(node, ast.Global):
+                # a module-level name rebound via `global` is mutable state
+                # even when it's a plain scalar counter
+                for name in node.names:
+                    if name in module_names:
+                        module_mutables.add(name)
+
+        targets = self._thread_targets(tree)
+        for tname in targets:
+            fn = funcs.get(tname)
+            if fn is not None:
+                self._scan_target(
+                    fn, module_mutables, module_locks, lock_attrs
+                )
+
+    def _thread_targets(self, tree: ast.Module) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func) not in ("threading.Thread", "Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                if isinstance(kw.value, ast.Name):
+                    out.add(kw.value.id)
+                elif isinstance(kw.value, ast.Attribute):
+                    out.add(kw.value.attr)  # self._work -> method name
+        return out
+
+    def _scan_target(
+        self,
+        fn: ast.FunctionDef,
+        mutables: Set[str],
+        locks: Set[str],
+        lock_attrs: Set[str],
+    ) -> None:
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+
+        def held(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name) and expr.id in locks:
+                return True
+            if isinstance(expr, ast.Attribute) and expr.attr in lock_attrs:
+                return True
+            return False
+
+        findings = self.findings
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(
+                (
+                    node.lineno,
+                    node.col_offset,
+                    f"thread target '{fn.name}' mutates module state "
+                    f"{what} without holding a module Lock/Condition — "
+                    "wrap the mutation in `with <lock>:`",
+                )
+            )
+
+        def walk(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, ast.With):
+                now_locked = locked or any(
+                    held(item.context_expr) for item in node.items
+                )
+                for child in node.body:
+                    walk(child, now_locked)
+                return
+            if not locked:
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id in mutables
+                            and t.id in globals_declared
+                        ):
+                            flag(t, f"'{t.id}'")
+                        elif isinstance(t, ast.Subscript):
+                            root = t.value
+                            if (
+                                isinstance(root, ast.Name)
+                                and root.id in mutables
+                            ):
+                                flag(t, f"'{root.id}[...]'")
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATORS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in mutables
+                    ):
+                        flag(node, f"'{f.value.id}.{f.attr}()'")
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked)
+
+        for stmt in fn.body:
+            walk(stmt, False)
+
+
+# --------------------------------------------------------------------------
+# R5 — swallowed exceptions
+
+_LOGGY_PREFIXES = ("logging.", "logger.", "log.", "self.logger.", "self.log.")
+_LOGGY_EXACT = {
+    "warnings.warn",
+    "traceback.print_exc",
+    "traceback.print_exception",
+    "print",
+}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except
+        return True
+    if isinstance(t, ast.Name) and t.id == "Exception":
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(el, ast.Name) and el.id == "Exception" for el in t.elts
+        )
+    return False
+
+
+def _body_handles(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if name in _LOGGY_EXACT or name.startswith(_LOGGY_PREFIXES):
+                return True
+            # logging.getLogger(...).warning(...) style chains
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "exception",
+                "warning",
+                "error",
+                "critical",
+            ):
+                return True
+    return False
+
+
+class _R5(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _handler_is_broad(node) and not _body_handles(node):
+            what = "bare except" if node.type is None else "except Exception"
+            self.findings.append(
+                (
+                    node.lineno,
+                    node.col_offset,
+                    f"{what} swallows errors silently — catch the specific "
+                    "exception types, and log or re-raise",
+                )
+            )
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*ignore\[([A-Za-z0-9_,\s]*)\]\s*(.*)$"
+)
+
+
+@dataclass
+class _Suppression:
+    line: int
+    rules: Set[str]
+    reason: str
+    standalone: bool  # comment-only line: applies to the next line too
+
+
+def scan_suppressions(
+    source: str,
+) -> Tuple[List[_Suppression], List[Tuple[int, int, str]]]:
+    """All jaxlint suppression comments plus SUP findings for malformed
+    ones (empty rule list or missing reason)."""
+    sups: List[_Suppression] = []
+    bad: List[Tuple[int, int, str]] = []
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                # only comments that *open with* an attempted directive are
+                # malformed; prose mentioning the directive syntax is fine
+                if re.match(r"#+\s*jaxlint\s*:", tok.string):
+                    bad.append(
+                        (
+                            tok.start[0],
+                            tok.start[1],
+                            "unrecognized jaxlint marker; expected "
+                            "'# jaxlint: ignore[RULE] reason'",
+                        )
+                    )
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            line_text = tok.line.strip()
+            standalone = line_text.startswith("#")
+            if not rules:
+                bad.append(
+                    (
+                        tok.start[0],
+                        tok.start[1],
+                        "suppression names no rule: use "
+                        "'# jaxlint: ignore[R2] reason'",
+                    )
+                )
+                continue
+            unknown = rules - set(RULE_DOCS)
+            if unknown:
+                bad.append(
+                    (
+                        tok.start[0],
+                        tok.start[1],
+                        f"suppression names unknown rule(s) "
+                        f"{sorted(unknown)}",
+                    )
+                )
+                continue
+            if not reason:
+                bad.append(
+                    (
+                        tok.start[0],
+                        tok.start[1],
+                        f"suppression of {sorted(rules)} lacks the "
+                        "mandatory reason — say why the finding is safe",
+                    )
+                )
+                continue
+            sups.append(_Suppression(tok.start[0], rules, reason, standalone))
+    except tokenize.TokenError:
+        pass  # the ast parse will report the syntax problem
+    return sups, bad
+
+
+# --------------------------------------------------------------------------
+# per-file driver
+
+
+@dataclass
+class FileReport:
+    path: str
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+
+
+def lint_source(
+    source: str,
+    relpath: str,
+    config: JaxlintConfig,
+    hot: Optional[bool] = None,
+) -> FileReport:
+    """Lints one file's source.  ``hot`` overrides the config's hot-module
+    glob match (fixture tests exercise R2 on paths outside the configured
+    globs)."""
+    report = FileReport(path=relpath)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        report.findings.append(
+            Finding(relpath, e.lineno or 1, 0, PARSE_RULE, f"syntax error: {e.msg}")
+        )
+        return report
+
+    raw: List[Tuple[str, int, int, str]] = []
+
+    if "R1" in config.rules:
+        r1 = _R1()
+        r1.collect(tree)
+        r1.visit(tree)
+        raw += [("R1", *f) for f in r1.findings]
+    is_hot = config.is_hot(relpath) if hot is None else hot
+    if "R2" in config.rules and is_hot:
+        r2 = _R2()
+        r2.visit(tree)
+        raw += [("R2", *f) for f in r2.findings]
+    if "R3" in config.rules:
+        r3 = _R3()
+        r3.run(tree)
+        raw += [("R3", *f) for f in r3.findings]
+    if "R4" in config.rules:
+        r4 = _R4()
+        r4.run(tree)
+        raw += [("R4", *f) for f in r4.findings]
+    if "R5" in config.rules:
+        r5 = _R5()
+        r5.visit(tree)
+        raw += [("R5", *f) for f in r5.findings]
+
+    sups, bad_sups = scan_suppressions(source)
+    by_line: Dict[int, List[_Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+        if s.standalone:
+            by_line.setdefault(s.line + 1, []).append(s)
+
+    for rule, line, col, msg in sorted(raw, key=lambda f: (f[1], f[2], f[0])):
+        finding = Finding(relpath, line, col, rule, msg)
+        if any(rule in s.rules for s in by_line.get(line, ())):
+            report.suppressed.append(finding)
+        else:
+            report.findings.append(finding)
+
+    for line, col, msg in bad_sups:
+        report.findings.append(Finding(relpath, line, col, SUPPRESSION_RULE, msg))
+    report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return report

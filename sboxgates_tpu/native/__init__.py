@@ -22,12 +22,48 @@ Exposed surface (mirrors the C ABI):
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
+import sys
 import threading
 from typing import Optional, Tuple
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+# Process-wide tally of device-work-service failures surfaced by the
+# engine bail path.  Mirrors parallel.mesh._note_pallas_fallback:
+# warnings.warn is deduplicated to one line per process by the default
+# filter, which hides *repeated* silent degradations to the Python
+# engine, so the visibility line is a rate-limited stderr print keyed
+# off a locked counter instead.
+_SERVICE_FAILURES = 0
+_SERVICE_FAIL_LOCK = threading.Lock()
+_SERVICE_FAIL_PRINT_FIRST = 5
+_SERVICE_FAIL_PRINT_EVERY = 100
+
+
+def service_failure_count() -> int:
+    """How many native-engine calls bailed because the attached
+    device-work service raised, in this process."""
+    return _SERVICE_FAILURES
+
+
+def _note_service_failure(exc: BaseException) -> None:
+    global _SERVICE_FAILURES
+    with _SERVICE_FAIL_LOCK:
+        _SERVICE_FAILURES += 1
+        n = _SERVICE_FAILURES
+    if n <= _SERVICE_FAIL_PRINT_FIRST or n % _SERVICE_FAIL_PRINT_EVERY == 0:
+        print(
+            f"sboxgates_tpu: device-work service failed inside the native "
+            f"engine ({exc!r}); the search fell back to the Python engine "
+            f"[failure #{n} this process]",
+            file=sys.stderr,
+            flush=True,
+        )
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "libsboxg_runtime.so")
@@ -100,7 +136,7 @@ def make_eng_devcb(service):
     engine, so a broken service degrades to round-3 behavior instead of
     crashing.  Interrupts also make the engine bail (the fastest unwind)
     but are re-raised by the caller, so Ctrl-C still stops the run."""
-    pending = {"exc": None}
+    pending = {"exc": None, "service_exc": None}
 
     def cb(
         handle, kind, tables_p, g, target_p, mask_p, inbits_p, n_inbits,
@@ -126,10 +162,16 @@ def make_eng_devcb(service):
                 resp[0] = 1
                 resp[1 : 1 + len(out)] = np.asarray(out, dtype=np.int64)
             return 0
-        except Exception:
-            import traceback
-
-            traceback.print_exc()
+        except Exception as e:
+            # An exception must not unwind across the C frame; the specific
+            # type is unknowable (the service is user code), so: record it
+            # for the caller to surface once the ctypes call returns, log
+            # the traceback, and report failure — the engine then bails to
+            # the Python engine (degrades instead of crashing).
+            pending["service_exc"] = e
+            _logger.exception(
+                "device-work service failed inside the native LUT engine"
+            )
             return 1
         except BaseException as e:  # KeyboardInterrupt / SystemExit
             pending["exc"] = e
@@ -826,6 +868,12 @@ class LutEngineCaller:
         if pending is not None and pending["exc"] is not None:
             exc, pending["exc"] = pending["exc"], None
             raise exc
+        if pending is not None and pending.get("service_exc") is not None:
+            # The engine already bailed to the Python fallback (round-3
+            # behavior); make the degradation and its cause visible at the
+            # call site instead of only in the callback's log record.
+            sexc, pending["service_exc"] = pending["service_exc"], None
+            _note_service_failure(sexc)
         if n == -2:
             return self.BAILED, None, stats
         if n < 0:

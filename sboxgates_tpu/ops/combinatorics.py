@@ -11,6 +11,7 @@ ranges because chunks themselves are split across devices.
 from __future__ import annotations
 
 import itertools
+import os
 import queue as _queue
 import threading
 import time
@@ -18,6 +19,11 @@ from math import comb
 from typing import Callable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
+
+# Debug-mode enforcement of the ChunkPrefetcher thread-safety contract
+# (see its docstring).  Asserts compile away under -O; the env lever
+# drops them in debug runs that intentionally share a prefetcher.
+_THREAD_CHECKS = __debug__ and os.environ.get("SBG_THREAD_CHECKS", "1") != "0"
 
 
 def n_choose_k(n: int, k: int) -> int:
@@ -39,7 +45,16 @@ def _native_stream_available() -> bool:
             from .. import native
 
             _native_ok = native.available()
-        except Exception:
+        except (ImportError, OSError, AttributeError) as e:
+            # Import failure, ctypes load failure, or a stale .so missing a
+            # symbol: the pure-Python stream is a correct (slower) fallback,
+            # but the degradation must be visible in debug logs.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native combination stream unavailable (%r); "
+                "falling back to the pure-Python iterator", e
+            )
             _native_ok = False
     return _native_ok
 
@@ -187,6 +202,28 @@ class ChunkPrefetcher:
     the pair to measure how much production time stayed off the
     consumer's critical path: serial production is all stall (produce ==
     stall), a fully warmed pipeline stalls ~0.
+
+    Thread-safety contract
+    ----------------------
+    Exactly two threads touch an instance:
+
+    * **producer** (the internal ``sbg-chunk-prefetch`` worker): sole
+      caller of ``_work``/``_put``, and — in threaded mode — sole caller
+      of ``_produce_one``, hence the only reader of ``stream`` and the
+      only writer of ``_exc``.
+    * **consumer** (whichever single thread drives the sweep): ``get``,
+      ``close``/``__exit__``, and ``closed``.  ``get`` is single-consumer
+      by design — the (padded, valid) ordering guarantee that keeps
+      first-hit verdicts deterministic dies with a second reader.
+      ``close`` is idempotent and, exceptionally, may also be called
+      from a third supervising thread *after* the consumer has stopped
+      reading (the mux drivers' unwind path).
+
+    In inline mode (``depth <= 1``) the consumer plays both roles and no
+    worker exists.  Debug builds (``__debug__``, i.e. no ``-O``) enforce
+    the contract: ``get`` asserts it is always called from one thread,
+    and the producer internals assert they run on the worker.  Set
+    ``SBG_THREAD_CHECKS=0`` to drop the checks in debug runs.
     """
 
     def __init__(
@@ -206,6 +243,7 @@ class ChunkPrefetcher:
         self.on_stall = on_stall
         self._done = False
         self._inline = self.depth <= 1
+        self._consumer_ident: Optional[int] = None
         if not self._inline:
             self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
             self._stop = threading.Event()
@@ -215,7 +253,30 @@ class ChunkPrefetcher:
             )
             self._thread.start()
 
+    def _assert_producer(self) -> None:
+        # Contract check (debug only): in threaded mode the production
+        # internals — and through them the stream — belong to the worker.
+        assert (
+            not _THREAD_CHECKS
+            or self._inline
+            or threading.get_ident() == self._thread.ident
+        ), "ChunkPrefetcher: producer-only method called off the worker thread"
+
+    def _assert_consumer(self) -> None:
+        # Contract check (debug only): one consumer thread for the
+        # instance's lifetime — a second reader breaks chunk ordering.
+        if not _THREAD_CHECKS:
+            return
+        ident = threading.get_ident()
+        if self._consumer_ident is None:
+            self._consumer_ident = ident
+        assert self._consumer_ident == ident, (
+            "ChunkPrefetcher.get() called from a second thread; the chunk "
+            "stream is single-consumer"
+        )
+
     def _produce_one(self) -> Optional[Tuple[np.ndarray, int]]:
+        self._assert_producer()
         t0 = time.perf_counter()
         chunk = self.stream.next_chunk(self.chunk_size)
         if chunk is None:
@@ -250,6 +311,7 @@ class ChunkPrefetcher:
 
     def get(self) -> Optional[Tuple[np.ndarray, int]]:
         """Next (padded, valid_count) in stream order; None at the end."""
+        self._assert_consumer()
         if self._done:
             return None
         t0 = time.perf_counter()

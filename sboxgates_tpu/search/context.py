@@ -666,7 +666,10 @@ class SearchContext:
                 self._native_probe = native.available()
                 if not self._native_probe:
                     why = str(native.build_error())
-            except Exception as e:  # import/ABI failure — still warn
+            except (ImportError, OSError, AttributeError) as e:
+                # import failure, ctypes load failure, or stale-.so ABI
+                # mismatch — still warn; anything else is a real bug and
+                # should propagate
                 self._native_probe = False
                 why = repr(e)
             if why is not None and self.opt.host_small_steps:

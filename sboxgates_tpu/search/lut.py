@@ -183,6 +183,7 @@ def _solve_lut5_rows(
         p1, _ = comb.pad_rows(req1[lo:hi], scs, fill=0xFFFFFFFF)
         p0, _ = comb.pad_rows(req0[lo:hi], scs, fill=0xFFFFFFFF)
         ctx.stats["lut5_solved"] += hi - lo
+        # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
         v = np.asarray(
             sweeps.lut5_solve(
                 ctx.place_chunk(p1, fill=0xFFFFFFFF),
@@ -426,6 +427,7 @@ def _lut5_search_pivot(
             # SPMD lockstep rounds of one tile per device; per-device
             # verdicts resolved in tile order, so the chosen circuit matches
             # the single-device stream's when not randomizing.
+            # jaxlint: ignore[R2] deliberate sync: per-round sharded verdict gather is the stream's only sync point
             verdicts = np.asarray(
                 sharded_pivot_stream(
                     ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
@@ -451,6 +453,7 @@ def _lut5_search_pivot(
             continue
 
         backend = pivot_backend()
+        # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
         v = np.asarray(
             sweeps.lut5_pivot_stream(
                 tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
@@ -563,6 +566,7 @@ def _lut5_stream_loop(
     g = st.num_gates
     args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
     while start < total:
+        # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
         v = np.asarray(
             sweeps.lut5_stream(
                 *args, start, total, jw, jm, ctx.next_seed(), chunk=chunk
@@ -710,6 +714,7 @@ def _host_feasible_chunks(
             ctx.stats[stat_key] += nvalid
             if not bool(ctx.sync_verdict(phase, hit)):
                 continue
+            # jaxlint: ignore[R2] deliberate sync: feasibility bitmap resolved only after the pipelined verdict said hit
             yield padded, np.asarray(feas)[:csize], req1p, req0p
 
 
@@ -733,6 +738,7 @@ def _lut5_search_host(
             fidx = np.nonzero(feas)[0]
             res = _solve_lut5_rows(
                 ctx, st, target, mask, padded[fidx],
+                # jaxlint: ignore[R2] deliberate sync: hit-row gather happens at most once per feasible chunk
                 np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
                 jw, jm, splits, w_tab, m_tab,
             )
@@ -814,6 +820,7 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
                 and nhits + 2 * max_rows < LUT7_CAP
             )
             resolve = dispatch(cstart + chunk) if speculate else None
+            # jaxlint: ignore[R2] deliberate sync: window resolve point of the double-buffered lut7 stream
             feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
             rows = np.nonzero(feas)[0]
             hit_combos.append(
@@ -840,7 +847,9 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
             for padded, feas, req1p, req0p in chunks:
                 fidx = np.nonzero(feas)[0]
                 hit_combos.append(padded[fidx])
+                # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
                 hit_req1.append(np.asarray(req1p)[fidx])
+                # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
                 hit_req0.append(np.asarray(req0p)[fidx])
                 nhits += len(fidx)
                 if nhits >= LUT7_CAP:
@@ -893,6 +902,7 @@ def _lut7_solve_hits(
         r1, _ = comb.pad_rows(req1[lo:hi], size, fill=0xFFFFFFFF)
         r0, _ = comb.pad_rows(req0[lo:hi], size, fill=0xFFFFFFFF)
         ctx.stats["lut7_solved"] += hi - lo
+        # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
         v = np.asarray(
             sweeps.lut7_solve(
                 ctx.place_chunk(r1, fill=0xFFFFFFFF),

@@ -1,0 +1,10 @@
+"""Shared utilities: sbox loading, phase profiling, and the runtime
+jaxlint complements (:mod:`~sboxgates_tpu.utils.guards`)."""
+
+from .guards import (  # noqa: F401
+    GuardReport,
+    RecompileError,
+    SyncError,
+    recompile_guard,
+    sync_guard,
+)

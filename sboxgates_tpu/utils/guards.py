@@ -1,0 +1,223 @@
+"""Runtime guards for the two failure modes jaxlint can only partially
+prove statically: silent recompiles (R1) and hidden host-device syncs
+(R2).
+
+:func:`recompile_guard` watches ``jax.jit`` compilation activity inside a
+``with`` block — either per-function cache growth (``fns=...``, via the
+jitted callable's ``_cache_size()``) or process-wide compile events (via
+the ``jax_log_compiles`` logging channel) — and raises
+:class:`RecompileError` when the count exceeds ``allowed``.
+
+:func:`sync_guard` counts blocking device->host transfers inside a
+``with`` block by wrapping ``jax.device_get``, ``jax.block_until_ready``
+and ``np.asarray``/``np.array`` on ``jax.Array`` values, raising
+:class:`SyncError` (``action="raise"``) or just tallying
+(``action="count"``) for benchmark reporting.  It cannot see syncs that
+bypass those entry points (``.item()``, ``float()`` on a device scalar
+via ``__float__``, direct buffer protocol) — the static R2 pass covers
+those shapes; together the two nets overlap.
+
+Both guards are re-entrant-safe for nested use but not thread-safe:
+install them from the consumer thread that owns the region under test
+(bench.py's timing loops, the streaming tests).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Sequence
+
+
+class RecompileError(RuntimeError):
+    """An unexpected jax.jit compilation happened inside a recompile_guard."""
+
+
+class SyncError(RuntimeError):
+    """An unexpected host-device sync happened inside a sync_guard."""
+
+
+@dataclass
+class GuardReport:
+    """Mutable tally yielded by both guards."""
+
+    compiles: int = 0
+    syncs: int = 0
+    events: List[str] = field(default_factory=list)
+
+    def note(self, kind: str, detail: str) -> None:
+        if kind == "compile":
+            self.compiles += 1
+        else:
+            self.syncs += 1
+        if len(self.events) < 200:  # bounded: long bench runs
+            self.events.append(f"{kind}: {detail}")
+
+
+class _CompileLogCounter(logging.Handler):
+    """Counts 'Compiling <name> ...' records on the jax logger tree."""
+
+    def __init__(self, report: GuardReport) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.report = report
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            self.report.note("compile", msg.split(" in ")[0][:160])
+
+
+@contextlib.contextmanager
+def recompile_guard(
+    fns: Sequence[Callable] = (),
+    allowed: int = 0,
+    label: str = "",
+) -> Iterator[GuardReport]:
+    """Raises :class:`RecompileError` when more than ``allowed`` new
+    compilations happen inside the block.
+
+    With ``fns`` (jitted callables), growth is measured per function via
+    ``_cache_size()`` — precise, zero overhead, immune to other threads'
+    compiles.  Without ``fns``, every compile in the process is counted
+    through the ``jax_log_compiles`` logging channel (which this guard
+    enables for the duration of the block).
+
+    The canonical bug this catches: a per-call-varying Python scalar
+    passed as a static arg, which grows the jit cache by one entry per
+    call — invisible in tests with one call, catastrophic in a streaming
+    loop on real hardware.
+    """
+    import jax
+
+    report = GuardReport()
+    tracked = [f for f in fns if hasattr(f, "_cache_size")]
+    if fns and not tracked:
+        raise TypeError(
+            "recompile_guard(fns=...) requires jax.jit-wrapped callables "
+            "(objects with _cache_size)"
+        )
+    before = [f._cache_size() for f in tracked]
+    handler: Optional[_CompileLogCounter] = None
+    prev_log = None
+    jax_logger = logging.getLogger("jax")
+    prev_handlers: List[logging.Handler] = []
+    if not tracked:
+        handler = _CompileLogCounter(report)
+        # The compile records are emitted at WARNING only when
+        # jax_log_compiles is on; flip it for the duration, and swap out
+        # jax's own stderr handler so the guard doesn't spray one WARNING
+        # line per compile while counting them.
+        prev_handlers, jax_logger.handlers = jax_logger.handlers, [handler]
+        prev_log = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+    try:
+        yield report
+    finally:
+        if handler is not None:
+            jax.config.update("jax_log_compiles", prev_log)
+            jax_logger.handlers = prev_handlers
+    if tracked:
+        for f, b in zip(tracked, before):
+            grew = f._cache_size() - b
+            if grew > 0:
+                report.note(
+                    "compile",
+                    f"{getattr(f, '__name__', repr(f))}: cache "
+                    f"{b} -> {b + grew}",
+                )
+    if report.compiles > allowed:
+        where = f" in {label}" if label else ""
+        raise RecompileError(
+            f"{report.compiles} jit compilation(s){where} (allowed "
+            f"{allowed}) — a static arg is probably varying per call; "
+            f"events: {report.events[:8]}"
+        )
+
+
+class _SyncPatches:
+    """Wraps the module-level sync entry points, counting (and optionally
+    rejecting) calls whose operand is a device array."""
+
+    def __init__(self, report: GuardReport, action: str, allowed: int):
+        self.report = report
+        self.action = action
+        self.allowed = allowed
+        self._saved: List = []
+
+    def _hit(self, what: str) -> None:
+        self.report.note("sync", what)
+        if self.action == "raise" and self.report.syncs > self.allowed:
+            raise SyncError(
+                f"host-device sync #{self.report.syncs} (allowed "
+                f"{self.allowed}): {what} — batch the transfer or move it "
+                "out of the guarded region"
+            )
+
+    def install(self) -> None:
+        import jax
+        import numpy as np
+
+        def wrap(mod, name, is_device_value):
+            orig = getattr(mod, name)
+
+            def wrapper(x, *a, **k):
+                if is_device_value(x):
+                    self._hit(f"{mod.__name__}.{name}")
+                return orig(x, *a, **k)
+
+            wrapper.__wrapped__ = orig
+            self._saved.append((mod, name, orig))
+            setattr(mod, name, wrapper)
+
+        def is_jax_array(x) -> bool:
+            return isinstance(x, jax.Array)
+
+        def contains_jax_array(x) -> bool:
+            if isinstance(x, jax.Array):
+                return True
+            if isinstance(x, (list, tuple)):
+                return any(contains_jax_array(e) for e in x)
+            return False
+
+        wrap(jax, "device_get", contains_jax_array)
+        wrap(jax, "block_until_ready", contains_jax_array)
+        wrap(np, "asarray", is_jax_array)
+        wrap(np, "array", is_jax_array)
+
+    def uninstall(self) -> None:
+        for mod, name, orig in reversed(self._saved):
+            setattr(mod, name, orig)
+        self._saved.clear()
+
+
+@contextlib.contextmanager
+def sync_guard(
+    allowed: int = 0,
+    action: str = "raise",
+    label: str = "",
+) -> Iterator[GuardReport]:
+    """Counts blocking device->host transfers inside the block.
+
+    ``action="raise"`` raises :class:`SyncError` on the first transfer
+    past ``allowed`` (streaming tests: prove a region never syncs);
+    ``action="count"`` only tallies into the yielded
+    :class:`GuardReport` (bench.py: report sync pressure alongside
+    throughput).  The patches are process-global while installed —
+    guard one region at a time, from the thread that owns it.
+    """
+    if action not in ("raise", "count"):
+        raise ValueError(f"sync_guard action must be raise|count, got {action!r}")
+    report = GuardReport()
+    patches = _SyncPatches(report, action, allowed)
+    patches.install()
+    try:
+        yield report
+    finally:
+        patches.uninstall()
+    if action == "raise" and report.syncs > allowed:
+        where = f" in {label}" if label else ""
+        raise SyncError(
+            f"{report.syncs} host-device sync(s){where} (allowed {allowed}); "
+            f"events: {report.events[:8]}"
+        )

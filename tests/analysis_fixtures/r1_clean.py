@@ -1,0 +1,35 @@
+# jaxlint R1 clean twin: same shapes, no recompilation hazard.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def sweep(x, chunk):
+    return x[:chunk].sum()
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, factor):
+    return x * factor
+
+
+def fixed_static_in_loop(x, chunk=64):
+    total = 0.0
+    for _ in range(100):
+        total += sweep(x, chunk)  # static arg constant across iterations
+    return total
+
+
+def hashable_static(x):
+    return scaled(x, (2, 3))  # tuple is hashable: one compile
+
+
+def jit_hoisted(fns, x):
+    jitted = [jax.jit(f) for f in fns]
+
+    def run_all():
+        return [jf(x) for jf in jitted]
+
+    return run_all()

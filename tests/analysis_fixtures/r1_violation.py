@@ -1,0 +1,35 @@
+# jaxlint R1 fixture: recompilation hazards.  Read by tests as text —
+# never imported or executed.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def sweep(x, chunk):
+    return x[:chunk].sum()
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, factor):
+    return x * factor
+
+
+def varying_static_in_loop(x):
+    total = 0.0
+    for step in range(100):
+        total += sweep(x, step)  # line 22: static 'chunk' varies per iteration
+    return total
+
+
+def unhashable_static(x):
+    return scaled(x, [2, 3])  # line 27: list literal as static arg
+
+
+def jit_in_loop(fns, x):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)  # line 33: fresh jit wrapper per iteration
+        outs.append(jf(x))
+    return outs

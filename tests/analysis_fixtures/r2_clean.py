@@ -1,0 +1,31 @@
+# jaxlint R2 clean twin: same work, syncs hoisted out of the loops.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def stream(chunks, kernel):
+    outs = [kernel(c) for c in chunks]
+    resolved = np.asarray(jnp.stack(outs))  # one sync after the loop
+    return [v for v in resolved if v[0]]
+
+
+def batched_verdict(kernel, xs):
+    out = kernel(jnp.stack(xs))
+    out.block_until_ready()  # outside any loop: a deliberate barrier
+    return out
+
+
+def host_side_loop(rows):
+    total = 0
+    for r in rows:
+        total += int(r[0])  # host numpy scalar: no device involved
+        arr = np.asarray([1, 2, 3])  # list literal: host data
+    return total, arr
+
+
+def device_reduction(xs):
+    total = jnp.zeros(())
+    for x in xs:
+        total = total + jnp.sum(x)  # stays on device
+    return float(total)  # single sync at the end

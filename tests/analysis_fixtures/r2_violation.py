@@ -1,0 +1,36 @@
+# jaxlint R2 fixture: host-device syncs inside loops (linted as a hot
+# module by the tests).  Read as text — never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def stream(chunks, kernel):
+    hits = []
+    for c in chunks:
+        v = np.asarray(kernel(c))  # line 11: blocking copy per chunk
+        if v[0]:
+            hits.append(v)
+    return hits
+
+
+def polling_loop(kernel, x):
+    while True:
+        out = kernel(x)
+        out.block_until_ready()  # line 20: serializes every dispatch
+        if jax.device_get(out)[0]:  # line 21: second sync per iteration
+            return out
+
+
+def scalar_coercion(xs):
+    total = 0.0
+    for x in xs:
+        total += float(jnp.sum(x))  # line 28: device reduction synced per item
+    return total
+
+
+def item_per_iter(kernel, xs):
+    flags = []
+    for x in xs:
+        flags.append(kernel(x).item())  # line 35: scalar transfer per item
+    return flags

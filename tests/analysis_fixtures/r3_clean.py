@@ -1,0 +1,31 @@
+# jaxlint R3 clean twin: state updates happen outside the trace.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_LAST = None
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        h = x * 2
+        return h.sum(), h  # caller stores concrete outputs
+
+    def run(self, x):
+        out, h = self.forward(x)
+        self.cache = h  # concrete jax.Array, outside the trace: fine
+        return out
+
+
+def remember(x):
+    global _LAST
+    _LAST = x  # not a traced function: fine
+    return x
+
+
+def spawn_worker(payload):
+    t = threading.Thread(target=print, args=(payload,))  # not traced: fine
+    t.start()
+    return t

@@ -1,0 +1,31 @@
+# jaxlint R3 fixture: tracer escape from jit-traced functions.  Read as
+# text — never imported.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_LAST = None
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        h = x * 2
+        self.cache = h  # line 15: tracer stored on self
+        return h.sum()
+
+
+@jax.jit
+def leak_global(x):
+    global _LAST
+    y = x + 1
+    _LAST = y  # line 23: tracer stored in a global
+    return y
+
+
+@jax.jit
+def thread_handoff(x):
+    t = threading.Thread(target=print, args=(x,))  # line 29: tracer to thread
+    t.start()
+    return x

@@ -1,0 +1,35 @@
+# jaxlint R4 clean twin: every module-state mutation holds the module lock.
+import threading
+
+RESULTS = []
+COUNTS = {}
+_TOTAL = 0
+_lock = threading.Lock()
+
+
+def worker(job):
+    out = job()
+    with _lock:
+        RESULTS.append(out)
+        COUNTS[job.__name__] = out
+
+
+def tally(n):
+    global _TOTAL
+    with _lock:
+        _TOTAL += n
+
+
+def collect(job):
+    local = [job()]  # closure-local list: no lock needed
+    return local
+
+
+def launch(jobs):
+    threads = [threading.Thread(target=worker, args=(j,)) for j in jobs]
+    threads.append(threading.Thread(target=tally, args=(1,)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return RESULTS

@@ -1,0 +1,29 @@
+# jaxlint R4 fixture: thread targets mutating module state lockless.
+# Read as text — never imported.
+import threading
+
+RESULTS = []
+COUNTS = {}
+_TOTAL = 0
+_lock = threading.Lock()
+
+
+def worker(job):
+    out = job()
+    RESULTS.append(out)  # line 13: no lock held
+    COUNTS[job.__name__] = out  # line 14: no lock held
+
+
+def tally(n):
+    global _TOTAL
+    _TOTAL += n  # line 19: lost-update race on the module counter
+
+
+def launch(jobs):
+    threads = [threading.Thread(target=worker, args=(j,)) for j in jobs]
+    threads.append(threading.Thread(target=tally, args=(1,)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return RESULTS

@@ -1,0 +1,31 @@
+# jaxlint R5 clean twin: narrow catches, logged or re-raised.
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def probe_backend():
+    try:
+        import does_not_exist  # noqa: F401
+
+        return True
+    except ImportError as e:
+        logger.warning("backend probe failed: %r", e)
+        return False
+
+
+def best_effort_cleanup(path):
+    import os
+
+    try:
+        os.unlink(path)
+    except OSError:
+        pass  # narrow type: fine
+
+
+def wrapped(fn):
+    try:
+        return fn()
+    except Exception:
+        logger.exception("fn failed")  # broad but logged: fine
+        raise

@@ -1,0 +1,19 @@
+# jaxlint R5 fixture: swallowed exceptions.  Read as text — never imported.
+
+
+def probe_backend():
+    try:
+        import does_not_exist  # noqa: F401
+
+        return True
+    except Exception:  # line 9: swallows everything silently
+        return False
+
+
+def best_effort_cleanup(path):
+    import os
+
+    try:
+        os.unlink(path)
+    except:  # line 18: bare except, nothing logged
+        pass

@@ -1,0 +1,30 @@
+# jaxlint suppression-syntax fixture.  Read as text — never imported.
+
+
+def probe_a():
+    try:
+        import maybe_missing  # noqa: F401
+    except Exception:  # jaxlint: ignore[R5] optional dep probe; absence is the common case
+        return False
+
+
+def probe_b():
+    try:
+        import maybe_missing  # noqa: F401
+    # jaxlint: ignore[R5] standalone-comment form, applies to the next line
+    except Exception:
+        return False
+
+
+def probe_c():
+    try:
+        import maybe_missing  # noqa: F401
+    except Exception:  # jaxlint: ignore[R5]
+        return False  # missing reason above: NOT suppressed, plus SUP
+
+
+def probe_d():
+    try:
+        import maybe_missing  # noqa: F401
+    except Exception:  # jaxlint: ignore[R9] no such rule
+        return False  # unknown rule: NOT suppressed, plus SUP

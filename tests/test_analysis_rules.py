@@ -1,0 +1,110 @@
+"""Per-rule jaxlint coverage over the fixture snippets.
+
+Every rule is demonstrated by a violation fixture (exact rule IDs and
+line numbers asserted) with a clean twin that must scan empty; the
+suppression fixture locks in the inline-ignore syntax and the
+mandatory-reason enforcement.  Fixtures are read as text, never
+imported.
+"""
+
+import os
+
+import pytest
+
+from sboxgates_tpu.analysis import JaxlintConfig, lint_source
+from sboxgates_tpu.analysis.rules import SUPPRESSION_RULE
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def lint_fixture(name, **kwargs):
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    # hot=True so R2 applies to fixture paths outside the configured
+    # hot-module globs
+    return lint_source(source, name, JaxlintConfig(), hot=True, **kwargs)
+
+
+def found(report):
+    return [(f.rule, f.line) for f in report.findings]
+
+
+VIOLATIONS = {
+    "r1_violation.py": [("R1", 22), ("R1", 27), ("R1", 33)],
+    "r2_violation.py": [
+        ("R2", 11),
+        ("R2", 20),
+        ("R2", 21),
+        ("R2", 28),
+        ("R2", 35),
+    ],
+    "r3_violation.py": [("R3", 15), ("R3", 23), ("R3", 29)],
+    "r4_violation.py": [("R4", 13), ("R4", 14), ("R4", 19)],
+    "r5_violation.py": [("R5", 9), ("R5", 18)],
+}
+
+
+@pytest.mark.parametrize("name,expected", sorted(VIOLATIONS.items()))
+def test_violation_fixture_exact_findings(name, expected):
+    assert found(lint_fixture(name)) == expected
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py", "r5_clean.py"],
+)
+def test_clean_twin_scans_empty(name):
+    report = lint_fixture(name)
+    assert found(report) == []
+    assert report.suppressed == []
+
+
+def test_rule_messages_name_the_hazard():
+    messages = {f.rule: f.message for f in lint_fixture("r1_violation.py").findings}
+    assert "recompile" in messages["R1"] or "compile" in messages["R1"]
+    r2 = lint_fixture("r2_violation.py").findings[0]
+    assert "loop" in r2.message and "hot" in r2.message
+
+
+def test_suppression_with_reason_suppresses():
+    report = lint_fixture("suppressions.py")
+    # probe_a (same-line form) and probe_b (standalone-comment form) are
+    # suppressed; both retain the finding in the suppressed list
+    assert [(f.rule, f.line) for f in report.suppressed] == [
+        ("R5", 7),
+        ("R5", 15),
+    ]
+
+
+def test_reasonless_and_unknown_rule_suppressions_do_not_suppress():
+    report = lint_fixture("suppressions.py")
+    got = found(report)
+    # probe_c: reason missing -> R5 stays, plus a SUP finding
+    assert ("R5", 22) in got and (SUPPRESSION_RULE, 22) in got
+    # probe_d: unknown rule id -> R5 stays, plus a SUP finding
+    assert ("R5", 29) in got and (SUPPRESSION_RULE, 29) in got
+    # and nothing else leaks through
+    assert len(got) == 4
+
+
+def test_rule_subset_config():
+    report = lint_source(
+        open(os.path.join(FIXTURES, "r5_violation.py")).read(),
+        "r5_violation.py",
+        JaxlintConfig(rules=["R1"]),
+    )
+    assert found(report) == []
+
+
+def test_r2_requires_hot_module():
+    source = open(os.path.join(FIXTURES, "r2_violation.py")).read()
+    cfg = JaxlintConfig(hot_modules=["somewhere_else/*"])
+    assert found(lint_source(source, "r2_violation.py", cfg)) == []
+    cfg_hot = JaxlintConfig(hot_modules=["r2_*.py"])
+    assert len(found(lint_source(source, "r2_violation.py", cfg_hot))) == 5
+
+
+def test_syntax_error_reported_not_raised():
+    report = lint_source("def broken(:\n", "bad.py", JaxlintConfig())
+    assert [f.rule for f in report.findings] == ["ERR"]

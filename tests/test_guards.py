@@ -1,0 +1,105 @@
+"""Runtime complements of the static pass: recompile_guard / sync_guard.
+
+The acceptance case: a deliberately-injected per-call static-arg
+recompile — the exact bug R1 exists for — is caught at runtime by
+recompile_guard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sboxgates_tpu.utils import (
+    RecompileError,
+    SyncError,
+    recompile_guard,
+    sync_guard,
+)
+
+
+def test_recompile_guard_catches_static_arg_churn():
+    @jax.jit
+    def warm(x):
+        return x + 1
+
+    churn = jax.jit(lambda x, n: x * n, static_argnums=1)
+    churn(jnp.ones(2), 0)  # first compile is expected, outside the guard
+    with pytest.raises(RecompileError, match="static arg"):
+        with recompile_guard(fns=[churn], allowed=0):
+            for n in range(1, 4):  # every n is a fresh static value
+                churn(jnp.ones(2), n)
+
+
+def test_recompile_guard_clean_steady_state():
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones(3))
+    with recompile_guard(fns=[f], allowed=0) as report:
+        for _ in range(10):
+            f(jnp.ones(3))
+    assert report.compiles == 0
+
+
+def test_recompile_guard_allows_budget():
+    g = jax.jit(lambda x, n: x + n, static_argnums=1)
+    with recompile_guard(fns=[g], allowed=2):
+        g(jnp.ones(2), 100)
+        g(jnp.ones(2), 101)
+
+
+def test_recompile_guard_global_mode_counts_process_compiles():
+    with pytest.raises(RecompileError):
+        with recompile_guard(allowed=0):
+            fresh = jax.jit(lambda x: x - 3.5)
+            fresh(jnp.ones(4))
+
+
+def test_recompile_guard_rejects_plain_callables():
+    with pytest.raises(TypeError):
+        with recompile_guard(fns=[lambda x: x]):
+            pass
+
+
+def test_sync_guard_raises_on_device_asarray():
+    a = jnp.arange(8)
+    with pytest.raises(SyncError, match="sync"):
+        with sync_guard(allowed=0):
+            np.asarray(a)
+
+
+def test_sync_guard_counts_all_entry_points():
+    a = jnp.arange(4)
+    with sync_guard(action="count") as report:
+        np.asarray(a)
+        jax.device_get(a)
+        jax.block_until_ready(a)
+        np.array(a)
+    assert report.syncs == 4
+    assert any("device_get" in e for e in report.events)
+
+
+def test_sync_guard_ignores_host_data():
+    with sync_guard(allowed=0) as report:
+        np.asarray([1, 2, 3])
+        np.array((4, 5))
+        jax.block_until_ready(np.ones(3))  # numpy in, no device sync
+    assert report.syncs == 0
+
+
+def test_sync_guard_restores_patches():
+    before = (np.asarray, jax.device_get)
+    with sync_guard(action="count"):
+        assert np.asarray is not before[0]
+    assert np.asarray is before[0]
+    assert jax.device_get is before[1]
+
+
+def test_sync_guard_allowed_budget():
+    a = jnp.arange(3)
+    with sync_guard(allowed=1) as report:
+        np.asarray(a)  # the one budgeted sync
+    assert report.syncs == 1
+    with pytest.raises(SyncError):
+        with sync_guard(allowed=1):
+            np.asarray(a)
+            np.asarray(a)
