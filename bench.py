@@ -684,6 +684,12 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
          # is churning; syncs are the deliberate per-chunk verdicts.
          "steady_state_compiles": creport.compiles,
          "steady_state_syncs": sreport.syncs,
+         # Hung-dispatch deadline activity (resilience.deadline) over the
+         # same window: nonzero here on real hardware means the tunnel or
+         # device stalled mid-bench and the guard retried/degraded —
+         # throughput numbers from such a window are suspect.
+         "dispatch_retries": c2.stats.get("dispatch_retries", 0),
+         "deadline_breaches": c2.stats.get("deadline_breaches", 0),
          "guard_mode": "strict" if strict_guards else "count"},
     ]
 
@@ -1668,6 +1674,8 @@ def main() -> None:
             "value": pipelined.get("speedup_vs_serial"),
             "unit": "x (pipelined vs serial cand/s)",
             "overlap": pipelined.get("overlap"),
+            "dispatch_retries": pipelined.get("dispatch_retries"),
+            "deadline_breaches": pipelined.get("deadline_breaches"),
         }))
         return
 

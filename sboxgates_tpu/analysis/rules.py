@@ -52,7 +52,10 @@ RULE_DOCS = {
     "R3": "tracer escape (self/global store or thread hand-off under jit trace)",
     "R4": "module state mutated in a thread target without its module lock",
     "R5": "except Exception/bare except that neither re-raises nor logs",
-    SUPPRESSION_RULE: "malformed jaxlint suppression (reason is mandatory)",
+    SUPPRESSION_RULE: (
+        "malformed or unused jaxlint suppression (reason is mandatory; a "
+        "marker whose finding no longer fires is itself a finding)"
+    ),
     PARSE_RULE: "file failed to parse",
 }
 
@@ -864,12 +867,42 @@ def lint_source(
         if s.standalone:
             by_line.setdefault(s.line + 1, []).append(s)
 
+    used: Set[Tuple[int, str]] = set()  # (id(suppression), rule) pairs
     for rule, line, col, msg in sorted(raw, key=lambda f: (f[1], f[2], f[0])):
         finding = Finding(relpath, line, col, rule, msg)
-        if any(rule in s.rules for s in by_line.get(line, ())):
+        matching = [s for s in by_line.get(line, ()) if rule in s.rules]
+        if matching:
+            for s in matching:
+                used.add((id(s), rule))
             report.suppressed.append(finding)
         else:
             report.findings.append(finding)
+
+    # Unused-suppression detection: a well-formed marker naming a rule
+    # that produced NO finding on its line(s) is stale — the hazard it
+    # justified is gone (or moved), and a stale marker left behind would
+    # silently swallow the next, different finding at that line.  Only
+    # rules this scan actually executed count (R2 is skipped entirely in
+    # non-hot files, so its markers can't be judged there).
+    checked = {r for r in config.rules if r in ("R1", "R3", "R4", "R5")}
+    if "R2" in config.rules and is_hot:
+        checked.add("R2")
+    for s in sups:
+        stale = sorted(
+            r for r in s.rules if r in checked and (id(s), r) not in used
+        )
+        if stale:
+            report.findings.append(
+                Finding(
+                    relpath,
+                    s.line,
+                    0,
+                    SUPPRESSION_RULE,
+                    f"unused suppression: no {', '.join(stale)} finding on "
+                    "this line — the justified hazard is gone; remove the "
+                    "stale '# jaxlint: ignore' marker",
+                )
+            )
 
     for line, col, msg in bad_sups:
         report.findings.append(Finding(relpath, line, col, SUPPRESSION_RULE, msg))

@@ -87,7 +87,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable concurrent exploration of mux select bits "
                         "(single in-flight device sweep at a time)")
     p.add_argument("--output-dir", default=".", metavar="DIR",
-                   help="directory for saved XML states (default: cwd)")
+                   help="directory for saved XML states (default: cwd); "
+                        "searches also keep a crash-safe journal there so "
+                        "a killed run can continue with --resume-run")
+    p.add_argument("--resume-run", metavar="DIR", default=None,
+                   help="resume a killed search from DIR's journal "
+                        "(written by a prior run with --output-dir DIR); "
+                        "the original search configuration is restored "
+                        "from the journal and the final circuits are "
+                        "bit-identical to an uninterrupted run")
+    p.add_argument("--dispatch-timeout", type=float, default=None,
+                   metavar="S",
+                   help="hung-dispatch deadline for device sweeps in "
+                        "seconds (default: SBG_DISPATCH_TIMEOUT_S or off); "
+                        "on breach the dispatch is retried with backoff, "
+                        "then the driver degrades to its host-fallback "
+                        "path")
     p.add_argument("--coordinator", metavar="HOST:PORT", default=None,
                    help="multi-host: coordinator address for "
                         "jax.distributed.initialize (or set "
@@ -104,8 +119,86 @@ def _err(msg: str) -> int:
     return 1
 
 
+#: Journal-recorded configuration: ONE key list drives both the record
+#: (SearchJournal.start) and the restore (--resume-run), so an option
+#: can never be recorded without being restored or vice versa.  Includes
+#: every flag that shapes the deterministic draw stream — execution-mode
+#: flags too (mesh / serial_jobs / serial_mux / batch_iterations pick
+#: drivers with different PRNG consumption orders), not just the search
+#: parameters.  ``input``/``graph`` are handled separately (abspath'd).
+#: Multi-host infra flags (--coordinator/--num-processes/--process-id)
+#: are per-launch and stay on the command line.
+JOURNAL_CONFIG_KEYS = (
+    "permute",
+    "iterations",
+    "single_output",
+    "available_gates",
+    "seed",
+    "sat_metric",
+    "lut",
+    "append_not",
+    "batch_iterations",
+    "permute_sweep",
+    "serial_jobs",
+    "serial_mux",
+    "mesh",
+    "pipeline_depth",
+)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+
+    # Resume: restore the original run configuration from the journal
+    # BEFORE validation — `--resume-run DIR` alone must suffice.
+    journal = None
+    resume = args.resume_run is not None
+    if resume:
+        from .resilience.journal import (
+            JOURNAL_VERSION,
+            JournalError,
+            SearchJournal,
+        )
+
+        if args.shard_sweep:
+            # Job-sharded sweeps are journal-free (every process owns its
+            # own slice's side effects); silently restarting would look
+            # like a resume while discarding the journal's progress claim.
+            return _err(
+                "--resume-run cannot be combined with --shard-sweep: "
+                "job-sharded sweeps restart instead of resuming (ROADMAP "
+                "open item)."
+            )
+        try:
+            journal = SearchJournal.resume(args.resume_run)
+        except JournalError as e:
+            return _err(f"Error: {e}")
+        ver = journal.records[0].get("version")
+        if ver != JOURNAL_VERSION:
+            return _err(
+                f"Error: journal in {args.resume_run} has version {ver}, "
+                f"this build reads version {JOURNAL_VERSION}; re-run the "
+                "search instead of resuming."
+            )
+        cfg = journal.config
+        args.output_dir = args.resume_run
+        try:
+            args.input = list(cfg["input"])
+            args.graph = cfg["graph"]
+            for key in JOURNAL_CONFIG_KEYS:
+                setattr(args, key, cfg[key])
+        except KeyError as e:
+            return _err(
+                f"Error: journal in {args.resume_run} lacks the recorded "
+                f"setting {e}; it was written by an incompatible build — "
+                "re-run the search instead of resuming."
+            )
+        if journal.complete:
+            print(
+                f"Run in {args.resume_run} is already complete; "
+                "nothing to resume."
+            )
+            return 0
 
     # Validation mirroring parse_opt (sboxgates.c:895-986).
     if args.available_gates is not None and not (
@@ -148,7 +241,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             st = load_state(args.input[0])
         except (OSError, StateLoadError) as e:
-            return _err(f"Error when reading state file. ({e})")
+            return _err(
+                f"Error when reading state file {args.input[0]}: {e}"
+            )
         if args.convert_c:
             try:
                 sys.stdout.write(c_function_text(st))
@@ -211,6 +306,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"Target S-box only has {n_out} outputs."
         )
 
+    # Crash-safe journaling: on for every primary-process search with an
+    # output directory, except job-sharded sweeps (every process would
+    # contend for one journal) and the multibox one-output driver.
+    multibox_sweep = multibox or args.permute_sweep
+    journaling = (
+        args.output_dir is not None
+        and not args.shard_sweep
+        and not (multibox_sweep and args.single_output != -1)
+    )
+    if journaling and args.seed is None:
+        # Materialize the seed so the journal can reproduce the run: a
+        # resumed search must draw the exact same PRNG stream.
+        args.seed = int.from_bytes(os.urandom(4), "little")
+
     opt = Options(
         iterations=args.iterations,
         permute=args.permute,
@@ -227,7 +336,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         batch_restarts=args.batch_iterations,
         parallel_mux=False if args.serial_mux else None,
         pipeline_depth=args.pipeline_depth,
+        dispatch_timeout_s=args.dispatch_timeout,
     )
+
+    if journaling and not resume:
+        from .resilience.journal import SearchJournal
+
+        config = {key: getattr(args, key) for key in JOURNAL_CONFIG_KEYS}
+        config["input"] = [os.path.abspath(p) for p in args.input]
+        config["graph"] = (
+            os.path.abspath(args.graph) if args.graph is not None else None
+        )
+        journal = SearchJournal.start(args.output_dir, config=config)
+    elif journal is not None and not journaling:
+        # Resuming on a process whose side effects are disabled (the
+        # non-primary ranks of a multi-host run: output_dir was nulled
+        # above): the journal stays READABLE so this process restores
+        # the same beam + PRNG position as the primary — without it the
+        # peers would restart at round 0 and desync the collectives —
+        # but all writes remain the primary's.
+        journal.readonly = True
+    elif not journaling:
+        journal = None
     mesh_plan = None
     if args.shard_sweep or args.mesh:
         import jax
@@ -280,6 +410,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         batched = False if (args.serial_jobs or args.mesh) else None
         try:
             if args.single_output != -1:
+                # The one-output multibox driver is journal-free (see
+                # `journaling` above): a kill there restarts the sweep.
                 search_boxes_one_output(
                     ctx, boxes, args.single_output,
                     save_dir=args.output_dir, log=log, batched=batched,
@@ -287,7 +419,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             else:
                 search_boxes_all_outputs(
                     ctx, boxes, save_dir=args.output_dir, log=log,
-                    batched=batched,
+                    batched=batched, journal=journal,
                 )
         except ValueError as e:
             return _err(f"Error: {e}")
@@ -302,16 +434,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             st = load_state(args.graph)
         except (OSError, StateLoadError) as e:
-            return _err(f"Error when reading state file. ({e})")
+            return _err(f"Error when reading state file {args.graph}: {e}")
         log(f"Loaded {args.graph}.")
 
     if args.single_output != -1:
         generate_graph_one_output(
             ctx, st, targets, args.single_output, save_dir=args.output_dir,
-            log=log,
+            log=log, journal=journal,
         )
     else:
-        generate_graph(ctx, st, targets, save_dir=args.output_dir, log=log)
+        generate_graph(
+            ctx, st, targets, save_dir=args.output_dir, log=log,
+            journal=journal,
+        )
 
     if args.verbose >= 2:
         # Per-phase wall-clock + candidate-throughput summary (a TPU-build

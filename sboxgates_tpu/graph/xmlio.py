@@ -132,12 +132,22 @@ def state_to_xml(st: State) -> str:
 
 
 def save_state(st: State, directory: str = ".") -> str:
-    """Writes the state; returns the path (reference: save_state)."""
+    """Durably writes the state; returns the path (reference: save_state,
+    state.c:107-125 — which truncates in place; here the write is
+    crash-safe: temp file + fsync + atomic ``os.replace``, with an
+    integrity digest recorded in the file as a trailing XML comment the
+    reference parser ignores).  At every instant the path holds either
+    the complete old bytes or the complete new bytes."""
     import os
 
+    from ..resilience.checkpoint import durable_write_text, with_digest
+
     path = os.path.join(directory, state_filename(st))
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(state_to_xml(st))
+    durable_write_text(
+        path,
+        with_digest(state_to_xml(st)),
+        fault_sites=("ckpt.write", "ckpt.replace"),
+    )
     return path
 
 
@@ -260,8 +270,19 @@ def state_from_xml(text: str) -> State:
 
 
 def load_state(path: str) -> State:
+    """Loads and validates a checkpoint: integrity digest first (when the
+    file records one — reference-written files don't and are validated
+    structurally), then the full structural parse.  Torn or corrupted
+    files raise :class:`StateLoadError`."""
+    from ..resilience.checkpoint import IntegrityError, verify_digest
+
     with open(path, "r", encoding="utf-8") as f:
-        return state_from_xml(f.read())
+        raw = f.read()
+    try:
+        body = verify_digest(raw)
+    except IntegrityError as e:
+        raise StateLoadError(str(e)) from e
+    return state_from_xml(body)
 
 
 # -- schema validation ----------------------------------------------------

@@ -277,6 +277,11 @@ class ChunkPrefetcher:
 
     def _produce_one(self) -> Optional[Tuple[np.ndarray, int]]:
         self._assert_producer()
+        # Fault site: one hit per produced chunk (crash = die mid-stream;
+        # raise = a producer-side failure the consumer's get() surfaces).
+        from ..resilience.faults import fault_point
+
+        fault_point("prefetch.produce")
         t0 = time.perf_counter()
         chunk = self.stream.next_chunk(self.chunk_size)
         if chunk is None:

@@ -26,6 +26,19 @@ Everything is shaped [chunk, ...] with static sizes; invalid rows are
 masked.  Randomized tie-breaking among matches uses a hashed priority seeded
 per call, replacing the reference's Fisher-Yates shuffles of the scan order
 (sboxgates.c:285-299, lut.c:126-135) with equivalent search diversification.
+
+**Dispatch-resolution contract.**  The streaming kernels here
+(``feasible_stream``, ``lut5_stream``, ``lut5_pivot_stream``, ...) are
+issued asynchronously and their compact verdicts resolved by the drivers
+in :mod:`sboxgates_tpu.search.lut` / :mod:`sboxgates_tpu.search.context`
+under the hung-dispatch deadline guard
+(:func:`sboxgates_tpu.resilience.deadline.dispatch_with_retry`, also the
+``dispatch.sweep`` fault-injection site): device RPCs are not
+interruptible, so on a budget breach the *resolve* is abandoned to a
+parked daemon thread and the whole dispatch is re-issued — every kernel
+in this module must therefore stay side-effect-free and idempotent for
+identical operands (a given (args, seed) pair always returns the same
+verdict), which the pure-functional jit formulation guarantees.
 """
 
 from __future__ import annotations

@@ -67,6 +67,37 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def journal_seq_check(round_idx: int, seq: Optional[int] = None) -> None:
+    """Validates multi-host resume lockstep at a round boundary.
+
+    Only the primary journals (checkpoint writes are rank-0-keyed, like
+    the reference's ``save_state``); the peers have no local journal to
+    compare, so the primary broadcasts its (round, journal sequence
+    number) and every process asserts the round matches its own progress
+    counter.  A desync — e.g. one process resumed from a stale directory
+    — fails loudly HERE, at a host-side barrier, instead of deadlocking
+    the next device collective with misaligned seed streams.  No-op with
+    one process.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(
+        [round_idx, -1 if seq is None else seq], dtype=np.int64
+    )
+    got = np.asarray(multihost_utils.broadcast_one_to_all(local))
+    if int(got[0]) != round_idx:
+        raise RuntimeError(
+            f"multi-host journal desync: the primary is at round "
+            f"{int(got[0])} (journal seq {int(got[1])}) but this process "
+            f"is at round {round_idx}; resume every process against the "
+            "same run directory state"
+        )
+
+
 def shared_seed(seed: Optional[int]) -> Optional[int]:
     """A seed every process agrees on.
 

@@ -1,0 +1,51 @@
+"""Resilience subsystem: crash-safe checkpoints, exact search resume,
+deterministic fault injection, and hung-dispatch deadlines.
+
+Real S-box searches run for hours-to-days; at production scale preemption,
+hung device dispatches, and partial writes are routine events, not edge
+cases.  This package makes every one of them survivable:
+
+- :mod:`checkpoint` — durable XML state writes (write-to-temp + fsync +
+  ``os.replace`` with an integrity digest) and :func:`latest_valid_state`
+  recovery of the newest intact checkpoint in a directory.
+- :mod:`journal` — :class:`SearchJournal`, an append-only fsync'd JSONL
+  (plus an atomically-replaced snapshot) recording round/iteration
+  progress, beam membership, budget ratchets, and the host PRNG position,
+  so ``--resume-run DIR`` continues a killed search with bit-identical
+  final circuits.
+- :mod:`faults` — named deterministic fault sites armed via
+  ``SBG_FAULTS=site:action@when`` (actions: raise / crash / hang), used
+  by the kill→resume tests to die at arbitrary points and prove recovery.
+- :mod:`deadline` — :func:`dispatch_with_retry`, the reusable
+  hung-dispatch guard (generalized from bench.py's ad-hoc tunnel-death
+  watchdog): a blocked device sweep raises :class:`DispatchTimeout`
+  within the configured budget, retries with exponential backoff, and the
+  search drivers then degrade to the host-fallback path.
+"""
+
+from .checkpoint import (
+    IntegrityError,
+    durable_write_text,
+    latest_valid_state,
+    verify_digest,
+    with_digest,
+)
+from .deadline import DeadlineConfig, DispatchTimeout, dispatch_with_retry
+from .faults import InjectedFault, arm, disarm, fault_point
+from .journal import SearchJournal
+
+__all__ = [
+    "IntegrityError",
+    "durable_write_text",
+    "latest_valid_state",
+    "verify_digest",
+    "with_digest",
+    "DeadlineConfig",
+    "DispatchTimeout",
+    "dispatch_with_retry",
+    "InjectedFault",
+    "arm",
+    "disarm",
+    "fault_point",
+    "SearchJournal",
+]

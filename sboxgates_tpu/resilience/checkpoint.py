@@ -1,0 +1,181 @@
+"""Durable checkpoint writes and torn-file recovery.
+
+The reference's ``save_state`` (state.c:107-125) — and our port until this
+module — truncated the target file in place, so a crash mid-write corrupts
+the only copy.  Here every checkpoint write is write-to-temp + ``fsync`` +
+``os.replace`` (atomic on POSIX within one filesystem) + best-effort
+directory fsync, so at every instant the path holds either the complete
+old bytes or the complete new bytes — never a torn file.
+
+Integrity is verified on load through a digest recorded *inside* the file
+as a trailing XML comment (``<!-- sbg:sha256=... -->``), which the
+reference binary's parser ignores — interop with the reference format is
+unchanged in both directions (its files simply carry no digest and are
+validated structurally by the loader).
+
+:func:`latest_valid_state` is the recovery entry point: the newest
+checkpoint in a directory that passes digest + structural validation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import tempfile
+from typing import Optional, Tuple
+
+from .faults import fault_point
+
+#: Temp-file prefix for in-flight writes; a crash can strand these, and
+#: :func:`clean_stale_tmp` (called on resume) removes them.
+TMP_PREFIX = ".sbg-tmp-"
+
+# Process umask, sampled once at import (the get-is-a-set dance is not
+# thread-safe, so it must not run per write): mkstemp creates 0600 temp
+# files, and os.replace would carry that onto the published checkpoint —
+# unreadable to the peers / reference tooling that could read the
+# umask-governed files open(path, "w") used to produce.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+_DIGEST_RE = re.compile(r"<!-- sbg:sha256=([0-9a-f]{64}) -->\s*\Z")
+
+
+class IntegrityError(Exception):
+    """A checkpoint's recorded digest does not match its contents."""
+
+
+def digest_text(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def with_digest(text: str) -> str:
+    """Appends the integrity digest as a trailing XML comment."""
+    return f"{text}<!-- sbg:sha256={digest_text(text)} -->\n"
+
+
+def split_digest(raw: str) -> Tuple[str, Optional[str]]:
+    """(body, digest-or-None) — body is the text the digest covers."""
+    m = _DIGEST_RE.search(raw)
+    if m is None:
+        return raw, None
+    return raw[: m.start()], m.group(1)
+
+
+def verify_digest(raw: str) -> str:
+    """Returns the digest-covered body; raises :class:`IntegrityError` on
+    mismatch.  Files without a recorded digest (e.g. written by the
+    reference binary) pass through unchanged — the structural loader
+    still validates them."""
+    body, digest = split_digest(raw)
+    if digest is not None and digest_text(body) != digest:
+        raise IntegrityError(
+            f"checkpoint digest mismatch (recorded {digest[:12]}..., "
+            f"computed {digest_text(body)[:12]}...): torn or corrupted file"
+        )
+    return body
+
+
+def _fsync_dir(directory: str) -> None:
+    """Best-effort directory fsync so the rename itself is durable."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # some filesystems refuse O_RDONLY on directories
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # fsync-on-dir unsupported: the rename is still atomic
+    finally:
+        os.close(fd)
+
+
+def durable_write_text(
+    path: str, text: str, fault_sites: Tuple[Optional[str], Optional[str]] = (None, None)
+) -> None:
+    """Atomically replaces ``path`` with ``text``.
+
+    Write order: temp file in the same directory (same filesystem, so the
+    final rename is atomic), content, ``fsync``, ``os.replace``, directory
+    fsync.  ``fault_sites`` names the (mid-content, pre-replace) fault
+    sites — checkpoint writes pass ``("ckpt.write", "ckpt.replace")``: a
+    crash at the first leaves a torn *temp* file and the old checkpoint
+    untouched; at the second, the complete old file.
+    """
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(prefix=TMP_PREFIX, suffix=".tmp", dir=directory)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            half = len(text) // 2
+            f.write(text[:half])
+            f.flush()
+            if fault_sites[0]:
+                fault_point(fault_sites[0])
+            f.write(text[half:])
+            f.flush()
+            os.fchmod(f.fileno(), 0o666 & ~_UMASK)
+            os.fsync(f.fileno())
+        if fault_sites[1]:
+            fault_point(fault_sites[1])
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(directory)
+
+
+def clean_stale_tmp(directory: str) -> int:
+    """Removes in-flight temp files stranded by a crash; returns the
+    count.  Safe at resume time: no writer is live."""
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith(TMP_PREFIX):
+            try:
+                os.unlink(os.path.join(directory, name))
+                removed += 1
+            except OSError:
+                pass  # already gone or unremovable; not ours to fail on
+    return removed
+
+
+def latest_valid_state(directory: str):
+    """(path, State) of the newest intact checkpoint in ``directory``, or
+    None when no XML file there passes validation.
+
+    "Intact" = digest verified (when recorded) and structurally loadable
+    (:func:`sboxgates_tpu.graph.xmlio.load_state`); torn, truncated, or
+    corrupted files are skipped, so recovery falls back file by file to
+    the newest checkpoint that survived the crash.
+    """
+    from ..graph.xmlio import StateLoadError, load_state
+
+    try:
+        names = [
+            n for n in os.listdir(directory)
+            if n.endswith(".xml") and not n.startswith(TMP_PREFIX)
+        ]
+    except OSError:
+        return None
+
+    def mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    paths = [os.path.join(directory, n) for n in names]
+    paths.sort(key=lambda p: (mtime(p), p), reverse=True)
+    for path in paths:
+        try:
+            return path, load_state(path)
+        except (OSError, StateLoadError):
+            continue
+    return None

@@ -1,0 +1,157 @@
+"""Hung-dispatch deadlines with retry/backoff.
+
+Generalized from bench.py's ad-hoc tunnel-death watchdog (observed live:
+a dropped tunnel leaves an XLA device RPC blocked FOREVER — device calls
+are not interruptible, so without a deadline the whole search hangs past
+any external timeout).  :func:`dispatch_with_retry` runs one blocking
+device-sweep resolve in an abandonable worker thread: on budget breach it
+raises :class:`DispatchTimeout`, re-issues the dispatch with exponential
+backoff, and after the retry budget re-raises so the calling driver can
+degrade to its host-fallback path (see ``search.lut.lut5_search``).
+
+Multi-host note: a process-spanning mesh runs its sweeps as pod-wide
+collectives, so abort/retry decisions MUST be replicated — a process that
+locally times out and re-issues while its peers keep waiting deadlocks
+the collective.  The guard is therefore disabled on process-spanning
+meshes unless explicitly forced (``SBG_DISPATCH_TIMEOUT_MULTIHOST=1``,
+for deployments whose budgets and clocks are tight enough that every
+process breaches together); the retry *schedule* itself is deterministic
+(fixed budget, fixed backoff), never derived from locally divergent
+state, so forced mode keeps processes aligned when their breaches do
+coincide.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .faults import fault_point
+
+logger = logging.getLogger(__name__)
+
+
+class DispatchTimeout(RuntimeError):
+    """A device dispatch exceeded its deadline budget (retries included)."""
+
+
+@dataclass
+class DeadlineConfig:
+    """Deadline policy for blocking device-sweep resolves.
+
+    ``budget_s <= 0`` disables the guard entirely (the default: deadlines
+    are an operational opt-in — SBG_DISPATCH_TIMEOUT_S or
+    ``Options.dispatch_timeout_s`` / ``--dispatch-timeout``)."""
+
+    budget_s: float = 0.0
+    retries: int = 2
+    backoff_s: float = 0.25
+    multihost: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_s > 0
+
+
+def config_from_env() -> DeadlineConfig:
+    """SBG_DISPATCH_TIMEOUT_S / SBG_DISPATCH_RETRIES /
+    SBG_DISPATCH_BACKOFF_S / SBG_DISPATCH_TIMEOUT_MULTIHOST."""
+    return DeadlineConfig(
+        budget_s=float(os.environ.get("SBG_DISPATCH_TIMEOUT_S", "0")),
+        retries=max(0, int(os.environ.get("SBG_DISPATCH_RETRIES", "2"))),
+        backoff_s=float(os.environ.get("SBG_DISPATCH_BACKOFF_S", "0.25")),
+        multihost=os.environ.get("SBG_DISPATCH_TIMEOUT_MULTIHOST", "0") == "1",
+    )
+
+
+def run_with_deadline(fn: Callable, budget_s: float, label: str = ""):
+    """Runs ``fn()`` in a daemon worker, waiting at most ``budget_s``
+    seconds.  On breach the worker is abandoned (a blocked device RPC
+    cannot be interrupted; the daemon thread parks on it harmlessly) and
+    :class:`DispatchTimeout` is raised in the caller."""
+    if budget_s <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # delivered to the caller below
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=work, name="sbg-deadline", daemon=True)
+    worker.start()
+    if not done.wait(budget_s):
+        raise DispatchTimeout(
+            f"device dispatch{f' [{label}]' if label else ''} exceeded its "
+            f"{budget_s:g}s deadline (hung RPC / dead tunnel?)"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def dispatch_with_retry(
+    fn: Callable,
+    cfg: Optional[DeadlineConfig],
+    stats: Optional[dict] = None,
+    label: str = "",
+    on_retry: Optional[Callable[[], None]] = None,
+    site: str = "dispatch.sweep",
+):
+    """One guarded device-sweep resolve: deadline, retry, backoff.
+
+    Every attempt first marks the ``dispatch.sweep`` fault site (so
+    crash/raise injection works with or without deadlines armed), then
+    runs ``fn`` under :func:`run_with_deadline`.  A breach increments
+    ``stats['deadline_breaches']``; each retry increments
+    ``stats['dispatch_retries']``, sleeps the exponentially-growing
+    backoff, calls ``on_retry`` (re-issue the dispatch — retrying a
+    resolve whose underlying RPC is already wedged would just block on
+    the same corpse), and tries again.  After ``cfg.retries`` retries the
+    final :class:`DispatchTimeout` propagates so the caller can degrade
+    to its host-fallback path.
+
+    ``cfg=None`` (or a disabled config) short-circuits to an inline call
+    — zero threads, zero overhead beyond the fault-site lookup.
+    """
+
+    def attempt():
+        fault_point(site)
+        return fn()
+
+    if cfg is None or not cfg.enabled:
+        return attempt()
+    delay = cfg.backoff_s
+    for k in range(cfg.retries + 1):
+        try:
+            return run_with_deadline(attempt, cfg.budget_s, label)
+        except DispatchTimeout as e:
+            if stats is not None:
+                stats["deadline_breaches"] = (
+                    stats.get("deadline_breaches", 0) + 1
+                )
+            if k == cfg.retries:
+                logger.warning(
+                    "%s; %d retr%s exhausted", e, cfg.retries,
+                    "y" if cfg.retries == 1 else "ies",
+                )
+                raise
+            if stats is not None:
+                stats["dispatch_retries"] = (
+                    stats.get("dispatch_retries", 0) + 1
+                )
+            logger.warning("%s; retry %d/%d in %.2fs", e, k + 1,
+                           cfg.retries, delay)
+            time.sleep(delay)
+            delay *= 2
+            if on_retry is not None:
+                on_retry()
+    raise AssertionError("unreachable")

@@ -1,0 +1,187 @@
+"""Deterministic fault injection.
+
+Production code calls :func:`fault_point` at named host-side sites; when a
+site is armed, the configured action fires there — letting tests kill the
+process at arbitrary points and prove that checkpoints stay intact and
+``--resume-run`` reproduces the uninterrupted result bit-for-bit.
+
+Registered sites (the registry is open — any dotted name works, these are
+the ones production code fires today):
+
+========================  =====================================================
+``ckpt.write``            mid-way through writing a checkpoint's temp file
+``ckpt.replace``          after the temp file is durable, before ``os.replace``
+``journal.append``        after a journal record reaches disk
+``search.round``          between beam-search rounds (after the round record)
+``search.node``           entering one ``create_circuit`` search node
+``prefetch.produce``      producing one chunk in the streaming prefetcher
+``dispatch.sweep``        issuing/resolving one device sweep dispatch
+``native.devcb``          servicing one native-engine device-work callback
+========================  =====================================================
+
+Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
+
+    SBG_FAULTS="site:action[@when][,site:action[@when]...]"
+
+``action`` is ``raise`` (raise :class:`InjectedFault`), ``crash``
+(``os._exit``, the uncatchable analog of SIGKILL/preemption), or ``hang``
+(block forever — what a dead tunnel or wedged device RPC looks like).
+``when`` selects hits of the site, counted from 1: ``N`` fires on exactly
+the Nth hit, ``N+`` on the Nth and every later one; omitted means ``1+``
+(every hit).  Hit counting is per-process and thread-safe; with a fixed
+seed the schedules are deterministic, so the same spec kills the same
+point every run.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+CRASH_EXIT_CODE = 17
+
+ACTIONS = ("raise", "crash", "hang")
+
+#: Documented sites (informational; fault_point accepts any name).
+KNOWN_SITES = (
+    "ckpt.write",
+    "ckpt.replace",
+    "journal.append",
+    "search.round",
+    "search.node",
+    "prefetch.produce",
+    "dispatch.sweep",
+    "native.devcb",
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed ``raise`` fault site."""
+
+
+@dataclass(frozen=True)
+class _Spec:
+    action: str
+    first: int       # 1-based hit ordinal the fault starts firing at
+    once: bool       # True: fire on exactly `first`; False: `first` onward
+
+    def fires(self, hit: int) -> bool:
+        return hit == self.first if self.once else hit >= self.first
+
+
+_WHEN_RE = re.compile(r"^(\d+)(\+?)$")
+
+_lock = threading.Lock()
+_specs: Dict[str, _Spec] = {}
+_hits: Dict[str, int] = {}
+_env_loaded = False
+
+
+def parse_spec(text: str) -> Dict[str, _Spec]:
+    """Parses an ``SBG_FAULTS`` value; raises ValueError on bad syntax."""
+    out: Dict[str, _Spec] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) != 2:
+            raise ValueError(
+                f"bad fault spec {part!r}: expected 'site:action[@when]'"
+            )
+        site, action = fields
+        when = "1+"
+        if "@" in action:
+            action, _, when = action.partition("@")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"bad fault action {action!r} in {part!r}: "
+                f"expected one of {ACTIONS}"
+            )
+        m = _WHEN_RE.match(when)
+        if m is None or int(m.group(1)) < 1:
+            raise ValueError(
+                f"bad fault trigger {when!r} in {part!r}: expected 'N' or 'N+'"
+            )
+        out[site] = _Spec(action, int(m.group(1)), once=m.group(2) != "+")
+    return out
+
+
+def _load_env() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    text = os.environ.get("SBG_FAULTS", "")
+    if text:
+        _specs.update(parse_spec(text))
+
+
+def arm(site: str, action: str, when: str = "1+") -> None:
+    """Programmatically arms one site (tests; pair with :func:`disarm`)."""
+    spec = parse_spec(f"{site}:{action}@{when}")
+    with _lock:
+        _load_env()
+        _specs.update(spec)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarms one site (or all) and resets its hit counter(s)."""
+    global _env_loaded
+    with _lock:
+        if site is None:
+            _specs.clear()
+            _hits.clear()
+            _env_loaded = True  # a full reset also drops the env spec
+        else:
+            _specs.pop(site, None)
+            _hits.pop(site, None)
+
+
+def hit_count(site: str) -> int:
+    """Hits recorded so far at ``site`` (armed sites only)."""
+    with _lock:
+        return _hits.get(site, 0)
+
+
+def fault_point(site: str) -> None:
+    """Marks a named fault site; fires the armed action, if any.
+
+    The unarmed fast path is one dict lookup — cheap enough for
+    per-chunk and per-node call sites.
+    """
+    if not _env_loaded and not _specs:
+        with _lock:
+            _load_env()
+    spec = _specs.get(site)
+    if spec is None:
+        return
+    with _lock:
+        # Re-read under the lock: a concurrent disarm() may have won.
+        spec = _specs.get(site)
+        if spec is None:
+            return
+        hit = _hits.get(site, 0) + 1
+        _hits[site] = hit
+        fire = spec.fires(hit)
+    if not fire:
+        return
+    if spec.action == "raise":
+        raise InjectedFault(f"injected fault at {site} (hit {hit})")
+    if spec.action == "crash":
+        # The uncatchable death: no atexit, no finally, no flush beyond
+        # this marker — exactly what preemption looks like to the files
+        # on disk.
+        print(
+            f"[sbg-fault] crash at {site} (hit {hit})",
+            flush=True,
+        )
+        os._exit(CRASH_EXIT_CODE)
+    # hang: block forever in small sleeps (a daemon worker thread parked
+    # here is abandonable; a caller under a deadline() guard times out).
+    while True:
+        time.sleep(0.05)

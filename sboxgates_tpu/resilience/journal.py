@@ -1,0 +1,231 @@
+"""The search journal: exact resume for interrupted searches.
+
+A :class:`SearchJournal` is an append-only JSONL file in the run's output
+directory, fsync'd per record, plus an atomically-replaced JSON snapshot
+(so even a torn JSONL tail — the worst a crash can do to an append — loses
+at most the record being written, and the reader tolerates that).
+
+Records capture everything the drivers need to continue a killed search
+such that the final circuits are **bit-identical** to an uninterrupted run
+with the same seed:
+
+- ``run_start`` — the search configuration (inputs, flags, the
+  materialized seed) so ``--resume-run DIR`` can rebuild the
+  ``SearchContext`` without the original command line;
+- ``round_done`` / ``iter_done`` / ``mb_round_done`` — completed progress
+  units: beam membership (by checkpoint filename — the states themselves
+  live in the durable XML checkpoints), budget ratchets, and the host
+  PRNG position (bit-generator state **plus** the unconsumed tail of the
+  context's batched kernel-seed buffer — dropping the buffer would shift
+  every later draw);
+- ``run_done`` — the completed run's final beam, so a resume of a
+  finished run is a no-op.
+
+Granularity is the driver's natural unit (an iteration for the one-output
+driver, a beam round for the full-graph and multibox drivers): a kill
+anywhere inside a unit re-runs that unit from its recorded PRNG state,
+which reproduces it exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from .checkpoint import clean_stale_tmp, durable_write_text
+from .faults import fault_point
+
+JOURNAL_VERSION = 1
+JOURNAL_NAME = "search.journal.jsonl"
+SNAPSHOT_NAME = "search.journal.json"
+#: Snapshot refresh cadence (appends).  The JSONL is the source of truth
+#: (fsync'd per record, torn tail truncated on resume); the snapshot is
+#: the fallback for an unreadable JSONL, and a snapshot that lags by a
+#: few records only makes a resume re-run those units deterministically
+#: — correct, just redone — so it need not ride every append.
+SNAPSHOT_EVERY = 8
+
+
+class JournalError(Exception):
+    """The journal is missing, unreadable, or inconsistent."""
+
+
+class SearchJournal:
+    """Append-only run journal; see the module docstring.
+
+    Use :meth:`start` for a fresh run (truncates any previous journal in
+    the directory and writes ``run_start``) and :meth:`resume` to
+    continue one (cleans stale checkpoint temp files, replays the
+    records).  Single-writer by design: only the primary process of a
+    multi-host run journals (``distributed.is_primary``); peers validate
+    the broadcast sequence number instead
+    (``distributed.journal_seq_check``).
+    """
+
+    def __init__(
+        self, directory: str, records: List[dict], readonly: bool = False
+    ):
+        self.directory = directory
+        self.records = records
+        #: Read-only journals restore progress but never write: the
+        #: non-primary processes of a multi-host resume share the run
+        #: directory for restore, while writes stay rank-0-owned.
+        self.readonly = readonly
+        self._unsnapshotted = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def start(cls, directory: str, config: Dict[str, Any]) -> "SearchJournal":
+        os.makedirs(directory, exist_ok=True)
+        j = cls(directory, [])
+        # A new run in the directory owns it: drop the previous run's
+        # snapshot FIRST (a crash between the truncate and the run_start
+        # append must not leave an empty JSONL next to a stale snapshot
+        # that a later resume would silently resurrect), then truncate.
+        try:
+            os.unlink(os.path.join(directory, SNAPSHOT_NAME))
+        except FileNotFoundError:
+            pass
+        open(j._path, "w", encoding="utf-8").close()
+        j.append("run_start", version=JOURNAL_VERSION, config=config)
+        return j
+
+    @classmethod
+    def resume(cls, directory: str, readonly: bool = False) -> "SearchJournal":
+        records = cls.load_records(directory)
+        if not records or records[0].get("type") != "run_start":
+            raise JournalError(
+                f"no resumable journal in {directory!r} "
+                f"(missing run_start record)"
+            )
+        j = cls(directory, records, readonly=readonly)
+        if not readonly:
+            # Re-materialize the JSONL as exactly the parsed records: a
+            # crash mid-append can leave a torn, newline-less tail, and
+            # appending onto that fragment would weld the next record to
+            # garbage — silently truncating the journal at the NEXT
+            # resume to wherever the weld sits.  Best-effort: when
+            # several processes of a multi-host resume race through here
+            # against one shared directory, the losers' rewrites may
+            # fail (identical content either way) — the parsed records
+            # already in memory are authoritative.
+            try:
+                clean_stale_tmp(directory)
+                durable_write_text(
+                    j._path,
+                    "".join(
+                        json.dumps(r, sort_keys=True) + "\n" for r in records
+                    ),
+                )
+            except OSError as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "journal tail cleanup in %s failed (%s); continuing "
+                    "with the parsed records", directory, e,
+                )
+        return j
+
+    @property
+    def writable(self) -> bool:
+        return not self.readonly
+
+    @staticmethod
+    def load_records(directory: str) -> List[dict]:
+        """Journal records, tolerating a torn final JSONL line; falls back
+        to the atomic snapshot when the JSONL itself is unreadable.  The
+        snapshot may lag the JSONL by up to ``SNAPSHOT_EVERY`` records —
+        resuming from the earlier prefix just re-runs those units
+        deterministically."""
+        path = os.path.join(directory, JOURNAL_NAME)
+        records: List[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break  # torn tail: the snapshot/earlier lines rule
+        except OSError:
+            records = []
+        if records:
+            return records
+        snap = os.path.join(directory, SNAPSHOT_NAME)
+        try:
+            with open(snap, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            return list(data.get("records", []))
+        except (OSError, json.JSONDecodeError):
+            return []
+
+    # -- writing -----------------------------------------------------------
+
+    @property
+    def _path(self) -> str:
+        return os.path.join(self.directory, JOURNAL_NAME)
+
+    @property
+    def seq(self) -> int:
+        return len(self.records)
+
+    def append(self, rtype: str, **payload: Any) -> dict:
+        """Appends one fsync'd record, refreshes the atomic snapshot
+        (every ``SNAPSHOT_EVERY`` appends, plus the run boundaries), and
+        fires the ``journal.append`` fault site (after the record is
+        durable — a crash there proves the record survives).  On a
+        read-only journal this is a no-op."""
+        rec = {"seq": self.seq, "type": rtype, **payload}
+        if self.readonly:
+            return rec
+        line = json.dumps(rec, sort_keys=True)
+        with open(self._path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.records.append(rec)
+        self._unsnapshotted += 1
+        if (
+            self._unsnapshotted >= SNAPSHOT_EVERY
+            or rtype in ("run_start", "run_done")
+        ):
+            self._unsnapshotted = 0
+            durable_write_text(
+                os.path.join(self.directory, SNAPSHOT_NAME),
+                json.dumps(
+                    {"version": JOURNAL_VERSION, "records": self.records},
+                    sort_keys=True,
+                )
+                + "\n",
+            )
+        fault_point("journal.append")
+        return rec
+
+    # -- reading -----------------------------------------------------------
+
+    def last(self, rtype: str) -> Optional[dict]:
+        for rec in reversed(self.records):
+            if rec.get("type") == rtype:
+                return rec
+        return None
+
+    def of_type(self, rtype: str) -> List[dict]:
+        return [r for r in self.records if r.get("type") == rtype]
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self.records[0]["config"] if self.records else {}
+
+    @property
+    def complete(self) -> bool:
+        return self.last("run_done") is not None
+
+    def load_checkpoint(self, filename: str):
+        """Loads a beam-member checkpoint recorded by filename."""
+        from ..graph.xmlio import load_state
+
+        return load_state(os.path.join(self.directory, filename))

@@ -378,14 +378,25 @@ def generate_graph_one_output_batched(
     output: int,
     save_dir: Optional[str] = ".",
     log: Callable[[str], None] = print,
+    journal=None,
 ) -> List[State]:
     """Batched counterpart of
     :func:`sboxgates_tpu.search.orchestrator.generate_graph_one_output`:
     all ``iterations`` restarts run concurrently with rendezvous-batched
     sweeps.  Returns successful states, best (fewest gates / lowest SAT
-    metric) last."""
+    metric) last.
+
+    The batch is the journal's atomic progress unit (all per-restart
+    seeds are drawn in one up-front block): a kill anywhere inside it
+    re-runs the whole batch from the run's recorded PRNG state; a resume
+    after completion replays the recorded checkpoints."""
     opt = ctx.opt
     r = opt.iterations
+    if journal is not None:
+        rec = journal.last("batch_done")
+        if rec is not None:
+            log("Resumed: batched restarts already complete.")
+            return [journal.load_checkpoint(p) for p in rec["beam"]]
     mask = tt.mask_table(st.num_inputs)
     jobs = [(st.copy(), targets[output], mask) for _ in range(r)]
     raw = run_batched_circuits(ctx, jobs)
@@ -407,4 +418,10 @@ def generate_graph_one_output_batched(
         ok.sort(key=lambda s: -s.num_gates)
     else:
         ok.sort(key=lambda s: -s.sat_metric)
+    if journal is not None:
+        from ..graph.xmlio import state_filename
+
+        names = [state_filename(s) for s in ok]
+        journal.append("batch_done", beam=names, rng=ctx.rng_snapshot())
+        journal.append("run_done", beam=names)
     return ok

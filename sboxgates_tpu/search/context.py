@@ -21,6 +21,7 @@ from ..core import boolfunc as bf
 from ..graph.state import GATES, State
 from ..ops import combinatorics as comb
 from ..ops import sweeps
+from ..resilience import deadline as _deadline
 from ..utils.profile import PhaseProfiler
 
 # Gate-count buckets: live tables are zero-padded up to the next bucket so
@@ -159,6 +160,12 @@ class Options:
     # stream order, in-flight work issued after a hit is discarded, and
     # the accepted hit is always the lowest-ranked feasible chunk.
     pipeline_depth: int = 2
+    # Hung-dispatch deadline for blocking device-sweep resolves (seconds;
+    # None = the SBG_DISPATCH_TIMEOUT_S env default, which is 0 = off).
+    # On breach the dispatch is retried with exponential backoff
+    # (SBG_DISPATCH_RETRIES / SBG_DISPATCH_BACKOFF_S), then
+    # DispatchTimeout degrades the driver to its host-fallback path.
+    dispatch_timeout_s: Optional[float] = None
     # Run the WHOLE create_circuit recursion in a native engine
     # (csrc sbg_gate_engine / sbg_lut_engine) instead of Python driving
     # the per-node native steps: profiling showed ~64% of gate-mode
@@ -333,7 +340,25 @@ class SearchContext:
             # (mesh.py routes the once-per-call stderr signal here too
             # so long runs can report it in the -vv summary).
             "pivot_pallas_fallbacks": 0,
+            # Hung-dispatch deadline guard activity (resilience.deadline):
+            # reported by bench.py --host-stream next to the sync/compile
+            # guard counters.
+            "dispatch_retries": 0,
+            "deadline_breaches": 0,
         }
+        # Deadline policy for blocking sweep resolves (guarded_dispatch).
+        self.deadline_cfg = _deadline.config_from_env()
+        if opt.dispatch_timeout_s is not None:
+            self.deadline_cfg.budget_s = float(opt.dispatch_timeout_s)
+        # Circuit breaker: set (sticky for the run) the first time a
+        # dispatch exhausts its whole retry schedule.  Later LUT search
+        # nodes then route straight to their host-fallback drivers
+        # instead of re-probing a known-dead device for budget*(retries+1)
+        # seconds — and leaking one parked daemon thread per breach — at
+        # every node.  Best-effort across RestartContext views (each view
+        # snapshots the flag at creation); restart the process to
+        # re-enable the device paths.
+        self.device_degraded = False
         # Heartbeat state: a RUN-LEVEL mutable shared BY REFERENCE with
         # every RestartContext view (their __dict__.update snapshot
         # copies the reference, batched.py), so concurrent mux branches
@@ -387,6 +412,69 @@ class SearchContext:
                 "?" if st is None else st.num_gates,
             )
         print(line, flush=True)
+
+    def rng_snapshot(self) -> dict:
+        """JSON-able host PRNG position: the numpy bit-generator state
+        AND the unconsumed tail of the batched kernel-seed buffer —
+        restoring only the generator would shift every later
+        :meth:`next_seed` draw by the buffered remainder.  This is the
+        SearchJournal's exact-resume payload."""
+        buf, pos = self._seed_buf
+        return {
+            "bg": self.rng.bit_generator.state,
+            "seed_buf": [int(x) for x in buf[pos:]],
+        }
+
+    def rng_restore(self, snap: dict) -> None:
+        """Inverse of :meth:`rng_snapshot`: after this, every future draw
+        (host choices, engine seeds, kernel seeds) matches the run the
+        snapshot was taken from, bit for bit."""
+        self.rng.bit_generator.state = snap["bg"]
+        self._seed_buf = (np.asarray(snap["seed_buf"], dtype=np.int64), 0)
+
+    def guarded_dispatch(self, fn, label: str, on_retry=None):
+        """Runs one blocking device-sweep resolve under the hung-dispatch
+        deadline (resilience.deadline): breach -> retry with backoff ->
+        :class:`DispatchTimeout` for the caller to degrade on.  Also the
+        ``dispatch.sweep`` fault-injection site.  Disabled (inline call)
+        when no budget is configured, and on process-spanning meshes
+        unless explicitly forced — abort/retry decisions there must stay
+        replicated across processes, never derived from one process's
+        local clock."""
+        cfg = self.deadline_cfg
+        if (
+            cfg.enabled
+            and not cfg.multihost
+            and self.mesh_plan is not None
+            and self.mesh_plan.spans_processes
+        ):
+            cfg = None
+        return _deadline.dispatch_with_retry(
+            fn, cfg, stats=self.stats, label=label, on_retry=on_retry
+        )
+
+    def host_sync_deadline(self, fn, label: str):
+        """Deadline-only guard (no retry loop, no ``dispatch.sweep``
+        fault site) for the HOST-FALLBACK drivers' verdict syncs: the
+        fallback is the degradation *target*, so it must never re-enter
+        the retry/degrade machinery — but on a genuinely dead device its
+        own filter dispatches would otherwise block forever, turning the
+        "survivable hang" into an eternal one.  Gets the whole retry
+        schedule's budget in one window; a breach propagates
+        :class:`DispatchTimeout` so the search fails loudly."""
+        cfg = self.deadline_cfg
+        if (
+            not cfg.enabled
+            or (
+                not cfg.multihost
+                and self.mesh_plan is not None
+                and self.mesh_plan.spans_processes
+            )
+        ):
+            return fn()
+        return _deadline.run_with_deadline(
+            fn, cfg.budget_s * (cfg.retries + 1), label
+        )
 
     def next_seed(self) -> int:
         """Per-dispatch kernel seed.  Negative when not randomizing: the
@@ -452,14 +540,24 @@ class SearchContext:
             ),
         )
 
-    def sync_verdict(self, phase: Optional[str], value) -> np.ndarray:
+    def sync_verdict(
+        self, phase: Optional[str], value, consumer: Optional[int] = None
+    ) -> np.ndarray:
         """Blocks on a (compact) device value, recording the blocked span
-        as a ``phase`` device-wait interval for the overlap accounting."""
+        as a ``phase`` device-wait interval for the overlap accounting.
+
+        ``consumer`` pins the overlap stream to the CONSUMER thread's
+        ident when the sync itself executes elsewhere — with a dispatch
+        deadline armed, the blocking call runs on an abandonable
+        ``sbg-deadline`` worker, and keying the wait by that ephemeral
+        thread would orphan it from the prefetcher's produce/stall
+        streams (the settle condition would never fire and the overlap
+        report would drop the wait intervals)."""
         if phase is None:
             return np.asarray(value)
         t0 = time.perf_counter()
         out = np.asarray(value)
-        self.prof.add_wait(phase, t0, time.perf_counter())
+        self.prof.add_wait(phase, t0, time.perf_counter(), consumer=consumer)
         return out
 
     def _pair_combos_np(self, bucket: int) -> np.ndarray:
@@ -559,21 +657,36 @@ class SearchContext:
             chunk = -(-chunk // n) * n
             if self.mesh_plan.spans_processes:
                 return self._multihost_dispatch(args, k, chunk, n, phase)
-            verdict, feas, r1, r0 = sharded_feasible_stream(
-                self.mesh_plan, *args, k=k, chunk=chunk
-            )
+
+            def issue():
+                return sharded_feasible_stream(
+                    self.mesh_plan, *args, k=k, chunk=chunk
+                )
         else:
-            verdict, feas, r1, r0 = sweeps.feasible_stream(
-                *args, k=k, chunk=chunk
-            )
+            def issue():
+                return sweeps.feasible_stream(*args, k=k, chunk=chunk)
+
+        # Issued asynchronously NOW; a deadline retry re-issues the whole
+        # dispatch (resolving a wedged RPC again would block on the same
+        # corpse).
+        pending = {"out": issue()}
 
         def resolve():
             # ONE verdict fetch; the big per-chunk arrays stay on device
             # and are pulled by callers only on a hit (each fetch pays a
-            # full host link round trip).
-            found, cstart, examined = (
-                int(x) for x in self.sync_verdict(phase, verdict)
+            # full host link round trip).  The overlap stream stays keyed
+            # to THIS (consumer) thread even when the deadline guard runs
+            # the sync on its worker.
+            ckey = threading.get_ident()
+            vec = self.guarded_dispatch(
+                lambda: self.sync_verdict(
+                    phase, pending["out"][0], consumer=ckey
+                ),
+                f"feasible_stream k={k}",
+                on_retry=lambda: pending.update(out=issue()),
             )
+            found, cstart, examined = (int(x) for x in vec)
+            _, feas, r1, r0 = pending["out"]
             return bool(found), cstart, feas, r1, r0, examined, chunk
 
         return resolve

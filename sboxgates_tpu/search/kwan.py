@@ -20,6 +20,7 @@ from ..graph.state import (
     check_num_gates_possible,
     get_sat_metric,
 )
+from ..resilience.faults import fault_point
 from .context import SearchContext
 from .lut import lut_search, lut_search_from_head
 
@@ -30,6 +31,9 @@ def create_circuit(
     """Returns the id of a gate realizing ``target`` under ``mask``, adding
     gates to ``st`` as needed; NO_GATE on failure.  Step numbers reference
     Kwan's paper, as in the reference implementation."""
+    # Fault site: one hit per search node entered (the kill→resume tests'
+    # "mid-round" point — deterministic for a fixed seed).
+    fault_point("search.node")
     # Re-entrant phase: self-time = host control flow (state copies, mux
     # bookkeeping, verification) exclusive of the nested device sweeps.
     with ctx.prof.phase("kwan_host"):
@@ -245,6 +249,7 @@ def _lut_engine_service(ctx: SearchContext, threaded: bool = False):
     merge_lock = threading.Lock()
 
     def run(cctx, kind, st, target, mask, inbits, arg0):
+        fault_point("native.devcb")
         cctx.heartbeat(st)
         if kind == 1:  # pivot-sized space: full 5-LUT search
             with cctx.prof.phase("lut5"):

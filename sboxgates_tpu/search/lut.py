@@ -19,6 +19,8 @@ the same kernels.
 
 from __future__ import annotations
 
+import logging
+import threading
 from collections import deque
 from contextlib import closing
 from typing import List, Optional, Tuple
@@ -29,6 +31,7 @@ import numpy as np
 from ..graph.state import NO_GATE, State, check_num_gates_possible
 from ..ops import combinatorics as comb
 from ..ops import sweeps
+from ..resilience.deadline import DispatchTimeout
 from .context import (
     LUT5_CHUNK,
     LUT5_SOLVE_CHUNK,
@@ -43,6 +46,8 @@ from .context import (
     lut_head_has7,
     pick_chunk,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def _unpack32(word: int) -> np.ndarray:
@@ -98,8 +103,12 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
         # triple by hashed priority, so the whole search costs one verdict
         # fetch.
         args, total, chunk = ctx.stream_args(st, target, mask, [], 3)
-        v = np.asarray(
-            sweeps.lut3_stream(*args, 0, total, ctx.next_seed(), chunk=chunk)
+        seed = ctx.next_seed()
+        v = ctx.guarded_dispatch(
+            lambda: np.asarray(
+                sweeps.lut3_stream(*args, 0, total, seed, chunk=chunk)
+            ),
+            "lut3.stream",
         )
         ctx.stats["lut3_candidates"] += int(v[4])
         if not v[0]:
@@ -183,15 +192,17 @@ def _solve_lut5_rows(
         p1, _ = comb.pad_rows(req1[lo:hi], scs, fill=0xFFFFFFFF)
         p0, _ = comb.pad_rows(req0[lo:hi], scs, fill=0xFFFFFFFF)
         ctx.stats["lut5_solved"] += hi - lo
-        # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
-        v = np.asarray(
-            sweeps.lut5_solve(
-                ctx.place_chunk(p1, fill=0xFFFFFFFF),
-                ctx.place_chunk(p0, fill=0xFFFFFFFF),
+        seed = ctx.next_seed()
+        v = ctx.host_sync_deadline(
+            # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
+            lambda a=p1, b=p0: np.asarray(sweeps.lut5_solve(
+                ctx.place_chunk(a, fill=0xFFFFFFFF),
+                ctx.place_chunk(b, fill=0xFFFFFFFF),
                 jw,
                 jm,
-                ctx.next_seed(),
-            )
+                seed,
+            )),
+            "lut5.solve",
         )
         if not v[0]:
             continue
@@ -427,14 +438,28 @@ def _lut5_search_pivot(
             # SPMD lockstep rounds of one tile per device; per-device
             # verdicts resolved in tile order, so the chosen circuit matches
             # the single-device stream's when not randomizing.
-            # jaxlint: ignore[R2] deliberate sync: per-round sharded verdict gather is the stream's only sync point
-            verdicts = np.asarray(
-                sharded_pivot_stream(
+            seed = ctx.next_seed()
+
+            # Per-ATTEMPT stats dict, allocated inside the attempt: an
+            # abandoned deadline worker that completes late writes only
+            # into its own private dict, so it can never race ctx.stats
+            # NOR the winning attempt's merge (the winner's dict is
+            # quiescent once the attempt returns it).
+            def _pivot_attempt(s=start_t):
+                astats: dict = {}
+                # jaxlint: ignore[R2] deliberate sync: per-round sharded verdict gather is the stream's only sync point
+                out = np.asarray(sharded_pivot_stream(
                     ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
-                    start_t, t_real, jw, jm, ctx.next_seed(),
-                    tl=tl, th=th, stats=ctx.stats,
-                )
+                    s, t_real, jw, jm, seed,
+                    tl=tl, th=th, stats=astats,
+                ))
+                return out, astats
+
+            verdicts, local_stats = ctx.guarded_dispatch(
+                _pivot_attempt, "lut5.pivot.sharded"
             )
+            for k, n in local_stats.items():
+                ctx.stats[k] = ctx.stats.get(k, 0) + n
             next_t = int(verdicts[0, 9])
             ctx.stats["lut5_candidates"] += int(
                 size_cum[min(next_t, t_real)] - size_cum[start_t]
@@ -453,17 +478,19 @@ def _lut5_search_pivot(
             continue
 
         backend = pivot_backend()
-        # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
-        v = np.asarray(
-            sweeps.lut5_pivot_stream(
-                tables, lc1, lc0, hc, jlv, jhv, jdescs, start_t, t_real,
-                jw, jm, ctx.next_seed(), tl=tl, th=th,
+        seed = ctx.next_seed()
+        v = ctx.guarded_dispatch(
+            # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
+            lambda s=start_t: np.asarray(sweeps.lut5_pivot_stream(
+                tables, lc1, lc0, hc, jlv, jhv, jdescs, s, t_real,
+                jw, jm, seed, tl=tl, th=th,
                 tile_batch=(
                     1 if backend.startswith("pallas")
                     else pivot_tile_batch()
                 ),
                 pipeline=pivot_pipeline(), backend=backend,
-            )
+            )),
+            "lut5.pivot",
         )
         status, next_t = int(v[0]), int(v[8])
         ctx.stats["lut5_candidates"] += int(
@@ -494,14 +521,45 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     in the packed cell domain, continuing the sweep past chunks whose
     feasible tuples admit no LUT(LUT,·,·) decomposition.  Large spaces use
     the pivot-structured sweep (no gathers / rank arithmetic).
-    """
+
+    With a hung-dispatch deadline configured
+    (``Options.dispatch_timeout_s`` / ``SBG_DISPATCH_TIMEOUT_S``), a
+    device sweep whose retries all breach the budget degrades to the
+    host-chunked fallback driver, which sweeps the identical space in the
+    identical chunk order — the returned first hit matches the device
+    stream's."""
     g = st.num_gates
     if g < 5:
         return None
+    if ctx.device_degraded or (
+        comb.n_choose_k(g, 5) < PIVOT_MIN_TOTAL
+        and not sweeps.device_rank_limit(g, 5)
+    ):
+        # Host-routed outright: the circuit breaker tripped (a prior
+        # dispatch exhausted its whole retry schedule — re-probing a dead
+        # device per node would stall budget*(retries+1) every time), or
+        # the rank exceeds int32 and there is no device path to degrade
+        # from.  Either way a host-driver DispatchTimeout must propagate,
+        # never trigger a second fallback run.
+        return _lut5_search_host(ctx, st, target, mask, inbits)
+    try:
+        return _lut5_search_device(ctx, st, target, mask, inbits)
+    except DispatchTimeout as e:
+        logger.warning(
+            "%s; degrading the 5-LUT sweep to the host-fallback driver", e
+        )
+        ctx.device_degraded = True
+        return _lut5_search_host(ctx, st, target, mask, inbits)
+
+
+def _lut5_search_device(
+    ctx: SearchContext, st: State, target, mask, inbits
+) -> Optional[dict]:
+    """Device-routed 5-LUT search body (pivot / fused stream / mesh
+    feasible-stream); raises DispatchTimeout past the deadline budget."""
+    g = st.num_gates
     if comb.n_choose_k(g, 5) >= PIVOT_MIN_TOTAL:
         return _lut5_search_pivot(ctx, st, target, mask, inbits)
-    if not sweeps.device_rank_limit(g, 5):
-        return _lut5_search_host(ctx, st, target, mask, inbits)
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
     total = comb.n_choose_k(g, 5)
@@ -566,11 +624,13 @@ def _lut5_stream_loop(
     g = st.num_gates
     args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
     while start < total:
-        # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
-        v = np.asarray(
-            sweeps.lut5_stream(
-                *args, start, total, jw, jm, ctx.next_seed(), chunk=chunk
-            )
+        seed = ctx.next_seed()
+        v = ctx.guarded_dispatch(
+            # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
+            lambda s=start: np.asarray(sweeps.lut5_stream(
+                *args, s, total, jw, jm, seed, chunk=chunk
+            )),
+            "lut5.stream",
         )
         status, cstart = int(v[0]), int(v[6])
         ctx.stats["lut5_candidates"] += int(v[7])
@@ -645,21 +705,36 @@ def lut5_resume_overflow(
     path, then resume the fused stream after it.  Shared by the Python
     head path (:func:`lut_search_from_head` step 6) and the native
     engine's device-work service (kwan._lut_engine_service kind 2)."""
+    if ctx.device_degraded:
+        # Circuit breaker (see lut5_search): never re-probe a known-dead
+        # device from the overflow-resume continuation either.
+        return _lut5_search_host(ctx, st, target, mask, inbits)
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
-    with ctx.prof.phase("lut5"):
-        res = _lut5_chunk_two_phase(
-            ctx, st, target, mask, inbits, cstart, jw, jm,
-            splits, w_tab, m_tab,
+    try:
+        with ctx.prof.phase("lut5"):
+            res = _lut5_chunk_two_phase(
+                ctx, st, target, mask, inbits, cstart, jw, jm,
+                splits, w_tab, m_tab,
+            )
+            if res is None:
+                chunk = pick_chunk(
+                    comb.n_choose_k(st.num_gates, 5), STREAM_CHUNK[5]
+                )
+                res = _lut5_stream_loop(
+                    ctx, st, target, mask, inbits, cstart + chunk,
+                    jw, jm, splits, w_tab, m_tab,
+                )
+    except DispatchTimeout as e:
+        # Degrade to the host-chunked driver over the WHOLE space: the
+        # prefix before cstart was already proven unsolvable, so the
+        # rescan reaches the same first hit (it only re-pays that work).
+        logger.warning(
+            "%s; degrading the overflow-resume 5-LUT sweep to the "
+            "host-fallback driver", e,
         )
-        if res is None:
-            chunk = pick_chunk(
-                comb.n_choose_k(st.num_gates, 5), STREAM_CHUNK[5]
-            )
-            res = _lut5_stream_loop(
-                ctx, st, target, mask, inbits, cstart + chunk,
-                jw, jm, splits, w_tab, m_tab,
-            )
+        ctx.device_degraded = True
+        res = _lut5_search_host(ctx, st, target, mask, inbits)
     return res
 
 
@@ -689,6 +764,9 @@ def _host_feasible_chunks(
     stream = comb.CombinationStream(g, k)
     csize = pick_chunk(stream.total, chunk_cap)
     depth = ctx.pipeline_depth
+    # Consumer thread ident: keys this driver's overlap streams alongside
+    # the prefetcher's, even when a sync runs on a deadline worker.
+    ckey = threading.get_ident()
     with ctx.host_prefetcher(stream, csize, excl, phase) as pf:
         inflight: deque = deque()
         exhausted = False
@@ -712,7 +790,18 @@ def _host_feasible_chunks(
                 return
             padded, nvalid, hit, feas, req1p, req0p = inflight.popleft()
             ctx.stats[stat_key] += nvalid
-            if not bool(ctx.sync_verdict(phase, hit)):
+            # Deadline-only sync (host_sync_deadline): this driver IS the
+            # degradation target, so a dead device must surface as a loud
+            # DispatchTimeout here, never an eternal hang — and never a
+            # re-entry into the retry/degrade loop.  The overlap stream
+            # stays keyed to this consumer thread (the guard may run the
+            # sync on its worker).
+            if not bool(
+                ctx.host_sync_deadline(
+                    lambda h=hit: ctx.sync_verdict(phase, h, consumer=ckey),
+                    phase,
+                )
+            ):
                 continue
             # jaxlint: ignore[R2] deliberate sync: feasibility bitmap resolved only after the pipelined verdict said hit
             yield padded, np.asarray(feas)[:csize], req1p, req0p
@@ -769,7 +858,9 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
     returned hit list and the candidate statistics are identical to the
     serial (depth=1) driver's."""
     g = st.num_gates
-    use_device_stream = sweeps.device_rank_limit(g, 7)
+    use_device_stream = (
+        sweeps.device_rank_limit(g, 7) and not ctx.device_degraded
+    )
 
     hit_combos: List[np.ndarray] = []
     hit_req1: List[np.ndarray] = []
@@ -779,66 +870,26 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
     phase = "lut7.stageA"
 
     if use_device_stream:
-        total = comb.n_choose_k(g, 7)
-        prebuilt = ctx.stream_args(st, target, mask, inbits, 7)
-
-        def dispatch(start):
-            if start >= total:
-                return None
-            return ctx.feasible_stream_dispatch(
-                st, target, mask, inbits, k=7, start=start,
-                prebuilt=prebuilt, phase=phase,
+        cand_before = ctx.stats["lut7_candidates"]
+        try:
+            hit_combos, hit_req1, hit_req0, nhits = _lut7_device_stage_a(
+                ctx, st, target, mask, inbits, depth, phase
             )
-
-        resolve = dispatch(0)
-        # Worst per-window row count seen so far — the speculation gate's
-        # headroom estimate (None until the first window resolves).
-        max_rows = None
-        while resolve is not None and nhits < LUT7_CAP:
-            found, cstart, feas, r1, r0, examined, chunk = resolve()
-            ctx.stats["lut7_candidates"] += examined
-            if not found:
-                break
-            # Keep the device busy during the host-side fetch + unrank of
-            # this window's hit rows: the resume stream's start depends
-            # only on the verdict, so it can launch right now.  When the
-            # rows below cross LUT7_CAP the in-flight dispatch is simply
-            # dropped (its candidates intentionally uncounted — the
-            # serial driver never swept them) — but the device still runs
-            # the abandoned stream, which in a hit-sparse tail can scan
-            # the whole remaining C(G,7) space before stage B and the
-            # next node's sweeps get the device (the same cost
-            # lut5_search's solve_failed gate guards against).  So
-            # speculate only with demonstrated cap headroom: this
-            # window's rows are unknown until the expensive feas fetch
-            # below, so assume it and the next window each bring the
-            # worst row count seen so far and require the cap to survive
-            # both.  The first window always resolves serially (no
-            # history), matching lut5's initially-unarmed speculation.
-            speculate = (
-                depth >= 2 and max_rows is not None
-                and nhits + 2 * max_rows < LUT7_CAP
+        except DispatchTimeout as e:
+            # Degrade to the host-chunked driver, restarting collection
+            # from rank 0: a partial device-collected prefix plus a host
+            # tail could duplicate or reorder hits, and stage A's contract
+            # is strict rank order.  Back out the abandoned windows'
+            # candidate tally too — the host driver recounts the same
+            # ranks from 0, and the stats must stay exact.
+            logger.warning(
+                "%s; degrading 7-LUT stage A to the host-chunked driver", e
             )
-            resolve = dispatch(cstart + chunk) if speculate else None
-            # jaxlint: ignore[R2] deliberate sync: window resolve point of the double-buffered lut7 stream
-            feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
-            rows = np.nonzero(feas)[0]
-            hit_combos.append(
-                np.stack(
-                    [comb.unrank_combination(cstart + int(r), g, 7) for r in rows]
-                )
-            )
-            hit_req1.append(r1[rows])
-            hit_req0.append(r0[rows])
-            nhits += len(rows)
-            max_rows = max(max_rows or 0, len(rows))
-            if resolve is None and nhits < LUT7_CAP:
-                # No speculative dispatch was in flight (serial depth,
-                # first window, or insufficient headroom): resume only
-                # now that this window is fully consumed — and never
-                # past the cap.
-                resolve = dispatch(cstart + chunk)
-    else:
+            ctx.stats["lut7_candidates"] = cand_before
+            ctx.device_degraded = True
+            hit_combos, hit_req1, hit_req0, nhits = [], [], [], 0
+            use_device_stream = False
+    if not use_device_stream:
         chunks = _host_feasible_chunks(
             ctx, st, target, mask, inbits, k=7, chunk_cap=LUT7_CHUNK,
             stat_key="lut7_candidates", phase=phase,
@@ -868,6 +919,79 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
         perm = ctx.rng.permutation(len(combos))
         combos, req1, req0 = combos[perm], req1[perm], req0[perm]
     return combos, req1, req0
+
+
+def _lut7_device_stage_a(
+    ctx: SearchContext, st: State, target, mask, inbits, depth: int,
+    phase: str,
+):
+    """Device-stream half of stage A (see :func:`_lut7_collect_hits`);
+    raises DispatchTimeout past the deadline budget."""
+    g = st.num_gates
+    hit_combos: List[np.ndarray] = []
+    hit_req1: List[np.ndarray] = []
+    hit_req0: List[np.ndarray] = []
+    nhits = 0
+    total = comb.n_choose_k(g, 7)
+    prebuilt = ctx.stream_args(st, target, mask, inbits, 7)
+
+    def dispatch(start):
+        if start >= total:
+            return None
+        return ctx.feasible_stream_dispatch(
+            st, target, mask, inbits, k=7, start=start,
+            prebuilt=prebuilt, phase=phase,
+        )
+
+    resolve = dispatch(0)
+    # Worst per-window row count seen so far — the speculation gate's
+    # headroom estimate (None until the first window resolves).
+    max_rows = None
+    while resolve is not None and nhits < LUT7_CAP:
+        found, cstart, feas, r1, r0, examined, chunk = resolve()
+        ctx.stats["lut7_candidates"] += examined
+        if not found:
+            break
+        # Keep the device busy during the host-side fetch + unrank of
+        # this window's hit rows: the resume stream's start depends
+        # only on the verdict, so it can launch right now.  When the
+        # rows below cross LUT7_CAP the in-flight dispatch is simply
+        # dropped (its candidates intentionally uncounted — the
+        # serial driver never swept them) — but the device still runs
+        # the abandoned stream, which in a hit-sparse tail can scan
+        # the whole remaining C(G,7) space before stage B and the
+        # next node's sweeps get the device (the same cost
+        # lut5_search's solve_failed gate guards against).  So
+        # speculate only with demonstrated cap headroom: this
+        # window's rows are unknown until the expensive feas fetch
+        # below, so assume it and the next window each bring the
+        # worst row count seen so far and require the cap to survive
+        # both.  The first window always resolves serially (no
+        # history), matching lut5's initially-unarmed speculation.
+        speculate = (
+            depth >= 2 and max_rows is not None
+            and nhits + 2 * max_rows < LUT7_CAP
+        )
+        resolve = dispatch(cstart + chunk) if speculate else None
+        # jaxlint: ignore[R2] deliberate sync: window resolve point of the double-buffered lut7 stream
+        feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+        rows = np.nonzero(feas)[0]
+        hit_combos.append(
+            np.stack(
+                [comb.unrank_combination(cstart + int(r), g, 7) for r in rows]
+            )
+        )
+        hit_req1.append(r1[rows])
+        hit_req0.append(r0[rows])
+        nhits += len(rows)
+        max_rows = max(max_rows or 0, len(rows))
+        if resolve is None and nhits < LUT7_CAP:
+            # No speculative dispatch was in flight (serial depth,
+            # first window, or insufficient headroom): resume only
+            # now that this window is fully consumed — and never
+            # past the cap.
+            resolve = dispatch(cstart + chunk)
+    return hit_combos, hit_req1, hit_req0, nhits
 
 
 def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional[dict]:
@@ -902,15 +1026,17 @@ def _lut7_solve_hits(
         r1, _ = comb.pad_rows(req1[lo:hi], size, fill=0xFFFFFFFF)
         r0, _ = comb.pad_rows(req0[lo:hi], size, fill=0xFFFFFFFF)
         ctx.stats["lut7_solved"] += hi - lo
-        # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
-        v = np.asarray(
-            sweeps.lut7_solve(
-                ctx.place_chunk(r1, fill=0xFFFFFFFF),
-                ctx.place_chunk(r0, fill=0xFFFFFFFF),
+        seed = ctx.next_seed()
+        v = ctx.host_sync_deadline(
+            # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
+            lambda a=r1, b=r0: np.asarray(sweeps.lut7_solve(
+                ctx.place_chunk(a, fill=0xFFFFFFFF),
+                ctx.place_chunk(b, fill=0xFFFFFFFF),
                 jidx,
                 jpp,
-                ctx.next_seed(),
-            )
+                seed,
+            )),
+            "lut7.solve",
         )
         if not v[0]:
             continue

@@ -41,7 +41,8 @@ import numpy as np
 
 from ..core import ttable as tt
 from ..graph.state import GATES, NO_GATE, State
-from ..graph.xmlio import save_state
+from ..graph.xmlio import save_state, state_filename
+from ..resilience.faults import fault_point
 from .context import SearchContext
 from .kwan import create_circuit
 from .orchestrator import BeamFold, make_targets, sbox_num_outputs
@@ -220,6 +221,7 @@ def search_boxes_all_outputs(
     save_dir: Optional[str] = ".",
     log: Callable[[str], None] = print,
     batched: Optional[bool] = None,
+    journal=None,
 ) -> dict:
     """Full-graph greedy beam search for every box, run in lockstep
     rounds: each round gathers every (box x start-state x missing-output
@@ -227,6 +229,12 @@ def search_boxes_all_outputs(
     results through each box's own beam (identical beam semantics to the
     single-box driver, sboxgates.c:701-788).  Boxes whose graphs complete
     drop out of later rounds.  Returns {box.name: final beam states}.
+
+    ``journal`` records every box's beam (by per-box checkpoint path) and
+    the host PRNG position at each lockstep round boundary — one record
+    for the whole sweep, because the round IS the sweep's atomic unit.  A
+    killed sweep resumed from the journal restarts the interrupted round
+    and finishes with bit-identical beams.  Requires ``save_dir``.
     """
     batched = _auto_batched(ctx, batched, boxes)
     opt = ctx.opt
@@ -234,6 +242,23 @@ def search_boxes_all_outputs(
     final: dict = {box.name: [] for box in boxes}
     live = list(boxes)
     rnd = 0
+    if journal is not None:
+        rec = journal.last("mb_round_done")
+        if rec is not None:
+            rnd = rec["round"]
+            ctx.rng_restore(rec["rng"])
+            live = []
+            for box in boxes:
+                ent = rec["boxes"].get(box.name)
+                if ent is None:
+                    continue
+                states = [journal.load_checkpoint(p) for p in ent["beam"]]
+                beams[box.name] = states
+                if ent["done"]:
+                    final[box.name] = states
+                elif states:
+                    live.append(box)
+            log(f"Resumed after round {rnd}.")
     while live:
         rnd += 1
         jobs, meta = [], []
@@ -286,6 +311,47 @@ def search_boxes_all_outputs(
             else:
                 still.append(box)
         live = still
+        if journal is not None and journal.writable:
+            boxes_state = {}
+            for box in boxes:
+                states, done = (
+                    (final[box.name], True)
+                    if final[box.name]
+                    else (beams[box.name], False)
+                )
+                # Re-save only LIVE beams (guaranteeing the files named
+                # by the record exist); a finished box's beam was durably
+                # saved the round it completed and never changes again.
+                d = None if done else _save_dir_for(save_dir, box.name)
+                names = []
+                for s in states:
+                    if d is not None:
+                        save_state(s, d)
+                    names.append(f"{box.name}/{state_filename(s)}")
+                boxes_state[box.name] = {"beam": names, "done": done}
+            journal.append(
+                "mb_round_done", round=rnd, boxes=boxes_state,
+                rng=ctx.rng_snapshot(),
+            )
+            fault_point("search.round")
+        # Every process joins the round barrier (journal or not): a
+        # desynced multi-host resume — one peer restored from a stale
+        # directory — must fail loudly here, not deadlock the next
+        # collective with misaligned seed streams (same contract as
+        # generate_graph's _round_checkpoint).
+        from ..parallel import distributed as dist
+
+        dist.journal_seq_check(
+            rnd, journal.seq if journal is not None else None
+        )
+    if journal is not None:
+        journal.append(
+            "run_done",
+            boxes={
+                name: [f"{name}/{state_filename(s)}" for s in states]
+                for name, states in final.items()
+            },
+        )
     return final
 
 

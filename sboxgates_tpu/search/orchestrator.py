@@ -4,13 +4,15 @@ sboxgates.c:661-688, generate_graph sboxgates.c:701-788)."""
 
 from __future__ import annotations
 
+import os
 from typing import Callable, List, Optional
 
 import numpy as np
 
 from ..core import ttable as tt
 from ..graph.state import GATES, INT_MAX, MAX_GATES, NO_GATE, State
-from ..graph.xmlio import save_state
+from ..graph.xmlio import save_state, state_filename
+from ..resilience.faults import fault_point
 from .context import Options, SearchContext
 from .kwan import create_circuit
 
@@ -76,6 +78,7 @@ def generate_graph_one_output(
     output: int,
     save_dir: Optional[str] = ".",
     log: Callable[[str], None] = print,
+    journal=None,
 ) -> List[State]:
     """``iterations`` independent attempts at one output bit, ratcheting the
     budget down after each success (sboxgates.c:661-688).  Returns all
@@ -84,7 +87,15 @@ def generate_graph_one_output(
     With ``Options.batch_restarts`` the serial loop is replaced by the
     rendezvous-batched concurrent driver (one vmapped device dispatch per
     sweep round across all restarts; restarts are then independent — no
-    cross-iteration budget ratchet, as if run in parallel processes)."""
+    cross-iteration budget ratchet, as if run in parallel processes).
+
+    ``journal`` (a :class:`sboxgates_tpu.resilience.SearchJournal`)
+    records each completed iteration — result checkpoint, budget
+    ratchets, host PRNG position — so a killed run resumed from the same
+    journal replays the completed iterations from their checkpoints and
+    continues from the exact PRNG state, producing bit-identical final
+    circuits.  Requires ``save_dir`` (the checkpoints ARE the recorded
+    states)."""
     opt = ctx.opt
     log(f"Generating graphs for output {output}...")
     # Batched restarts are host threads sharing rendezvous-merged
@@ -96,27 +107,57 @@ def generate_graph_one_output(
         from .batched import generate_graph_one_output_batched
 
         return generate_graph_one_output_batched(
-            ctx, st, targets, output, save_dir=save_dir, log=log
+            ctx, st, targets, output, save_dir=save_dir, log=log,
+            journal=journal,
         )
     mask = tt.mask_table(st.num_inputs)
     results = []
-    for it in range(opt.iterations):
+    start_it = 0
+    if journal is not None:
+        rec = journal.last("iter_done")
+        if rec is not None:
+            # Replay: completed iterations come back from their durable
+            # checkpoints; the PRNG continues from the recorded position.
+            start_it = rec["it"] + 1
+            st.max_gates = rec["max_gates"]
+            st.max_sat_metric = rec["max_sat_metric"]
+            ctx.rng_restore(rec["rng"])
+            results = [
+                journal.load_checkpoint(r["ckpt"])
+                for r in journal.of_type("iter_done")
+                if r.get("ckpt")
+            ]
+            log(f"Resumed at iteration {start_it + 1}/{opt.iterations}.")
+    for it in range(start_it, opt.iterations):
         nst = st.copy()
         nst.outputs[output] = create_circuit(ctx, nst, targets[output], mask, [])
+        ckpt = None
         if nst.outputs[output] == NO_GATE:
             log(f"({it + 1}/{opt.iterations}): Not found.")
-            continue
-        log(
-            f"({it + 1}/{opt.iterations}): {nst.num_gates - nst.num_inputs} gates. "
-            f"SAT metric: {nst.sat_metric}"
-        )
-        if save_dir is not None:
-            save_state(nst, save_dir)
-        results.append(nst)
-        if opt.metric == GATES:
-            st.max_gates = min(st.max_gates, nst.num_gates)
         else:
-            st.max_sat_metric = min(st.max_sat_metric, nst.sat_metric)
+            log(
+                f"({it + 1}/{opt.iterations}): "
+                f"{nst.num_gates - nst.num_inputs} gates. "
+                f"SAT metric: {nst.sat_metric}"
+            )
+            if save_dir is not None:
+                ckpt = os.path.basename(save_state(nst, save_dir))
+            results.append(nst)
+            if opt.metric == GATES:
+                st.max_gates = min(st.max_gates, nst.num_gates)
+            else:
+                st.max_sat_metric = min(st.max_sat_metric, nst.sat_metric)
+        if journal is not None:
+            journal.append(
+                "iter_done", it=it, ckpt=ckpt,
+                max_gates=st.max_gates, max_sat_metric=st.max_sat_metric,
+                rng=ctx.rng_snapshot(),
+            )
+    if journal is not None:
+        journal.append(
+            "run_done",
+            beam=[state_filename(s) for s in results],
+        )
     return results
 
 
@@ -126,15 +167,32 @@ def generate_graph(
     targets,
     save_dir: Optional[str] = ".",
     log: Callable[[str], None] = print,
+    journal=None,
 ) -> List[State]:
     """Greedy beam search over output order: repeatedly add every missing
     output to every surviving start state, keeping up to BEAM_WIDTH
     minimal-metric states per round (sboxgates.c:701-788).  Returns the
-    final beam."""
+    final beam.
+
+    ``journal`` records each completed round's beam (by checkpoint
+    filename, in beam order) and the host PRNG position; a killed run
+    resumed from the journal restarts the interrupted round from its
+    recorded PRNG state — bit-identical final beams (the round is the
+    atomic progress unit; per-round budgets are fresh BeamFold state, so
+    beam membership + PRNG position is the complete round boundary).
+    Requires ``save_dir``."""
     opt = ctx.opt
     num_outputs = sbox_num_outputs(targets)
     mask = tt.mask_table(st.num_inputs)
     start_states = [st]
+    rnd = 0
+    if journal is not None:
+        rec = journal.last("round_done")
+        if rec is not None:
+            start_states = [journal.load_checkpoint(p) for p in rec["beam"]]
+            ctx.rng_restore(rec["rng"])
+            rnd = rec["round"]
+            log(f"Resumed after round {rnd}.")
 
     while sum(1 for o in start_states[0].outputs if o != NO_GATE) < num_outputs:
         done = sum(1 for o in start_states[0].outputs if o != NO_GATE)
@@ -195,6 +253,8 @@ def generate_graph(
                         )
                         consider(nst, output)
         if not beam.states:
+            if journal is not None:
+                journal.append("run_done", beam=[])
             return []
         if opt.metric == GATES:
             log(
@@ -209,4 +269,33 @@ def generate_graph(
                 f"{beam.max_sat_metric}."
             )
         start_states = beam.states
+        rnd += 1
+        _round_checkpoint(ctx, journal, rnd, beam.states, save_dir)
+    if journal is not None:
+        journal.append(
+            "run_done", beam=[state_filename(s) for s in start_states]
+        )
     return start_states
+
+
+def _round_checkpoint(ctx, journal, rnd: int, beam_states, save_dir) -> None:
+    """Round boundary: journal the surviving beam (every member's
+    checkpoint already exists — ``consider`` saves all solutions — but
+    re-saving is an idempotent atomic replace and guarantees the files
+    named by the record are on disk), validate multi-host lockstep, and
+    mark the ``search.round`` fault site."""
+    if journal is not None and journal.writable:
+        for s in beam_states:
+            save_state(s, save_dir)
+        journal.append(
+            "round_done", round=rnd,
+            beam=[state_filename(s) for s in beam_states],
+            rng=ctx.rng_snapshot(),
+        )
+        fault_point("search.round")
+    # Non-primary processes carry journal=None; every process still joins
+    # the sequence-number broadcast so a desynced resume fails loudly
+    # instead of deadlocking the next collective.
+    from ..parallel import distributed as dist
+
+    dist.journal_seq_check(rnd, journal.seq if journal is not None else None)

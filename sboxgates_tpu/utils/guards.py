@@ -19,7 +19,15 @@ those shapes; together the two nets overlap.
 
 Both guards are re-entrant-safe for nested use but not thread-safe:
 install them from the consumer thread that owns the region under test
-(bench.py's timing loops, the streaming tests).
+(bench.py's timing loops, the streaming tests).  With the hung-dispatch
+deadline armed (:mod:`sboxgates_tpu.resilience.deadline`), guarded sweep
+resolves execute on a short-lived ``sbg-deadline`` worker thread; the
+sync wrappers still count those transfers (the patch is process-global),
+so the tallies stay complete — only strict ``action="raise"`` delivery
+moves to the resolving thread, where the driver surfaces it.  The
+deadline guard's own activity is reported separately
+(``dispatch_retries`` / ``deadline_breaches`` in the context stats and
+the bench output).
 """
 
 from __future__ import annotations

@@ -1,0 +1,23 @@
+# jaxlint unused-suppression fixture.  Read as text — never imported.
+
+
+def probe_stale():
+    try:
+        import maybe_missing  # noqa: F401
+    except ImportError:  # jaxlint: ignore[R5] handler narrowed long ago; marker left behind
+        return False
+
+
+def probe_stale_standalone():
+    try:
+        import maybe_missing  # noqa: F401
+    # jaxlint: ignore[R5] standalone form, equally stale
+    except ImportError:
+        return False
+
+
+def probe_partial():
+    try:
+        import maybe_missing  # noqa: F401
+    except Exception:  # jaxlint: ignore[R5,R3] R5 fires here, R3 never did
+        return False

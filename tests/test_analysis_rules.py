@@ -88,6 +88,57 @@ def test_reasonless_and_unknown_rule_suppressions_do_not_suppress():
     assert len(got) == 4
 
 
+def test_unused_suppression_is_a_finding():
+    """A well-formed marker whose finding no longer fires is itself
+    reported (the deferred unused-suppression rule)."""
+    report = lint_fixture("unused_suppression.py")
+    got = found(report)
+    # probe_stale (same-line) and probe_stale_standalone: no R5 finding
+    # on the marked lines -> the markers are stale
+    assert (SUPPRESSION_RULE, 7) in got
+    assert (SUPPRESSION_RULE, 14) in got
+    # probe_partial: R5 fires (and stays suppressed) but R3 never did —
+    # the marker is flagged for its unused half only
+    assert (SUPPRESSION_RULE, 22) in got
+    assert [(f.rule, f.line) for f in report.suppressed] == [("R5", 22)]
+    assert len(got) == 3
+    msgs = [f.message for f in report.findings]
+    assert all("unused suppression" in m for m in msgs)
+    assert any("R3" in m for m in msgs)
+
+
+def test_used_suppressions_are_not_flagged():
+    report = lint_fixture("unused_clean.py")
+    assert found(report) == []
+    assert [(f.rule, f.line) for f in report.suppressed] == [
+        ("R5", 9),
+        ("R5", 17),
+    ]
+
+
+def test_unused_suppression_respects_checked_rules():
+    """A marker for a rule this scan did not execute (R2 in a non-hot
+    file, or a rule disabled by config) is not judged stale."""
+    src = (
+        "for x in items:\n"
+        "    # jaxlint: ignore[R2] verdict sync, measured\n"
+        "    v = np.asarray(x)\n"
+    )
+    # Hot file: R2 fires on the asarray line and the marker is used.
+    hot = lint_source(src, "hot.py", JaxlintConfig(), hot=True)
+    assert found(hot) == []
+    assert [(f.rule, f.line) for f in hot.suppressed] == [("R2", 3)]
+    # Non-hot file: R2 never ran, so the marker cannot be judged stale.
+    cold = lint_source(src, "cold.py", JaxlintConfig(), hot=False)
+    assert found(cold) == []
+    # Rule disabled entirely: same reasoning.
+    off = lint_source(
+        src, "hot.py", JaxlintConfig(rules=["R1", "R3", "R4", "R5"]),
+        hot=True,
+    )
+    assert found(off) == []
+
+
 def test_rule_subset_config():
     report = lint_source(
         open(os.path.join(FIXTURES, "r5_violation.py")).read(),

@@ -44,6 +44,38 @@ def test_invalid_invocations_fail(argv):
     assert main(argv) != 0
 
 
+def test_truncated_graph_file_is_one_line_error(tmp_path, capsys):
+    """-g on a truncated or corrupt XML state exits nonzero with a
+    one-line error naming the file and the parse failure — never a
+    traceback."""
+    d = str(tmp_path)
+    files = _run_search(d, ["-i", "1", "-o", "0", "--seed", "5", FA])
+    good = os.path.join(d, files[0])
+    bad = os.path.join(d, "truncated.xml")
+    with open(good) as src, open(bad, "w") as dst:
+        dst.write(src.read()[:60])
+    capsys.readouterr()  # drop the search output
+    rc = main(["-g", bad, FA, "--output-dir", d])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert bad in err
+    assert err.strip().count("\n") == 0  # exactly one line
+    assert "Traceback" not in err
+    # Digest-verified corruption reports the same way.
+    body = open(good).read()
+    with open(bad, "w") as dst:
+        dst.write(body.replace('type="IN"', 'type="NO"', 1))
+    rc = main(["-g", bad, FA, "--output-dir", d])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert bad in err and "Traceback" not in err
+    # The -c/-d conversion path names the file too.
+    rc = main(["-d", bad])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert bad in err and "Traceback" not in err
+
+
 def test_help_exits_zero():
     with pytest.raises(SystemExit) as e:
         main(["--help"])

@@ -1,0 +1,207 @@
+"""Hung-dispatch deadline tests: DispatchTimeout within the budget,
+retry with exponential backoff, and degradation to the host-fallback
+path with the same first hit (the acceptance property)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from planted import build_planted_lut5_small, verify_lut5_result
+from sboxgates_tpu.resilience import faults
+from sboxgates_tpu.resilience.deadline import (
+    DeadlineConfig,
+    DispatchTimeout,
+    dispatch_with_retry,
+    run_with_deadline,
+)
+from sboxgates_tpu.resilience.faults import InjectedFault
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search import lut as slut
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def test_run_with_deadline_passthrough_and_timeout():
+    assert run_with_deadline(lambda: 42, 1.0) == 42
+    assert run_with_deadline(lambda: 42, 0.0) == 42  # disabled: inline
+    t0 = time.monotonic()
+    with pytest.raises(DispatchTimeout):
+        run_with_deadline(lambda: time.sleep(30), 0.2, label="t")
+    assert time.monotonic() - t0 < 5.0  # raised within the budget, not 30s
+    with pytest.raises(ZeroDivisionError):  # worker errors propagate
+        run_with_deadline(lambda: 1 // 0, 1.0)
+
+
+def test_dispatch_with_retry_recovers_after_transient_hang():
+    """A hang on the FIRST attempt only (a transient stall): one breach,
+    one retry, then success — with the re-issue hook invoked."""
+    faults.arm("dispatch.sweep", "hang", "1")  # exactly hit 1
+    calls = []
+    stats = {}
+    out = dispatch_with_retry(
+        lambda: "ok",
+        DeadlineConfig(budget_s=0.2, retries=2, backoff_s=0.01),
+        stats=stats,
+        on_retry=lambda: calls.append("reissue"),
+    )
+    assert out == "ok"
+    assert stats["deadline_breaches"] == 1
+    assert stats["dispatch_retries"] == 1
+    assert calls == ["reissue"]
+
+
+def test_dispatch_with_retry_backoff_and_exhaustion():
+    faults.arm("dispatch.sweep", "hang")  # every attempt hangs
+    stats = {}
+    t0 = time.monotonic()
+    with pytest.raises(DispatchTimeout):
+        dispatch_with_retry(
+            lambda: "never",
+            DeadlineConfig(budget_s=0.1, retries=2, backoff_s=0.05),
+            stats=stats,
+        )
+    dt = time.monotonic() - t0
+    assert stats["deadline_breaches"] == 3  # initial + 2 retries
+    assert stats["dispatch_retries"] == 2
+    # 3 budgets + backoffs 0.05 + 0.10: the exponential schedule ran.
+    assert dt >= 0.1 * 3 + 0.05 + 0.10 - 0.02
+
+
+def test_disabled_config_is_inline_and_fault_site_still_fires():
+    faults.arm("dispatch.sweep", "raise")
+    with pytest.raises(InjectedFault):
+        dispatch_with_retry(lambda: "x", None)
+    with pytest.raises(InjectedFault):
+        dispatch_with_retry(lambda: "x", DeadlineConfig(budget_s=0))
+
+
+def test_hung_sweep_degrades_to_host_fallback_same_first_hit():
+    """Acceptance: an injected hang in a device sweep dispatch raises
+    DispatchTimeout within the configured budget, retries with backoff,
+    then completes via the host-fallback path with the same first hit."""
+    st, target, mask = build_planted_lut5_small()
+
+    ref_ctx = SearchContext(Options(seed=1, lut_graph=True, randomize=False))
+    ref = slut.lut5_search(ref_ctx, st, target, mask, [])
+    assert ref is not None
+
+    ctx = SearchContext(
+        Options(seed=1, lut_graph=True, randomize=False,
+                dispatch_timeout_s=0.3)
+    )
+    ctx.deadline_cfg.retries = 2
+    ctx.deadline_cfg.backoff_s = 0.05
+    faults.arm("dispatch.sweep", "hang")
+    t0 = time.monotonic()
+    try:
+        res = slut.lut5_search(ctx, st, target, mask, [])
+    finally:
+        faults.disarm()
+    # Bounded (vs the eternal hang without the guard): generous margin —
+    # the window includes host-fallback jit compiles under CI load.
+    assert time.monotonic() - t0 < 120.0
+    assert ctx.stats["deadline_breaches"] == 3
+    assert ctx.stats["dispatch_retries"] == 2
+    assert res == ref  # same first hit as the unfaulted device stream
+    assert verify_lut5_result(st, target, mask, res)
+    # Circuit breaker: the exhausted retry schedule trips the context, so
+    # the NEXT search routes straight to the host driver — no fresh
+    # budget*(retries+1) stall per node against a known-dead device.
+    assert ctx.device_degraded
+    faults.arm("dispatch.sweep", "hang")  # device path would hang again
+    try:
+        t0 = time.monotonic()
+        res2 = slut.lut5_search(ctx, st, target, mask, [])
+    finally:
+        faults.disarm()
+    assert res2 == ref
+    assert ctx.stats["deadline_breaches"] == 3  # no new breaches
+
+
+def test_host_sync_deadline_fails_loudly_not_forever():
+    """The host-fallback drivers' verdict syncs run under a deadline-only
+    guard (no retry, no fault site): a dead device surfaces as a loud
+    DispatchTimeout instead of an eternal hang — and the guard never
+    re-enters the dispatch.sweep site it degrades away from."""
+    ctx = SearchContext(Options(dispatch_timeout_s=0.1))
+    ctx.deadline_cfg.retries = 1
+    faults.arm("dispatch.sweep", "raise")  # must NOT fire on this path
+    try:
+        assert ctx.host_sync_deadline(lambda: 5, "host") == 5
+        t0 = time.monotonic()
+        with pytest.raises(DispatchTimeout):
+            ctx.host_sync_deadline(lambda: time.sleep(30), "host")
+        # One window of the whole retry schedule's budget: 0.1 * (1+1).
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        faults.disarm()
+    # Disabled config: inline call, no threads.
+    assert SearchContext(Options()).host_sync_deadline(lambda: 7, "h") == 7
+
+
+def test_options_timeout_reaches_context_config():
+    ctx = SearchContext(Options(dispatch_timeout_s=12.5))
+    assert ctx.deadline_cfg.budget_s == 12.5
+    assert ctx.deadline_cfg.enabled
+    ctx2 = SearchContext(Options())
+    assert not ctx2.deadline_cfg.enabled  # default: off
+
+
+def test_guarded_dispatch_counts_into_ctx_stats():
+    ctx = SearchContext(Options(dispatch_timeout_s=0.1))
+    ctx.deadline_cfg.retries = 1
+    ctx.deadline_cfg.backoff_s = 0.01
+    with pytest.raises(DispatchTimeout):
+        ctx.guarded_dispatch(lambda: time.sleep(10), "test")
+    assert ctx.stats["deadline_breaches"] == 2
+    assert ctx.stats["dispatch_retries"] == 1
+    # The counters ride the normal stats channel (bench.py reports them
+    # alongside the sync/compile guard tallies).
+    assert "deadline_breaches" in SearchContext(Options()).stats
+
+
+def test_lut7_device_timeout_degrades_to_host_chunks():
+    """7-LUT stage A: a hung feasible-stream dispatch degrades to the
+    host-chunked driver with an identical hit list."""
+    rng = np.random.default_rng(3)
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+
+    st = State.init_inputs(8)
+    while st.num_gates < 12:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    outer = tt.eval_lut(0x96, st.table(1), st.table(3), st.table(9))
+    middle = tt.eval_lut(0xE8, st.table(2), st.table(5), st.table(10))
+    target = tt.eval_lut(0xCA, outer, middle, st.table(7))
+    mask = tt.mask_table(8)
+
+    ref_ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+    ref = slut._lut7_collect_hits(ref_ctx, st, target, mask, [])
+
+    ctx = SearchContext(
+        Options(seed=2, lut_graph=True, randomize=False,
+                dispatch_timeout_s=0.3)
+    )
+    ctx.deadline_cfg.retries = 1
+    ctx.deadline_cfg.backoff_s = 0.01
+    faults.arm("dispatch.sweep", "hang")
+    try:
+        got = slut._lut7_collect_hits(ctx, st, target, mask, [])
+    finally:
+        faults.disarm()
+    assert ctx.stats["deadline_breaches"] >= 2
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+    # The abandoned device windows' candidate tally was backed out, so
+    # the degraded run's accounting matches the reference sweep's.
+    assert (
+        ctx.stats["lut7_candidates"] == ref_ctx.stats["lut7_candidates"]
+    )

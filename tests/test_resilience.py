@@ -1,0 +1,412 @@
+"""Resilience subsystem tests: durable checkpoints, fault injection,
+torn-file recovery, journal mechanics, and the ChunkPrefetcher
+double-fault contract.
+
+The crash-action tests run a tiny no-jax subprocess (sboxgates_tpu's
+package init is import-light), so a real ``os._exit`` mid-write proves
+the on-disk guarantee: the complete old file or the complete new file,
+never a torn checkpoint.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.graph.state import GATES, State
+from sboxgates_tpu.graph.xmlio import (
+    StateLoadError,
+    load_state,
+    save_state,
+    state_filename,
+    state_to_xml,
+)
+from sboxgates_tpu.resilience import faults
+from sboxgates_tpu.resilience.checkpoint import (
+    TMP_PREFIX,
+    clean_stale_tmp,
+    latest_valid_state,
+    with_digest,
+)
+from sboxgates_tpu.resilience.faults import InjectedFault
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def small_state(n_extra=2, seed=0):
+    rng = np.random.default_rng(seed)
+    st = State.init_inputs(3)
+    for _ in range(n_extra):
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    st.outputs[0] = st.num_gates - 1
+    return st
+
+
+# -- fault-injection registry ---------------------------------------------
+
+
+def test_fault_spec_parsing():
+    specs = faults.parse_spec("a.b:raise@3,c.d:hang@2+, e.f:crash ")
+    assert specs["a.b"].action == "raise" and specs["a.b"].first == 3
+    assert specs["a.b"].once
+    assert specs["c.d"].action == "hang" and not specs["c.d"].once
+    assert specs["e.f"].action == "crash" and specs["e.f"].first == 1
+    for bad in ("x", "a:nosuch", "a:raise@0", "a:raise@x", "a:raise:b"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_fault_point_once_vs_onward():
+    faults.arm("t.once", "raise", "2")
+    faults.fault_point("t.once")  # hit 1: silent
+    with pytest.raises(InjectedFault):
+        faults.fault_point("t.once")  # hit 2: fires
+    faults.fault_point("t.once")  # hit 3: silent again (once)
+    faults.arm("t.onward", "raise", "2+")
+    faults.fault_point("t.onward")
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            faults.fault_point("t.onward")
+    assert faults.hit_count("t.onward") == 4
+
+
+def test_unarmed_fault_point_is_free():
+    faults.fault_point("never.armed")  # no spec: no-op, no error
+
+
+# -- durable checkpoint writes --------------------------------------------
+
+
+def test_save_state_writes_digest_and_roundtrips(tmp_path):
+    st = small_state()
+    path = save_state(st, str(tmp_path))
+    raw = open(path).read()
+    assert "sbg:sha256=" in raw
+    st2 = load_state(path)
+    assert state_to_xml(st2) == state_to_xml(st)
+    assert not [
+        f for f in os.listdir(tmp_path) if f.startswith(TMP_PREFIX)
+    ], "temp file leaked"
+    # The atomic write must publish umask-governed permissions, not
+    # mkstemp's 0600 (peers and the reference tool read these files).
+    umask = os.umask(0)
+    os.umask(umask)
+    assert os.stat(path).st_mode & 0o777 == 0o666 & ~umask
+
+
+def test_load_state_rejects_torn_and_corrupt(tmp_path):
+    st = small_state()
+    path = save_state(st, str(tmp_path))
+    raw = open(path).read()
+    # corrupted body under a recorded digest
+    open(path, "w").write(raw.replace('type="XOR"', 'type="AND"'))
+    with pytest.raises(StateLoadError):
+        load_state(path)
+    # truncated mid-file (digest comment gone entirely)
+    open(path, "w").write(raw[: len(raw) // 2])
+    with pytest.raises(StateLoadError):
+        load_state(path)
+
+
+def test_reference_format_files_still_load(tmp_path):
+    # A digest-less file (what the reference binary writes) passes the
+    # structural validation unchanged.
+    st = small_state()
+    p = tmp_path / "ref.xml"
+    p.write_text(state_to_xml(st))
+    st2 = load_state(str(p))
+    assert state_to_xml(st2) == state_to_xml(st)
+
+
+def _crash_script(site: str) -> str:
+    return textwrap.dedent(
+        f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import numpy as np
+        from sboxgates_tpu.core import boolfunc as bf
+        from sboxgates_tpu.graph.state import GATES, State
+        from sboxgates_tpu.graph.xmlio import save_state
+
+        rng = np.random.default_rng(0)
+        st = State.init_inputs(3)
+        for _ in range(2):
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(bf.XOR, int(a), int(b), GATES)
+        st.outputs[0] = st.num_gates - 1
+        save_state(st, sys.argv[1])          # first write: completes
+        st.outputs[1] = st.num_gates - 2     # new content, same round-trip
+        save_state(st, sys.argv[1])          # second write: dies mid-way
+        """
+    )
+
+
+@pytest.mark.parametrize("site", ["ckpt.write", "ckpt.replace"])
+def test_crash_during_save_never_tears_a_checkpoint(tmp_path, site):
+    """Acceptance: a crash at any registered fault site during save_state
+    leaves either the complete old file or the complete new file —
+    digest-verified — and latest_valid_state recovers the newest intact
+    one."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _crash_script(site), str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "SBG_FAULTS": f"{site}:crash@2"},
+    )
+    assert proc.returncode == faults.CRASH_EXIT_CODE, proc.stderr
+    # Every surviving .xml is complete and digest-valid.
+    xmls = [f for f in os.listdir(tmp_path) if f.endswith(".xml")]
+    assert xmls, "first checkpoint vanished"
+    for f in xmls:
+        load_state(str(tmp_path / f))  # raises on a torn file
+    got = latest_valid_state(str(tmp_path))
+    assert got is not None
+    # The second write died before (or during) publication: the first
+    # checkpoint is the newest intact state.
+    _, st = got
+    assert st.outputs[1] == 0xFFFF  # NO_GATE: new content never landed
+    # A crash mid-write strands a temp file; resume-time cleanup removes
+    # it (and only it).
+    stranded = [f for f in os.listdir(tmp_path) if f.startswith(TMP_PREFIX)]
+    if site == "ckpt.write":
+        assert stranded
+    removed = clean_stale_tmp(str(tmp_path))
+    assert removed == len(stranded)
+    assert not [
+        f for f in os.listdir(tmp_path) if f.startswith(TMP_PREFIX)
+    ]
+
+
+def test_latest_valid_state_skips_corrupt_newest(tmp_path):
+    st = small_state()
+    good = save_state(st, str(tmp_path))
+    bad = tmp_path / "9-999-9999-0-deadbeef.xml"
+    bad.write_text(with_digest(state_to_xml(st))[:40])  # torn
+    os.utime(good, (1, 1))  # make the torn file strictly newest
+    path, recovered = latest_valid_state(str(tmp_path))
+    assert path == good
+    assert state_to_xml(recovered) == state_to_xml(st)
+
+
+def test_latest_valid_state_empty_dir(tmp_path):
+    assert latest_valid_state(str(tmp_path)) is None
+
+
+# -- journal mechanics -----------------------------------------------------
+
+
+def test_journal_append_snapshot_and_torn_tail(tmp_path):
+    from sboxgates_tpu.resilience.journal import (
+        JOURNAL_NAME,
+        SearchJournal,
+    )
+
+    j = SearchJournal.start(str(tmp_path), config={"seed": 7})
+    j.append("round_done", round=1, beam=["a.xml"], rng={"bg": {}, "seed_buf": []})
+    j.append("round_done", round=2, beam=["b.xml"], rng={"bg": {}, "seed_buf": []})
+    # Simulate a torn tail: a crashed append leaves half a record with no
+    # trailing newline.
+    with open(tmp_path / JOURNAL_NAME, "a") as f:
+        f.write('{"seq": 3, "type": "round_do')
+    j2 = SearchJournal.resume(str(tmp_path))
+    assert [r["type"] for r in j2.records] == [
+        "run_start", "round_done", "round_done",
+    ]
+    assert j2.last("round_done")["round"] == 2
+    assert j2.config == {"seed": 7}
+    assert not j2.complete
+    # resume() truncated the torn fragment, so post-resume appends never
+    # weld onto garbage: a THIRD resume still sees every record.
+    j2.append("round_done", round=3, beam=["c.xml"], rng={"bg": {}, "seed_buf": []})
+    j3 = SearchJournal.resume(str(tmp_path))
+    assert [r.get("round") for r in j3.of_type("round_done")] == [1, 2, 3]
+    # The JSONL gone entirely: the atomic snapshot fallback restores a
+    # valid PREFIX (it rides run boundaries + every SNAPSHOT_EVERY
+    # appends, and resuming from an earlier record just re-runs those
+    # units deterministically).
+    os.unlink(tmp_path / JOURNAL_NAME)
+    j4 = SearchJournal.resume(str(tmp_path))
+    assert j4.records[0]["type"] == "run_start"
+    assert [r["type"] for r in j4.records] == [
+        r["type"] for r in j3.records[: len(j4.records)]
+    ]
+
+
+def test_journal_run_done_snapshots_everything(tmp_path):
+    from sboxgates_tpu.resilience.journal import JOURNAL_NAME, SearchJournal
+
+    j = SearchJournal.start(str(tmp_path), config={})
+    j.append("round_done", round=1, beam=[], rng={"bg": {}, "seed_buf": []})
+    j.append("run_done", beam=[])
+    os.unlink(tmp_path / JOURNAL_NAME)
+    # run boundaries always refresh the snapshot: nothing lost.
+    j2 = SearchJournal.resume(str(tmp_path))
+    assert [r["type"] for r in j2.records] == [
+        "run_start", "round_done", "run_done",
+    ]
+    assert j2.complete
+
+
+def test_journal_start_drops_previous_snapshot(tmp_path):
+    """A new run owns the directory: even if it dies before its
+    run_start is durable, the OLD run's snapshot must not be silently
+    resurrected by the next resume."""
+    from sboxgates_tpu.resilience.journal import (
+        JOURNAL_NAME,
+        SNAPSHOT_NAME,
+        JournalError,
+        SearchJournal,
+    )
+
+    j = SearchJournal.start(str(tmp_path), config={"run": "A"})
+    j.append("run_done", beam=[])
+    assert os.path.exists(tmp_path / SNAPSHOT_NAME)
+    # Run B starts and crashes between the snapshot removal / JSONL
+    # truncation and the run_start append: simulate by doing what
+    # start() does up to that point.
+    os.unlink(tmp_path / SNAPSHOT_NAME)
+    open(tmp_path / JOURNAL_NAME, "w").close()
+    with pytest.raises(JournalError):
+        SearchJournal.resume(str(tmp_path))  # run A must NOT come back
+
+
+def test_journal_readonly_restores_but_never_writes(tmp_path):
+    from sboxgates_tpu.resilience.journal import JOURNAL_NAME, SearchJournal
+
+    j = SearchJournal.start(str(tmp_path), config={"seed": 1})
+    j.append("round_done", round=1, beam=[], rng={"bg": {}, "seed_buf": []})
+    before = open(tmp_path / JOURNAL_NAME).read()
+    ro = SearchJournal.resume(str(tmp_path), readonly=True)
+    assert ro.readonly and not ro.writable
+    assert ro.last("round_done")["round"] == 1  # restore works
+    ro.append("round_done", round=2, beam=[], rng={})  # dropped
+    assert open(tmp_path / JOURNAL_NAME).read() == before
+    assert ro.last("round_done")["round"] == 1
+
+
+def test_journal_resume_requires_run_start(tmp_path):
+    from sboxgates_tpu.resilience.journal import JournalError, SearchJournal
+
+    with pytest.raises(JournalError):
+        SearchJournal.resume(str(tmp_path))
+
+
+def test_rng_snapshot_restore_exact():
+    """The snapshot must capture the seed-buffer tail, not just the
+    bit-generator: next_seed() draws in 256-entry batches."""
+    from sboxgates_tpu.search import Options, SearchContext
+
+    ctx = SearchContext(Options(seed=42))
+    for _ in range(5):
+        ctx.next_seed()
+    snap = json.loads(json.dumps(ctx.rng_snapshot()))  # JSON round-trip
+    expect = [ctx.next_seed() for _ in range(300)]  # crosses a refill
+    expect_host = ctx.rng.integers(0, 1 << 31)
+
+    ctx2 = SearchContext(Options(seed=999))  # different seed on purpose
+    ctx2.rng_restore(snap)
+    got = [ctx2.next_seed() for _ in range(300)]
+    assert got == expect
+    assert ctx2.rng.integers(0, 1 << 31) == expect_host
+
+
+def test_journal_seq_check_detects_desync(monkeypatch):
+    """Multi-host resume validation: a process whose round counter
+    disagrees with the primary's broadcast fails loudly at the host
+    barrier (simulated 2-process run via monkeypatched collectives)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from sboxgates_tpu.parallel import distributed as dist
+
+    # Single process: no-op, no collective.
+    dist.journal_seq_check(3, 4)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils,
+        "broadcast_one_to_all",
+        lambda x: np.asarray([5, 9], dtype=np.int64),
+    )
+    dist.journal_seq_check(5, 9)  # rounds agree: fine
+    dist.journal_seq_check(5, None)  # non-primary (no journal): fine
+    with pytest.raises(RuntimeError, match="desync"):
+        dist.journal_seq_check(3, 4)
+
+
+# -- ChunkPrefetcher double-fault contract --------------------------------
+
+
+class _FailingStream:
+    """CombinationStream stand-in whose second chunk raises."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def next_chunk(self, chunk):
+        self.calls += 1
+        if self.calls >= 2:
+            raise RuntimeError("producer blew up")
+        return np.zeros((chunk, 5), dtype=np.int32)
+
+
+def test_prefetcher_producer_fault_does_not_mask_consumer_fault():
+    """The documented double-fault contract (ops/combinatorics.py): a
+    pending producer exception must NOT mask an in-flight consumer
+    exception on __exit__/close."""
+    from sboxgates_tpu.ops.combinatorics import ChunkPrefetcher
+
+    pf = ChunkPrefetcher(_FailingStream(), 4, depth=2)
+    with pytest.raises(ValueError, match="consumer failed"):
+        with pf:
+            item = pf.get()  # first chunk arrives fine
+            assert item is not None
+            # Producer has (or will) put its failure in the queue; the
+            # consumer now dies of its own, unrelated error.
+            raise ValueError("consumer failed")
+    assert pf.closed  # __exit__ joined the worker despite the pending exc
+
+
+def test_prefetcher_producer_fault_surfaces_at_the_failed_chunk():
+    from sboxgates_tpu.ops.combinatorics import ChunkPrefetcher
+
+    with ChunkPrefetcher(_FailingStream(), 4, depth=2) as pf:
+        assert pf.get() is not None
+        with pytest.raises(RuntimeError, match="producer blew up"):
+            pf.get()
+    assert pf.closed
+
+
+def test_prefetcher_injected_fault_site():
+    """prefetch.produce is a registered site: a raise there surfaces
+    through the consumer's get(), in both threaded and inline modes."""
+    from sboxgates_tpu.ops.combinatorics import (
+        ChunkPrefetcher,
+        CombinationStream,
+    )
+
+    for depth in (2, 1):
+        faults.arm("prefetch.produce", "raise", "2")
+        try:
+            with ChunkPrefetcher(
+                CombinationStream(10, 3), 16, depth=depth
+            ) as pf:
+                assert pf.get() is not None
+                with pytest.raises(InjectedFault):
+                    pf.get()
+        finally:
+            faults.disarm()

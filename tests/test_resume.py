@@ -1,0 +1,268 @@
+"""Kill→resume property tests: a search killed at an arbitrary point and
+restarted with --resume-run produces final circuits **bit-identical** to
+an uninterrupted run with the same seed.
+
+Tier-1 variant: three injected kill points — during a checkpoint write
+(``ckpt.write``), between beam rounds (``search.round``), and mid-round
+inside the node stream (``search.node``) — interrupted in-process via the
+``raise`` fault action (same on-disk journal/checkpoint state as a crash,
+without a fresh interpreter + jax import per case).  The full kill-point
+matrix, with REAL ``os._exit`` crashes through the CLI subprocess, is
+marked ``slow``.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sboxgates_tpu.cli import main
+from sboxgates_tpu.resilience import faults
+from sboxgates_tpu.resilience.faults import InjectedFault
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(ROOT, "tests", "data")
+DES = os.path.join(DATA, "des_s1.txt")
+FA = os.path.join(DATA, "crypto1_fa.txt")
+SEED = "11"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def xml_digests(d):
+    """{filename: sha256} of every checkpoint in a run directory."""
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(d, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(d))
+        if f.endswith(".xml")
+    }
+
+
+@pytest.fixture(scope="module")
+def uninterrupted(tmp_path_factory):
+    """One full-graph DES S1 run (4 beam rounds) — the bit-identity
+    reference for every kill point."""
+    d = str(tmp_path_factory.mktemp("des_ok"))
+    assert main([DES, "--seed", SEED, "--output-dir", d]) == 0
+    digests = xml_digests(d)
+    assert digests, "reference run produced no checkpoints"
+    return digests
+
+
+# Kill points (site, hit): mid-checkpoint-write in round 2, between
+# rounds 2 and 3, and mid-round-2 in the node stream.
+KILL_POINTS = [
+    ("ckpt.write", "3"),
+    ("search.round", "2"),
+    ("search.node", "7"),
+]
+
+
+@pytest.mark.parametrize("site,when", KILL_POINTS)
+def test_killed_search_resumes_bit_identical(
+    tmp_path, uninterrupted, site, when
+):
+    d = str(tmp_path)
+    faults.arm(site, "raise", when)
+    try:
+        with pytest.raises(InjectedFault):
+            main([DES, "--seed", SEED, "--output-dir", d])
+    finally:
+        faults.disarm()
+    # The interrupted run must have stopped short of the full result.
+    assert xml_digests(d).keys() != uninterrupted.keys() or site == "search.round"
+    assert main(["--resume-run", d]) == 0
+    assert xml_digests(d) == uninterrupted
+    # Resuming the now-complete run is a no-op that exits 0.
+    assert main(["--resume-run", d]) == 0
+
+
+def test_one_output_driver_resumes_bit_identical(tmp_path):
+    """Iteration-granular journal of generate_graph_one_output: kill in
+    iteration 2's checkpoint write, resume, compare to uninterrupted."""
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    argv = [DES, "-o", "0", "-i", "2", "--seed", SEED]
+    assert main(argv + ["--output-dir", ok]) == 0
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    faults.arm("ckpt.write", "raise", "2")
+    try:
+        with pytest.raises(InjectedFault):
+            main(argv + ["--output-dir", killed])
+    finally:
+        faults.disarm()
+    assert main(["--resume-run", killed]) == 0
+    assert xml_digests(killed) == xml_digests(ok)
+
+
+@pytest.mark.slow
+def test_multibox_sweep_resumes_bit_identical(tmp_path):
+    """Round-granular journal of the multibox lockstep driver.  Slow
+    tier: the tier-1 kill points cover the single-box drivers and the
+    journal machinery is shared; this adds the mb_round_done restore
+    path over two boxes (one of them the full DES beam search)."""
+
+    def digests(root):
+        out = {}
+        for sub in sorted(os.listdir(root)):
+            p = os.path.join(root, sub)
+            if os.path.isdir(p):
+                out[sub] = xml_digests(p)
+        return out
+
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    argv = [DES, FA, "--seed", SEED]
+    assert main(argv + ["--output-dir", ok]) == 0
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    faults.arm("search.round", "raise", "1")
+    try:
+        with pytest.raises(InjectedFault):
+            main(argv + ["--output-dir", killed])
+    finally:
+        faults.disarm()
+    assert main(["--resume-run", killed]) == 0
+    assert digests(killed) == digests(ok)
+
+
+def test_fresh_run_truncates_stale_journal(tmp_path):
+    """A NEW run into a directory owns it: the old journal must not leak
+    resume state into the fresh search."""
+    d = str(tmp_path)
+    assert main([FA, "--seed", "5", "--output-dir", d]) == 0
+    first = xml_digests(d)
+    assert main([FA, "--seed", "5", "--output-dir", d]) == 0
+    assert xml_digests(d) == first
+
+
+def test_resume_run_without_journal_errors(tmp_path, capsys):
+    rc = main(["--resume-run", str(tmp_path)])
+    assert rc != 0
+    assert "journal" in capsys.readouterr().err
+
+
+def test_resume_run_rejects_incompatible_journal(tmp_path, capsys):
+    """Version mismatch or a missing recorded setting is a one-line
+    error, not a KeyError traceback."""
+    import json
+
+    from sboxgates_tpu.resilience.journal import JOURNAL_NAME
+
+    d = str(tmp_path)
+    assert main([FA, "--seed", "5", "--output-dir", d]) == 0
+    path = os.path.join(d, JOURNAL_NAME)
+    recs = [json.loads(line) for line in open(path)]
+    recs[0]["version"] = 999
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    capsys.readouterr()
+    assert main(["--resume-run", d]) != 0
+    assert "version" in capsys.readouterr().err
+    recs[0]["version"] = 1
+    del recs[0]["config"]["pipeline_depth"]  # an "older build's" journal
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    assert main(["--resume-run", d]) != 0
+    assert "incompatible" in capsys.readouterr().err
+
+
+def test_resume_run_rejects_shard_sweep(tmp_path, capsys):
+    """Job-sharded sweeps restart instead of resuming; silently dropping
+    the journal would masquerade as a resume."""
+    d = str(tmp_path)
+    assert main([FA, "--seed", "5", "--output-dir", d]) == 0
+    capsys.readouterr()
+    rc = main(["--resume-run", d, "--shard-sweep"])
+    assert rc != 0
+    assert "--shard-sweep" in capsys.readouterr().err
+
+
+# -- full matrix: real crashes through the CLI subprocess (slow) ----------
+
+
+def _run_cli(argv, d, fault=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if fault:
+        env["SBG_FAULTS"] = fault
+    return subprocess.run(
+        [sys.executable, "-m", "sboxgates_tpu", *argv, "--output-dir", d],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+        env=env,
+        timeout=600,
+    )
+
+
+CRASH_MATRIX = [
+    ("ckpt.write", "1"),
+    ("ckpt.write", "4"),
+    ("ckpt.replace", "2"),
+    ("journal.append", "2"),
+    ("search.round", "1"),
+    ("search.round", "3"),
+    ("search.node", "3"),
+    ("search.node", "9"),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,when", CRASH_MATRIX)
+def test_crash_matrix_resumes_bit_identical(tmp_path, site, when):
+    """The acceptance property with REAL crashes (os._exit mid-write):
+    every site × hit combination resumes to the uninterrupted result."""
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    argv = [DES, "--seed", SEED]
+    r = _run_cli(argv, ok)
+    assert r.returncode == 0, r.stderr
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    r = _run_cli(argv, killed, fault=f"{site}:crash@{when}")
+    assert r.returncode == faults.CRASH_EXIT_CODE, (r.stdout, r.stderr)
+    r = subprocess.run(
+        [sys.executable, "-m", "sboxgates_tpu", "--resume-run", killed],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    assert xml_digests(killed) == xml_digests(ok)
+
+
+@pytest.mark.slow
+def test_crash_matrix_lut_mode(tmp_path):
+    """LUT-mode search killed mid-run (native-engine path, iteration-
+    granular one-output journal) resumes bit-identically too."""
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    argv = [DES, "-l", "-o", "0", "-i", "2", "--seed", SEED]
+    r = _run_cli(argv, ok)
+    assert r.returncode == 0, r.stderr
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    r = _run_cli(argv, killed, fault="search.node:crash@2")
+    assert r.returncode == faults.CRASH_EXIT_CODE, (r.stdout, r.stderr)
+    r = subprocess.run(
+        [sys.executable, "-m", "sboxgates_tpu", "--resume-run", killed],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    assert xml_digests(killed) == xml_digests(ok)
