@@ -4,9 +4,16 @@
 modes that silently erase streaming-search throughput: recompilation
 hazards (R1), host-device syncs inside hot loops (R2), tracer escapes
 (R3), lock-discipline violations in thread targets (R4), and swallowed
-exceptions (R5).  Configuration lives in ``[tool.jaxlint]`` in
-pyproject.toml; suppressions are inline
-``# jaxlint: ignore[RULE] reason`` comments (reason mandatory).
+exceptions (R5).  The whole-program pass (``whole_program`` in
+``[tool.jaxlint]``, or ``--whole-program``) parses every module once,
+resolves imports into a project symbol table, builds a call graph with
+thread-entry and jit-boundary roots, and runs the cross-module rules:
+R4x (lock aliasing + transitive thread reachability), R1x (static-arg
+tracking across modules), and R2x (interprocedural host-sync
+detection); ``--graph`` dumps the resolved graph as JSON.
+Configuration lives in ``[tool.jaxlint]`` in pyproject.toml;
+suppressions are inline ``# jaxlint: ignore[RULE] reason`` comments
+(reason mandatory).
 
 The runtime complements — :func:`sboxgates_tpu.utils.guards.recompile_guard`
 and :func:`sboxgates_tpu.utils.guards.sync_guard` — catch what a static
@@ -14,12 +21,15 @@ pass cannot see; the tier-1 gate (tests/test_jaxlint.py) holds the tree
 at zero unsuppressed findings.
 """
 
-from .config import ALL_RULES, JaxlintConfig, load_config
+from .config import ALL_RULES, CROSS_RULES, FILE_RULES, JaxlintConfig, load_config
 from .rules import RULE_DOCS, FileReport, Finding, lint_source
 from .cli import iter_python_files, lint_paths, main
+from .project import graph_json, lint_project
 
 __all__ = [
     "ALL_RULES",
+    "CROSS_RULES",
+    "FILE_RULES",
     "JaxlintConfig",
     "load_config",
     "RULE_DOCS",
@@ -29,4 +39,6 @@ __all__ = [
     "iter_python_files",
     "lint_paths",
     "main",
+    "graph_json",
+    "lint_project",
 ]

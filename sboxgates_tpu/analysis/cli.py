@@ -49,9 +49,15 @@ def lint_paths(
     config: Optional[JaxlintConfig] = None,
 ) -> List[FileReport]:
     """Library entry point: lint ``paths`` (default from config) and
-    return per-file reports."""
+    return per-file reports.  When the config enables ``whole_program``,
+    the cross-module pass (call graph + R1x/R2x/R4x) runs too, sharing
+    one parse per module with the per-file rules."""
     if config is None:
         config = load_config(paths[0] if paths else ".")
+    if config.whole_program:
+        from .project import lint_project
+
+        return lint_project(paths, config)
     scan = paths or config.paths
     reports: List[FileReport] = []
     for ap, rel in iter_python_files(config.root, scan, config):
@@ -114,6 +120,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
     )
+    wp = ap.add_mutually_exclusive_group()
+    wp.add_argument(
+        "--whole-program",
+        action="store_true",
+        default=None,
+        help="run the cross-module pass (call graph + R1x/R2x/R4x) even "
+        "if [tool.jaxlint] whole_program is off",
+    )
+    wp.add_argument(
+        "--no-whole-program",
+        action="store_true",
+        help="per-file rules only, ignoring [tool.jaxlint] whole_program",
+    )
+    ap.add_argument(
+        "--graph",
+        action="store_true",
+        help="dump the resolved call graph (functions, edges with "
+        "lock/loop context, thread and jit roots) as deterministic JSON "
+        "and exit",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -134,6 +160,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"jaxlint: unknown rule ids {bad}", file=sys.stderr)
             return 2
         config.rules = wanted
+    if args.whole_program:
+        config.whole_program = True
+    elif args.no_whole_program:
+        config.whole_program = False
+
+    if args.graph:
+        from .project import graph_json
+
+        json.dump(
+            graph_json(args.paths or None, config),
+            sys.stdout,
+            indent=1,
+            sort_keys=True,
+        )
+        print()
+        return 0
 
     reports = lint_paths(args.paths or None, config)
     findings, suppressed = _flatten(reports)

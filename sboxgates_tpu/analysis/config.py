@@ -15,7 +15,11 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+#: Per-file rules.
+FILE_RULES = ("R1", "R2", "R3", "R4", "R5")
+#: Cross-module rules (whole-program pass only).
+CROSS_RULES = ("R1x", "R2x", "R4x")
+ALL_RULES = FILE_RULES + CROSS_RULES
 
 #: Defaults mirror the committed pyproject table so API callers that never
 #: touch a pyproject (unit tests on fixture snippets) see the same rules.
@@ -31,10 +35,16 @@ class JaxlintConfig:
     """Resolved analyzer configuration.
 
     ``hot_modules``: glob patterns (posix, relative to the project root)
-    naming the modules where R2 (host-device sync inside a loop) applies.
-    ``rules``: enabled rule IDs.  ``exclude``: glob patterns skipped when
-    scanning directories.  ``paths``: default scan roots when the CLI is
-    invoked without positional paths.
+    naming the modules where R2/R2x (host-device sync inside a loop)
+    apply.  ``rules``: enabled rule IDs (per-file R1–R5 and cross-module
+    R1x/R2x/R4x).  ``exclude``: glob patterns skipped when scanning
+    directories.  ``paths``: default scan roots when the CLI is invoked
+    without positional paths.  ``whole_program``: run the cross-module
+    pass (call graph + R1x/R2x/R4x) by default.  ``thread_roots`` /
+    ``jit_roots``: per-rule root extras for the call graph — function
+    names ("Class.meth", "fn", or "pkg.mod:Class.meth") treated as
+    thread entries (R4x) / jit boundaries beyond the auto-detected
+    ``threading.Thread(target=...)`` and ``jax.jit`` sites.
     """
 
     hot_modules: List[str] = field(default_factory=lambda: list(DEFAULT_HOT_MODULES))
@@ -42,6 +52,9 @@ class JaxlintConfig:
     exclude: List[str] = field(default_factory=list)
     paths: List[str] = field(default_factory=lambda: ["sboxgates_tpu"])
     root: str = "."
+    whole_program: bool = False
+    thread_roots: List[str] = field(default_factory=list)
+    jit_roots: List[str] = field(default_factory=list)
 
     def is_hot(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
@@ -156,10 +169,15 @@ def load_config(start: str = ".") -> JaxlintConfig:
     cfg.root = os.path.dirname(pyproject)
     with open(pyproject, "r", encoding="utf-8") as f:
         table = _read_table(f.read(), "tool.jaxlint")
-    for key in ("hot_modules", "rules", "exclude", "paths"):
+    for key in (
+        "hot_modules", "rules", "exclude", "paths",
+        "thread_roots", "jit_roots",
+    ):
         val = table.get(key)
         if isinstance(val, list) and all(isinstance(x, str) for x in val):
             setattr(cfg, key, list(val))
+    if isinstance(table.get("whole_program"), bool):
+        cfg.whole_program = table["whole_program"]
     bad = [r for r in cfg.rules if r not in ALL_RULES]
     if bad:
         raise ValueError(

@@ -1,0 +1,376 @@
+"""Whole-program jaxlint: the cross-module rules R1x / R2x / R4x.
+
+The per-file pass (``rules.py``) is structurally blind to three hazard
+classes the ROADMAP tracked as known false negatives, all of which need
+a project view:
+
+R4x  **lock aliasing + transitive thread reachability.**  A mutation of
+     module-level mutable state is racy when any thread-entry root
+     (``threading.Thread(target=...)``, the ``ChunkPrefetcher``
+     producer, ``dispatch_with_retry`` workers, plus ``[tool.jaxlint]
+     thread_roots`` extras) reaches the mutating function through the
+     call graph with no dominating ``with <lock>`` on the path — where
+     the lock may live in another module, be re-exported, or arrive as
+     a parameter.  The canonical miss:
+     ``ops/combinatorics._native_stream_available`` mutating
+     ``_native_ok`` from the prefetch thread via
+     ``_work -> _produce_one -> next_chunk``.
+R1x  **cross-module static-arg tracking.**  Call sites of jitted
+     functions imported from elsewhere (or wrapped by ``jax.jit`` at
+     module scope) that pass an unhashable literal or a loop-varying
+     expression as a *static* argument — every distinct value is a full
+     recompile.
+R2x  **interprocedural host-sync detection.**  A helper that calls
+     ``block_until_ready`` / ``.item()`` / ``jax.device_get`` (etc.) is
+     itself sync-tainted, transitively; calling a tainted helper inside
+     a loop in a hot module stalls the dispatch pipeline exactly like
+     the direct sync R2 already flags.  A sync carrying a valid
+     ``# jaxlint: ignore[R2]``/``[R2x]`` suppression is acknowledged
+     and does not taint its callers.
+
+Every module is parsed exactly once: the per-file pass and the graph
+share the :class:`~.rules.FileAnalysis` cache.  Findings are
+deterministic (sorted traversal everywhere) and suppressible with the
+existing ``# jaxlint: ignore[RULE] reason`` syntax; the
+unused-suppression rule judges R1x/R2x/R4x markers only when this pass
+actually ran.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import ProjectGraph, bind_call_args, build_graph
+from .config import JaxlintConfig
+from .rules import (
+    _UNHASHABLE_NODES,
+    FileAnalysis,
+    FileReport,
+    analyze_file,
+    dotted,
+    finalize_report,
+)
+
+RawFinding = Tuple[str, int, int, str]  # (rule, line, col, message)
+
+
+def _short_path(keys: List[str], limit: int = 8) -> str:
+    """Human call-path 'a -> b -> c' from function keys, elided when long."""
+    names = [k.split(":", 1)[1] for k in keys]
+    if len(names) > limit:
+        names = names[:3] + ["..."] + names[-(limit - 4):]
+    return " -> ".join(names)
+
+
+# --------------------------------------------------------------------------
+# R4x — lock aliasing + transitive thread reachability
+
+
+def run_r4x(
+    graph: ProjectGraph,
+    skip_sites: Set[Tuple[str, int]],
+) -> Dict[str, List[RawFinding]]:
+    """``skip_sites``: (path, line) pairs where the per-file R4 already
+    fired (a direct thread-target mutation) — reported once, not twice."""
+    out: Dict[str, List[RawFinding]] = {}
+    reach = graph.unlocked_reachable()
+    for m in sorted(
+        graph.mutations,
+        key=lambda m: (m.path, m.line, m.col, m.state_name),
+    ):
+        path_to = reach.get(m.func)
+        if path_to is None:
+            continue
+        if (m.path, m.line) in skip_sites:
+            continue
+        fi = graph.functions[m.func]
+        if graph.stack_holds_lock(fi.module, m.func, m.with_stack):
+            continue
+        root = path_to[0].split(":", 1)[1]
+        via = _short_path(path_to)
+        owner = (
+            "its own module's state"
+            if m.state_module == fi.module
+            else f"state owned by '{m.state_module}'"
+        )
+        out.setdefault(m.path, []).append(
+            (
+                "R4x",
+                m.line,
+                m.col,
+                f"module state {m.what} ({owner}) is mutated on an "
+                f"unlocked path reachable from thread entry '{root}' "
+                f"(via {via}) — guard the mutation with the owning "
+                "module's Lock (imported/aliased/parameter locks count)",
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# R1x — cross-module static-arg tracking
+
+
+def run_r1x(graph: ProjectGraph) -> Dict[str, List[RawFinding]]:
+    out: Dict[str, List[RawFinding]] = {}
+    seen: Set[Tuple[str, int, int, str]] = set()
+    for e in sorted(
+        graph.edges,
+        key=lambda e: (e.path, e.line, e.col, e.caller, e.callee),
+    ):
+        if e.call is None or e.via != "direct":
+            continue
+        caller = graph.functions.get(e.caller)
+        if caller is None:
+            continue
+        name = _call_name(e.call)
+        if name is None:
+            continue
+        got = graph.jit_statics_for(caller.module, name)
+        if got is None:
+            continue
+        callee, statics = got
+        # The per-file R1 already checks bare-name calls of functions
+        # jit-DECORATED in the same module; don't double-report those.
+        if (
+            callee.module == caller.module
+            and callee.jit_decorated
+            and isinstance(e.call.func, ast.Name)
+        ):
+            continue
+        loop_vars = set(e.loop_vars)
+        for pname, expr in bind_call_args(callee, e.call):
+            if pname not in statics:
+                continue
+            where = f"jitted '{callee.qualname}' (from {callee.module})"
+            if isinstance(expr, _UNHASHABLE_NODES):
+                key = (e.path, expr.lineno, expr.col_offset, pname)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.setdefault(e.path, []).append(
+                    (
+                        "R1x",
+                        expr.lineno,
+                        expr.col_offset,
+                        f"unhashable literal passed as static argument "
+                        f"'{pname}' of {where}: jit static args must be "
+                        "hashable, and every new value recompiles",
+                    )
+                )
+            elif loop_vars and (_names_in(expr) & loop_vars):
+                key = (e.path, expr.lineno, expr.col_offset, pname)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.setdefault(e.path, []).append(
+                    (
+                        "R1x",
+                        expr.lineno,
+                        expr.col_offset,
+                        f"static argument '{pname}' of {where} varies "
+                        "with the enclosing loop variable: every "
+                        "iteration triggers a recompile — pass it traced "
+                        "or hoist it",
+                    )
+                )
+    return out
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# --------------------------------------------------------------------------
+# R2x — interprocedural host-sync detection
+
+
+def run_r2x(
+    graph: ProjectGraph,
+    hot_paths: Set[str],
+    acknowledged: Set[Tuple[str, int]],
+) -> Dict[str, List[RawFinding]]:
+    """``hot_paths``: relpaths where the loop-call check applies (the
+    same hot-module set R2 uses).  ``acknowledged``: sync sites carrying
+    a valid R2/R2x suppression — they don't taint."""
+    out: Dict[str, List[RawFinding]] = {}
+    taint = graph.sync_taint(acknowledged)
+    seen: Set[Tuple[str, int, int, str]] = set()
+    for e in sorted(
+        graph.edges,
+        key=lambda e: (e.path, e.line, e.col, e.caller, e.callee),
+    ):
+        if not e.in_loop or e.path not in hot_paths:
+            continue
+        witness = taint.get(e.callee)
+        if witness is None:
+            continue
+        callee = graph.functions.get(e.callee)
+        if callee is None:
+            continue
+        if e.callee == e.caller:
+            continue  # recursion: the direct sync is already R2's job
+        # The direct sync inside THIS function at THIS line is R2's
+        # territory; R2x is only about syncs hidden behind a call.
+        if witness.func == e.caller and witness.line == e.line:
+            continue
+        key = (e.path, e.line, e.col, e.callee)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.setdefault(e.path, []).append(
+            (
+                "R2x",
+                e.line,
+                e.col,
+                f"call to '{callee.qualname}' inside a loop in a hot "
+                f"module: it transitively performs a host-device sync "
+                f"({witness.desc} at {witness.path}:{witness.line}) — "
+                "every iteration stalls the dispatch pipeline; batch or "
+                "hoist the sync, or suppress with a reason if the sync "
+                "is the point",
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# whole-program driver
+
+
+def _acknowledged_sync_sites(
+    analyses: Sequence[FileAnalysis],
+) -> Set[Tuple[str, int]]:
+    """(path, line) pairs whose line carries a valid R2/R2x suppression
+    (standalone markers cover the following line, as in finalize)."""
+    ack: Set[Tuple[str, int]] = set()
+    for fa in analyses:
+        for s in fa.sups:
+            if not (s.rules & {"R2", "R2x"}):
+                continue
+            ack.add((fa.path, s.line))
+            if s.standalone:
+                ack.add((fa.path, s.line + 1))
+    return ack
+
+
+def analyze_project(
+    analyses: Sequence[FileAnalysis],
+    config: JaxlintConfig,
+) -> Tuple[List[FileReport], ProjectGraph]:
+    """Runs the cross-module rules over pre-analyzed files and returns
+    finalized per-file reports plus the resolved graph (for --graph)."""
+    trees = {
+        fa.path: fa.tree for fa in analyses if fa.tree is not None
+    }
+    graph = build_graph(
+        trees,
+        thread_root_config=config.thread_roots,
+        jit_root_config=config.jit_roots,
+    )
+
+    extra: Dict[str, List[RawFinding]] = {}
+    ran: Set[str] = set()
+    if "R4x" in config.rules:
+        ran.add("R4x")
+        skip = {
+            (fa.path, line)
+            for fa in analyses
+            for (rule, line, _c, _m) in fa.raw
+            if rule == "R4"
+        }
+        for path, items in run_r4x(graph, skip).items():
+            extra.setdefault(path, []).extend(items)
+    if "R1x" in config.rules:
+        ran.add("R1x")
+        for path, items in run_r1x(graph).items():
+            extra.setdefault(path, []).extend(items)
+    if "R2x" in config.rules:
+        ran.add("R2x")
+        hot_paths = {fa.path for fa in analyses if fa.hot}
+        ack = _acknowledged_sync_sites(analyses)
+        for path, items in run_r2x(graph, hot_paths, ack).items():
+            extra.setdefault(path, []).extend(items)
+        # A deliberate sync can be acknowledged AT ITS SOURCE with an
+        # R2x marker: the taint dies there for every caller.  Emit the
+        # acknowledged source as a (suppressed) finding so the marker
+        # counts as used instead of being reported stale, and so the
+        # baseline documents the acknowledged sync inventory.
+        sync_lines: Dict[Tuple[str, int], Tuple[int, str]] = {}
+        for s in graph.sync_sites:
+            key = (s.path, s.line)
+            if key not in sync_lines or (s.col, s.desc) < sync_lines[key]:
+                sync_lines[key] = (s.col, s.desc)
+        for fa in analyses:
+            for sup in fa.sups:
+                if "R2x" not in sup.rules:
+                    continue
+                lines = [sup.line]
+                if sup.standalone:
+                    lines.append(sup.line + 1)
+                for line in lines:
+                    hit = sync_lines.get((fa.path, line))
+                    if hit is not None:
+                        extra.setdefault(fa.path, []).append(
+                            (
+                                "R2x",
+                                line,
+                                hit[0],
+                                f"deliberate host sync at its source "
+                                f"({hit[1]}): acknowledged — callers are "
+                                "not sync-tainted by this site",
+                            )
+                        )
+                        break
+
+    reports: List[FileReport] = []
+    for fa in analyses:
+        # Every x-rule that ran is judged for stale markers — including
+        # R2x in non-hot files: loop-call findings can't land there, but
+        # acknowledged-source entries are emitted wherever a sync site
+        # carries a marker, so an R2x marker with no finding under it is
+        # genuinely stale (the acknowledged sync is gone) and the
+        # inline-ignore inventory must not accrete.
+        reports.append(
+            finalize_report(fa, extra.get(fa.path, ()), set(ran))
+        )
+    return reports, graph
+
+
+def lint_project(
+    paths: Optional[List[str]] = None,
+    config: Optional[JaxlintConfig] = None,
+    return_graph: bool = False,
+):
+    """Whole-program lint of ``paths`` (default: config paths): per-file
+    rules + R1x/R2x/R4x, one parse per module."""
+    from .cli import iter_python_files
+    from .config import load_config
+
+    if config is None:
+        config = load_config(paths[0] if paths else ".")
+    scan = paths or config.paths
+    analyses: List[FileAnalysis] = []
+    for ap, rel in iter_python_files(config.root, scan, config):
+        with open(ap, "r", encoding="utf-8") as f:
+            source = f.read()
+        analyses.append(analyze_file(source, rel, config))
+    reports, graph = analyze_project(analyses, config)
+    if return_graph:
+        return reports, graph
+    return reports
+
+
+def graph_json(
+    paths: Optional[List[str]] = None,
+    config: Optional[JaxlintConfig] = None,
+) -> dict:
+    """The resolved call graph + roots as a deterministic JSON dict
+    (the ``--graph`` CLI output)."""
+    _reports, graph = lint_project(paths, config, return_graph=True)
+    return graph.as_json()

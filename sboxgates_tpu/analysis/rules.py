@@ -36,7 +36,7 @@ import re
 import tokenize
 from dataclasses import dataclass, field
 from io import StringIO
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .config import JaxlintConfig
 
@@ -52,6 +52,19 @@ RULE_DOCS = {
     "R3": "tracer escape (self/global store or thread hand-off under jit trace)",
     "R4": "module state mutated in a thread target without its module lock",
     "R5": "except Exception/bare except that neither re-raises nor logs",
+    "R1x": (
+        "cross-module recompilation hazard (unhashable or loop-varying "
+        "static arg at a call site of a jitted function defined elsewhere)"
+    ),
+    "R2x": (
+        "interprocedural host sync: a hot-module loop calls a helper that "
+        "transitively blocks on the device"
+    ),
+    "R4x": (
+        "module state mutated on an unlocked path reachable from a thread "
+        "entry (transitive reachability; locks may be imported, "
+        "re-exported, or passed as parameters)"
+    ),
     SUPPRESSION_RULE: (
         "malformed or unused jaxlint suppression (reason is mandatory; a "
         "marker whose finding no longer fires is itself a finding)"
@@ -221,19 +234,26 @@ class _R1(ast.NodeVisitor):
     def visit_For(self, node: ast.For) -> None:
         self._loop_vars.append(_target_names(node.target))
         self._in_loop += 1
-        for child in node.body + node.orelse:
+        for child in node.body:
             self.visit(child)
         self._in_loop -= 1
         self._loop_vars.pop()
-        # iterable expression is evaluated once, outside the loop body
+        # the else: body and the iterable run once, outside the loop
+        for child in node.orelse:
+            self.visit(child)
         self.visit(node.iter)
 
     def visit_While(self, node: ast.While) -> None:
         self._loop_vars.append(set())
         self._in_loop += 1
-        self.generic_visit(node)
+        # the test re-evaluates every iteration: it IS loop context
+        self.visit(node.test)
+        for child in node.body:
+            self.visit(child)
         self._in_loop -= 1
         self._loop_vars.pop()
+        for child in node.orelse:
+            self.visit(child)
 
     def _all_loop_vars(self) -> Set[str]:
         out: Set[str] = set()
@@ -337,6 +357,28 @@ def _contains_jax_call(node: ast.AST) -> bool:
     return False
 
 
+def classify_sync(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, short description) when the call is a host-device sync
+    pattern, else None.  ONE classifier for the per-file R2 and the
+    cross-module R2x taint seeding — the two must never drift."""
+    name = dotted(node.func)
+    if name in _SYNC_FUNCS:
+        return "sync_func", f"{name}()"
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "block_until_ready":
+            return "block_until_ready", ".block_until_ready()"
+        if node.func.attr == "item" and not node.args:
+            return "item", ".item()"
+    if name in _ASARRAY_FUNCS and node.args:
+        if not _hosty_arg(node.args[0]):
+            return "asarray", f"{name}() on a possibly-device value"
+        return None
+    if name in ("int", "float") and len(node.args) == 1:
+        if _contains_jax_call(node.args[0]):
+            return "cast", f"{name}() around a jax/jnp call"
+    return None
+
+
 class _R2(ast.NodeVisitor):
     def __init__(self) -> None:
         self.findings: List[Tuple[int, int, str]] = []
@@ -344,15 +386,23 @@ class _R2(ast.NodeVisitor):
 
     def visit_For(self, node: ast.For) -> None:
         self._in_loop += 1
-        for child in node.body + node.orelse:
+        for child in node.body:
             self.visit(child)
         self._in_loop -= 1
+        # the else: body and the iterable run once, outside the loop
+        for child in node.orelse:
+            self.visit(child)
         self.visit(node.iter)
 
     def visit_While(self, node: ast.While) -> None:
         self._in_loop += 1
-        self.generic_visit(node)
+        # the test re-evaluates every iteration: it IS loop context
+        self.visit(node.test)
+        for child in node.body:
+            self.visit(child)
         self._in_loop -= 1
+        for child in node.orelse:
+            self.visit(child)
 
     def visit_Call(self, node: ast.Call) -> None:
         if self._in_loop:
@@ -363,47 +413,44 @@ class _R2(ast.NodeVisitor):
         self.findings.append((node.lineno, node.col_offset, msg))
 
     def _check(self, node: ast.Call) -> None:
+        got = classify_sync(node)
+        if got is None:
+            return
+        kind, _desc = got
         name = dotted(node.func)
-        if name in _SYNC_FUNCS:
+        if kind == "sync_func":
             self._flag(
                 node,
                 f"{name}() inside a loop in a hot module blocks on the "
                 "device every iteration — batch the transfer or move the "
                 "sync out of the loop",
             )
-            return
-        if isinstance(node.func, ast.Attribute):
-            if node.func.attr == "block_until_ready":
-                self._flag(
-                    node,
-                    ".block_until_ready() inside a loop in a hot module "
-                    "serializes host and device — sync once after the loop",
-                )
-                return
-            if node.func.attr == "item" and not node.args:
-                self._flag(
-                    node,
-                    ".item() inside a loop in a hot module is a scalar "
-                    "device->host transfer per iteration",
-                )
-                return
-        if name in _ASARRAY_FUNCS and node.args:
-            if not _hosty_arg(node.args[0]):
-                self._flag(
-                    node,
-                    f"{name}() on a possibly-device value inside a loop in "
-                    "a hot module forces a blocking device->host copy each "
-                    "iteration",
-                )
-            return
-        if name in ("int", "float") and len(node.args) == 1:
-            if _contains_jax_call(node.args[0]):
-                self._flag(
-                    node,
-                    f"{name}() wrapped around a jax/jnp call inside a loop "
-                    "is a per-iteration device sync — keep the reduction on "
-                    "device and convert once after the loop",
-                )
+        elif kind == "block_until_ready":
+            self._flag(
+                node,
+                ".block_until_ready() inside a loop in a hot module "
+                "serializes host and device — sync once after the loop",
+            )
+        elif kind == "item":
+            self._flag(
+                node,
+                ".item() inside a loop in a hot module is a scalar "
+                "device->host transfer per iteration",
+            )
+        elif kind == "asarray":
+            self._flag(
+                node,
+                f"{name}() on a possibly-device value inside a loop in "
+                "a hot module forces a blocking device->host copy each "
+                "iteration",
+            )
+        elif kind == "cast":
+            self._flag(
+                node,
+                f"{name}() wrapped around a jax/jnp call inside a loop "
+                "is a per-iteration device sync — keep the reduction on "
+                "device and convert once after the loop",
+            )
 
 
 # --------------------------------------------------------------------------
@@ -817,59 +864,107 @@ class FileReport:
     suppressed: List[Finding] = field(default_factory=list)
 
 
-def lint_source(
+@dataclass
+class FileAnalysis:
+    """One file's parse + per-file raw findings, before suppression
+    matching.  The whole-program pass (:mod:`.project`) reuses the
+    parsed ``tree`` and the scanned suppressions, appends its
+    cross-module raw findings, and finalizes — so each module is parsed
+    exactly once no matter how many passes run over it."""
+
+    path: str
+    source: str
+    tree: Optional[ast.Module]  # None on syntax error
+    hot: bool
+    #: (rule, line, col, message) from the per-file rules
+    raw: List[Tuple[str, int, int, str]] = field(default_factory=list)
+    sups: List["_Suppression"] = field(default_factory=list)
+    bad_sups: List[Tuple[int, int, str]] = field(default_factory=list)
+    #: rules whose absence of findings makes a marker provably stale
+    checked: Set[str] = field(default_factory=set)
+    #: set on syntax error; finalize short-circuits to this
+    parse_finding: Optional[Finding] = None
+
+
+def analyze_file(
     source: str,
     relpath: str,
     config: JaxlintConfig,
     hot: Optional[bool] = None,
-) -> FileReport:
-    """Lints one file's source.  ``hot`` overrides the config's hot-module
-    glob match (fixture tests exercise R2 on paths outside the configured
-    globs)."""
-    report = FileReport(path=relpath)
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        report.findings.append(
-            Finding(relpath, e.lineno or 1, 0, PARSE_RULE, f"syntax error: {e.msg}")
-        )
-        return report
-
-    raw: List[Tuple[str, int, int, str]] = []
+    tree: Optional[ast.Module] = None,
+) -> FileAnalysis:
+    """Parses (or reuses ``tree``) and runs the per-file rules, returning
+    the raw, un-suppressed analysis.  ``hot`` overrides the config's
+    hot-module glob match (fixture tests exercise R2 on paths outside
+    the configured globs)."""
+    is_hot = config.is_hot(relpath) if hot is None else hot
+    fa = FileAnalysis(path=relpath, source=source, tree=tree, hot=is_hot)
+    if fa.tree is None:
+        try:
+            fa.tree = ast.parse(source)
+        except SyntaxError as e:
+            fa.parse_finding = Finding(
+                relpath, e.lineno or 1, 0, PARSE_RULE,
+                f"syntax error: {e.msg}",
+            )
+            return fa
 
     if "R1" in config.rules:
         r1 = _R1()
-        r1.collect(tree)
-        r1.visit(tree)
-        raw += [("R1", *f) for f in r1.findings]
-    is_hot = config.is_hot(relpath) if hot is None else hot
+        r1.collect(fa.tree)
+        r1.visit(fa.tree)
+        fa.raw += [("R1", *f) for f in r1.findings]
     if "R2" in config.rules and is_hot:
         r2 = _R2()
-        r2.visit(tree)
-        raw += [("R2", *f) for f in r2.findings]
+        r2.visit(fa.tree)
+        fa.raw += [("R2", *f) for f in r2.findings]
     if "R3" in config.rules:
         r3 = _R3()
-        r3.run(tree)
-        raw += [("R3", *f) for f in r3.findings]
+        r3.run(fa.tree)
+        fa.raw += [("R3", *f) for f in r3.findings]
     if "R4" in config.rules:
         r4 = _R4()
-        r4.run(tree)
-        raw += [("R4", *f) for f in r4.findings]
+        r4.run(fa.tree)
+        fa.raw += [("R4", *f) for f in r4.findings]
     if "R5" in config.rules:
         r5 = _R5()
-        r5.visit(tree)
-        raw += [("R5", *f) for f in r5.findings]
+        r5.visit(fa.tree)
+        fa.raw += [("R5", *f) for f in r5.findings]
 
-    sups, bad_sups = scan_suppressions(source)
+    fa.sups, fa.bad_sups = scan_suppressions(source)
+    # Unused-suppression eligibility: only rules this scan actually
+    # executed count (R2 is skipped entirely in non-hot files, so its
+    # markers can't be judged there; cross-module rule markers are only
+    # judged when the whole-program pass runs and extends this set).
+    fa.checked = {r for r in config.rules if r in ("R1", "R3", "R4", "R5")}
+    if "R2" in config.rules and is_hot:
+        fa.checked.add("R2")
+    return fa
+
+
+def finalize_report(
+    fa: FileAnalysis,
+    extra_raw: Sequence[Tuple[str, int, int, str]] = (),
+    extra_checked: Sequence[str] = (),
+) -> FileReport:
+    """Matches raw findings (per-file + ``extra_raw`` from cross-module
+    passes) against the file's suppressions, and reports stale markers
+    for every rule in ``checked`` ∪ ``extra_checked``."""
+    report = FileReport(path=fa.path)
+    if fa.parse_finding is not None:
+        report.findings.append(fa.parse_finding)
+        return report
+
     by_line: Dict[int, List[_Suppression]] = {}
-    for s in sups:
+    for s in fa.sups:
         by_line.setdefault(s.line, []).append(s)
         if s.standalone:
             by_line.setdefault(s.line + 1, []).append(s)
 
+    raw = list(fa.raw) + list(extra_raw)
     used: Set[Tuple[int, str]] = set()  # (id(suppression), rule) pairs
     for rule, line, col, msg in sorted(raw, key=lambda f: (f[1], f[2], f[0])):
-        finding = Finding(relpath, line, col, rule, msg)
+        finding = Finding(fa.path, line, col, rule, msg)
         matching = [s for s in by_line.get(line, ()) if rule in s.rules]
         if matching:
             for s in matching:
@@ -881,20 +976,16 @@ def lint_source(
     # Unused-suppression detection: a well-formed marker naming a rule
     # that produced NO finding on its line(s) is stale — the hazard it
     # justified is gone (or moved), and a stale marker left behind would
-    # silently swallow the next, different finding at that line.  Only
-    # rules this scan actually executed count (R2 is skipped entirely in
-    # non-hot files, so its markers can't be judged there).
-    checked = {r for r in config.rules if r in ("R1", "R3", "R4", "R5")}
-    if "R2" in config.rules and is_hot:
-        checked.add("R2")
-    for s in sups:
+    # silently swallow the next, different finding at that line.
+    checked = set(fa.checked) | set(extra_checked)
+    for s in fa.sups:
         stale = sorted(
             r for r in s.rules if r in checked and (id(s), r) not in used
         )
         if stale:
             report.findings.append(
                 Finding(
-                    relpath,
+                    fa.path,
                     s.line,
                     0,
                     SUPPRESSION_RULE,
@@ -904,7 +995,20 @@ def lint_source(
                 )
             )
 
-    for line, col, msg in bad_sups:
-        report.findings.append(Finding(relpath, line, col, SUPPRESSION_RULE, msg))
+    for line, col, msg in fa.bad_sups:
+        report.findings.append(
+            Finding(fa.path, line, col, SUPPRESSION_RULE, msg)
+        )
     report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return report
+
+
+def lint_source(
+    source: str,
+    relpath: str,
+    config: JaxlintConfig,
+    hot: Optional[bool] = None,
+) -> FileReport:
+    """Lints one file's source with the per-file rules (no cross-module
+    analysis; see :func:`sboxgates_tpu.analysis.project.lint_project`)."""
+    return finalize_report(analyze_file(source, relpath, config, hot=hot))

@@ -166,8 +166,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # like a resume while discarding the journal's progress claim.
             return _err(
                 "--resume-run cannot be combined with --shard-sweep: "
-                "job-sharded sweeps restart instead of resuming (ROADMAP "
-                "open item)."
+                "job-sharded sweeps restart instead of resuming — restart "
+                "the sharded run with --output-dir to journal fresh "
+                "progress (ROADMAP open item)."
             )
         try:
             journal = SearchJournal.resume(args.resume_run)

@@ -56,6 +56,7 @@ def to_bits(tt) -> np.ndarray:
 
     Host-side helper (numpy only).
     """
+    # jaxlint: ignore[R2x] host-side helper by contract: decode/emit callers pass host word arrays; a device value crossing here is the documented boundary
     tt = np.asarray(tt, dtype=np.uint32)
     assert tt.shape[-1] == N_WORDS
     shifts = np.arange(WORD_BITS, dtype=np.uint32)

@@ -33,6 +33,12 @@ def n_choose_k(n: int, k: int) -> int:
 
 
 _native_ok: Optional[bool] = None
+# The probe runs on whichever thread first asks for a chunk — usually the
+# sbg-chunk-prefetch producer, concurrently with the consumer's own first
+# call in inline/mixed-depth runs — so the probe-and-publish must be
+# locked (double-checked: the post-probe reads are a plain racy-but-
+# monotonic fast path).
+_native_probe_lock = threading.Lock()
 
 
 def _native_stream_available() -> bool:
@@ -41,21 +47,24 @@ def _native_stream_available() -> bool:
     fallback."""
     global _native_ok
     if _native_ok is None:
-        try:
-            from .. import native
+        with _native_probe_lock:
+            if _native_ok is None:
+                try:
+                    from .. import native
 
-            _native_ok = native.available()
-        except (ImportError, OSError, AttributeError) as e:
-            # Import failure, ctypes load failure, or a stale .so missing a
-            # symbol: the pure-Python stream is a correct (slower) fallback,
-            # but the degradation must be visible in debug logs.
-            import logging
+                    _native_ok = native.available()
+                except (ImportError, OSError, AttributeError) as e:
+                    # Import failure, ctypes load failure, or a stale .so
+                    # missing a symbol: the pure-Python stream is a correct
+                    # (slower) fallback, but the degradation must be
+                    # visible in debug logs.
+                    import logging
 
-            logging.getLogger(__name__).warning(
-                "native combination stream unavailable (%r); "
-                "falling back to the pure-Python iterator", e
-            )
-            _native_ok = False
+                    logging.getLogger(__name__).warning(
+                        "native combination stream unavailable (%r); "
+                        "falling back to the pure-Python iterator", e
+                    )
+                    _native_ok = False
     return _native_ok
 
 
@@ -151,6 +160,7 @@ class CombinationStream:
         self.pos += len(rows)
         if not rows:
             return None
+        # jaxlint: ignore[R2x] host-built list of combination tuples from the pure-Python iterator; no device value can flow here
         return np.asarray(rows, dtype=np.int32)
 
 

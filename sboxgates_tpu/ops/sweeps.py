@@ -1595,6 +1595,7 @@ def lut7_split_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
             wm_rows.append(pack128((g[:, None] >> idx_m[None, :]) & u(1)))
             g_rows.append(pack128((x[free] & 1)[None, :])[0])
     return (
+        # jaxlint: ignore[R2x] host-built python list of decode orders; nothing device-resident flows in
         np.asarray(orders, dtype=np.int32),
         np.stack(wo_rows),
         np.stack(wm_rows),

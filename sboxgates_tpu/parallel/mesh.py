@@ -120,6 +120,7 @@ class MeshPlan:
         if n % s:
             arr = np.concatenate(
                 [
+                    # jaxlint: ignore[R2x] pads the HOST-produced candidate chunk before device placement; no device value reaches this path
                     np.asarray(arr),
                     np.full((s - n % s,) + arr.shape[1:], fill, dtype=arr.dtype),
                 ]

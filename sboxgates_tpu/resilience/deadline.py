@@ -39,6 +39,13 @@ class DispatchTimeout(RuntimeError):
     """A device dispatch exceeded its deadline budget (retries included)."""
 
 
+# Parallel mux-branch threads call dispatch_with_retry with ONE shared
+# ctx.stats dict; an unlocked read-modify-write on the breach/retry
+# counters loses increments exactly when breaches coincide (the case the
+# counters exist to expose).  Same pattern as mesh._PALLAS_LOCK.
+_stats_lock = threading.Lock()
+
+
 @dataclass
 class DeadlineConfig:
     """Deadline policy for blocking device-sweep resolves.
@@ -135,9 +142,10 @@ def dispatch_with_retry(
             return run_with_deadline(attempt, cfg.budget_s, label)
         except DispatchTimeout as e:
             if stats is not None:
-                stats["deadline_breaches"] = (
-                    stats.get("deadline_breaches", 0) + 1
-                )
+                with _stats_lock:
+                    stats["deadline_breaches"] = (
+                        stats.get("deadline_breaches", 0) + 1
+                    )
             if k == cfg.retries:
                 logger.warning(
                     "%s; %d retr%s exhausted", e, cfg.retries,
@@ -145,9 +153,10 @@ def dispatch_with_retry(
                 )
                 raise
             if stats is not None:
-                stats["dispatch_retries"] = (
-                    stats.get("dispatch_retries", 0) + 1
-                )
+                with _stats_lock:
+                    stats["dispatch_retries"] = (
+                        stats.get("dispatch_retries", 0) + 1
+                    )
             logger.warning("%s; retry %d/%d in %.2fs", e, k + 1,
                            cfg.retries, delay)
             time.sleep(delay)

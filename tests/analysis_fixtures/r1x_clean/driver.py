@@ -1,0 +1,14 @@
+"""Clean twin: statics hoisted / hashable — no recompile churn."""
+
+from .kernels import compute, fast_plain
+
+
+def run(xs):
+    out = []
+    n = 4  # hoisted: one compile for the whole loop
+    for _i in range(8):
+        out.append(compute(xs, n=n))
+    out.append(compute(xs, n=(1, 2)))  # tuple: hashable static
+    for _j in range(4):
+        out.append(fast_plain(xs, n=n))
+    return out

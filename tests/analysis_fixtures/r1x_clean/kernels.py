@@ -1,0 +1,17 @@
+"""Clean twin: same kernels as r1x_violation."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def compute(x, n):
+    return x * n
+
+
+def plain(x, n):
+    return x + n
+
+
+fast_plain = jax.jit(plain, static_argnames=("n",))

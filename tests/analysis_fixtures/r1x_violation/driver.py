@@ -1,0 +1,13 @@
+"""Dirty twin: call sites of imported jitted kernels."""
+
+from .kernels import compute, fast_plain
+
+
+def run(xs):
+    out = []
+    for i in range(8):
+        out.append(compute(xs, n=i))  # R1x: loop-varying static arg
+    out.append(compute(xs, n=[1, 2]))  # R1x: unhashable static arg
+    for j in range(4):
+        out.append(fast_plain(xs, n=j))  # R1x: via module-scope jit alias
+    return out

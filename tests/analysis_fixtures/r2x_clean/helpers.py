@@ -1,0 +1,12 @@
+"""Clean twin: the sync is acknowledged AT ITS SOURCE — the taint dies
+here for every caller, and the marker is counted used (it shows up as a
+suppressed acknowledged-source entry, never as stale)."""
+
+
+def fetch(v):
+    # jaxlint: ignore[R2x] deliberate per-item verdict pull; measured off the critical path
+    return v.item()
+
+
+def relay(v):
+    return fetch(v)

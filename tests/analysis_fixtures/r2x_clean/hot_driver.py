@@ -1,0 +1,12 @@
+"""Clean twin: the same hot loop; the helper's sync is acknowledged at
+its source, so no call-site finding fires."""
+
+from .helpers import fetch, relay
+
+
+def drain(batch):
+    total = 0
+    for v in batch:
+        total += fetch(v)
+        total += relay(v)
+    return total

@@ -1,0 +1,11 @@
+"""Dirty twin: sync-tainted helpers.  This module is NOT hot and the
+syncs are not in loops, so the per-file R2 never fires here — the taint
+only matters at the hot-module call sites in hot_driver.py."""
+
+
+def fetch(v):
+    return v.item()  # sync-taints fetch (and transitively its callers)
+
+
+def relay(v):
+    return fetch(v)

@@ -1,0 +1,11 @@
+"""Dirty twin: a hot-module loop calling sync-tainted helpers."""
+
+from .helpers import fetch, relay
+
+
+def drain(batch):
+    total = 0
+    for v in batch:
+        total += fetch(v)  # R2x: helper syncs (directly)
+        total += relay(v)  # R2x: helper syncs (transitively)
+    return total

@@ -1,0 +1,6 @@
+"""Clean twin: the locks live in their own module (aliasing case)."""
+
+import threading
+
+PROBE_LOCK = threading.Lock()
+EVENTS_LOCK = threading.Lock()

@@ -1,0 +1,32 @@
+"""Clean twin: same shapes as r4x_violation, every mutation guarded.
+
+``probe`` holds a lock IMPORTED from a sibling module; ``record`` holds
+a lock received as a PARAMETER (the call site in worker.py passes a
+known lock) — both count as held for R4x.
+"""
+
+from .locks import PROBE_LOCK
+
+_probe_ok = None
+EVENTS = []
+
+
+def probe():
+    global _probe_ok
+    if _probe_ok is None:
+        with PROBE_LOCK:  # imported lock: cross-module aliasing
+            if _probe_ok is None:
+                _probe_ok = True
+    return _probe_ok
+
+
+def record(lock, n):
+    with lock:  # parameter lock: worker.py passes EVENTS_LOCK
+        EVENTS.append(n)
+
+
+class Stream:
+    def next_chunk(self):
+        if probe():
+            return [1, 2, 3]
+        return []

@@ -1,0 +1,23 @@
+"""Clean twin: the same thread entry, mutations routed through locks."""
+
+import threading
+
+from .locks import EVENTS_LOCK
+from .state import Stream, record
+
+
+class Prefetcher:
+    def __init__(self):
+        self.stream = Stream()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+
+    def _work(self):
+        while True:
+            item = self._produce()
+            if item is None:
+                return
+
+    def _produce(self):
+        chunk = self.stream.next_chunk()
+        record(EVENTS_LOCK, len(chunk))
+        return chunk

@@ -1,0 +1,25 @@
+"""Dirty twin: module state mutated on thread-reachable paths, no lock.
+
+Mirrors the pre-fix ``ops/combinatorics._native_stream_available`` miss:
+``probe()`` mutates ``_probe_ok`` and is reached from the prefetch
+thread via ``Prefetcher._work -> Prefetcher._produce ->
+Stream.next_chunk -> probe`` (see worker.py) — invisible to the
+per-file R4, caught by R4x.
+"""
+
+_probe_ok = None
+EVENTS = []
+
+
+def probe():
+    global _probe_ok
+    if _probe_ok is None:
+        _probe_ok = True  # R4x: unlocked, thread-reachable transitively
+    return _probe_ok
+
+
+class Stream:
+    def next_chunk(self):
+        if probe():
+            return [1, 2, 3]
+        return []

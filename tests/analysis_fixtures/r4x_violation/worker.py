@@ -1,0 +1,22 @@
+"""Dirty twin: the thread entry and an imported-state mutation."""
+
+import threading
+
+from .state import EVENTS, Stream
+
+
+class Prefetcher:
+    def __init__(self):
+        self.stream = Stream()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+
+    def _work(self):
+        while True:
+            item = self._produce()
+            if item is None:
+                return
+
+    def _produce(self):
+        chunk = self.stream.next_chunk()
+        EVENTS.append(len(chunk))  # R4x: state imported from .state, no lock
+        return chunk

@@ -3,7 +3,9 @@
 Every rule is demonstrated by a violation fixture (exact rule IDs and
 line numbers asserted) with a clean twin that must scan empty; the
 suppression fixture locks in the inline-ignore syntax and the
-mandatory-reason enforcement.  Fixtures are read as text, never
+mandatory-reason enforcement.  The cross-module rules (R1x/R2x/R4x)
+use multi-file mini-package packs, linted whole-program with the pack
+directory as the project root.  Fixtures are read as text, never
 imported.
 """
 
@@ -12,6 +14,8 @@ import os
 import pytest
 
 from sboxgates_tpu.analysis import JaxlintConfig, lint_source
+from sboxgates_tpu.analysis.config import ALL_RULES
+from sboxgates_tpu.analysis.project import lint_project
 from sboxgates_tpu.analysis.rules import SUPPRESSION_RULE
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
@@ -24,6 +28,24 @@ def lint_fixture(name, **kwargs):
     # hot=True so R2 applies to fixture paths outside the configured
     # hot-module globs
     return lint_source(source, name, JaxlintConfig(), hot=True, **kwargs)
+
+
+def lint_pack(name, hot_modules=()):
+    """Whole-program lint of one multi-file fixture pack."""
+    cfg = JaxlintConfig(
+        root=os.path.join(FIXTURES, name),
+        paths=["."],
+        rules=list(ALL_RULES),
+        hot_modules=list(hot_modules),
+        whole_program=True,
+    )
+    return lint_project(config=cfg)
+
+
+def pack_found(reports):
+    return sorted(
+        (f.rule, r.path, f.line) for r in reports for f in r.findings
+    )
 
 
 def found(report):
@@ -159,3 +181,354 @@ def test_r2_requires_hot_module():
 def test_syntax_error_reported_not_raised():
     report = lint_source("def broken(:\n", "bad.py", JaxlintConfig())
     assert [f.rule for f in report.findings] == ["ERR"]
+
+
+# -- cross-module rule packs (whole-program pass) --------------------------
+
+X_VIOLATIONS = {
+    # pack -> (hot globs, exact sorted (rule, file, line))
+    "r4x_violation": (
+        (),
+        [("R4x", "state.py", 17), ("R4x", "worker.py", 21)],
+    ),
+    "r1x_violation": (
+        (),
+        [
+            ("R1x", "driver.py", 9),
+            ("R1x", "driver.py", 10),
+            ("R1x", "driver.py", 12),
+        ],
+    ),
+    "r2x_violation": (
+        ("*hot*",),
+        [("R2x", "hot_driver.py", 9), ("R2x", "hot_driver.py", 10)],
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(X_VIOLATIONS))
+def test_xrule_violation_pack_exact_findings(name):
+    hot, expected = X_VIOLATIONS[name]
+    assert pack_found(lint_pack(name, hot)) == expected
+
+
+@pytest.mark.parametrize(
+    "name,hot",
+    [("r4x_clean", ()), ("r1x_clean", ()), ("r2x_clean", ("*hot*",))],
+)
+def test_xrule_clean_twin_scans_empty(name, hot):
+    reports = lint_pack(name, hot)
+    assert pack_found(reports) == []
+
+
+def test_r4x_matches_the_native_ok_false_negative_shape():
+    """The r4x_violation pack mirrors the known pre-fix false negative
+    (ops/combinatorics._native_stream_available mutating _native_ok from
+    the prefetch thread via _work -> _produce -> next_chunk): the
+    finding names the thread root and the transitive path."""
+    reports = lint_pack("r4x_violation")
+    msgs = {
+        f.line: f.message for r in reports for f in r.findings
+        if r.path == "state.py"
+    }
+    m = msgs[17]
+    assert "Prefetcher._work" in m  # the thread entry
+    assert "_produce" in m and "next_chunk" in m  # the transitive path
+    assert "_probe_ok" in m
+
+
+def test_r4x_clean_demonstrates_lock_aliasing_and_parameter_locks():
+    """The clean twin guards the same mutations with an IMPORTED lock
+    and a PARAMETER lock — both count as held (the per-file R4 would
+    miss both)."""
+    src = open(
+        os.path.join(FIXTURES, "r4x_clean", "state.py"), encoding="utf-8"
+    ).read()
+    assert "from .locks import PROBE_LOCK" in src
+    assert "def record(lock, n):" in src
+    assert pack_found(lint_pack("r4x_clean")) == []
+
+
+def test_r2x_message_names_the_sync_witness():
+    reports = lint_pack("r2x_violation", ("*hot*",))
+    msgs = [f.message for r in reports for f in r.findings]
+    assert any("helpers.py" in m and ".item()" in m for m in msgs)
+
+
+def test_r2x_acknowledged_source_marker_is_used_not_stale():
+    """An R2x marker on the sync source kills the taint for every
+    caller and is recorded as a suppressed acknowledged-source entry —
+    never reported as an unused suppression."""
+    reports = lint_pack("r2x_clean", ("*hot*",))
+    sup = [
+        (f.rule, r.path, f.line) for r in reports for f in r.suppressed
+    ]
+    assert sup == [("R2x", "helpers.py", 8)]
+
+
+def test_xrule_findings_suppressible_inline(tmp_path):
+    """R4x findings honor the existing ignore[RULE] syntax."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "state.py").write_text(
+        "import threading\n"
+        "_flag = None\n"
+        "def probe():\n"
+        "    global _flag\n"
+        "    # jaxlint: ignore[R4x] benign idempotent probe, worst case a duplicate write\n"
+        "    _flag = True\n"
+        "def work():\n"
+        "    probe()\n"
+        "def spawn():\n"
+        "    threading.Thread(target=work).start()\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    assert [
+        (f.rule, f.line) for r in reports for f in r.suppressed
+    ] == [("R4x", 6)]
+
+
+def test_xrule_markers_not_judged_stale_without_whole_program(tmp_path):
+    """A marker for a cross-module rule is only judged (used or stale)
+    when the whole-program pass actually ran; the per-file pass leaves
+    it alone, and a whole-program run flags a stale one."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "def quiet():\n"
+        "    # jaxlint: ignore[R4x] left over from a removed mutation\n"
+        "    return 1\n"
+    )
+    src = (pack / "mod.py").read_text()
+    per_file = lint_source(src, "mod.py", JaxlintConfig())
+    assert found(per_file) == []  # not judged: R4x never ran
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    got = pack_found(reports)
+    assert got == [(SUPPRESSION_RULE, "mod.py", 2)]
+
+
+def test_r2x_for_else_body_is_not_in_the_loop(tmp_path):
+    """A call in a for-else clause runs once, after the loop — it must
+    not fire R2x's inside-a-loop check (regression: the body scan used
+    to visit orelse with the loop context still active)."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "helpers.py").write_text(
+        "def fetch(v):\n    return v.item()\n"
+    )
+    (pack / "hot_driver.py").write_text(
+        "from .helpers import fetch\n"
+        "def drain(batch):\n"
+        "    for v in batch:\n"
+        "        pass\n"
+        "    else:\n"
+        "        return fetch(batch)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        hot_modules=["*hot*"], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == []
+
+
+def test_r4x_local_shadowing_is_not_module_state(tmp_path):
+    """A local variable (or parameter) shadowing a module-level mutable
+    name refers to the LOCAL — mutating it from a thread is fine and
+    must not resolve through the project symbol table."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "state.py").write_text("EVENTS = []\n")
+    (pack / "worker.py").write_text(
+        "import threading\n"
+        "from .state import EVENTS\n"
+        "def work():\n"
+        "    EVENTS = []\n"
+        "    EVENTS.append(1)\n"
+        "    EVENTS[0] = 2\n"
+        "def spawn():\n"
+        "    threading.Thread(target=work).start()\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == []
+
+
+def test_r2_for_else_body_is_not_in_the_loop():
+    """Per-file R2 parity with R2x: a sync in a for-else clause runs
+    once, after the loop — not a per-iteration stall."""
+    src = (
+        "def drain(batch, v):\n"
+        "    for x in batch:\n"
+        "        pass\n"
+        "    else:\n"
+        "        return v.item()\n"
+    )
+    report = lint_source(src, "hot.py", JaxlintConfig(), hot=True)
+    assert found(report) == []
+    # ...while the while-TEST re-evaluates per iteration and stays R2
+    src2 = "def drain(v):\n    while v.item():\n        pass\n"
+    report2 = lint_source(src2, "hot.py", JaxlintConfig(), hot=True)
+    assert found(report2) == [("R2", 2)]
+
+
+def test_r2x_shadowed_callable_is_not_the_imported_helper(tmp_path):
+    """A parameter shadowing an imported sync-tainted function means
+    the loop calls the PARAMETER — no R2x."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "helpers.py").write_text(
+        "def fetch(v):\n    return v.item()\n"
+    )
+    (pack / "hot_driver.py").write_text(
+        "from .helpers import fetch\n"
+        "def drain(batch, fetch):\n"
+        "    for v in batch:\n"
+        "        fetch(v)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        hot_modules=["*hot*"], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == []
+
+
+def test_r4x_tuple_unpacked_local_shadows_module_state(tmp_path):
+    """Tuple-unpacking assignment binds locals too: `EVENTS, x = [], 1`
+    shadows module EVENTS for the rest of the function."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "state.py").write_text("EVENTS = []\n")
+    (pack / "worker.py").write_text(
+        "import threading\n"
+        "from .state import EVENTS\n"
+        "def work():\n"
+        "    EVENTS, x = [], 1\n"
+        "    EVENTS.append(x)\n"
+        "def spawn():\n"
+        "    threading.Thread(target=work).start()\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == []
+
+
+def test_r4x_sees_aliased_threading_import(tmp_path):
+    """`import threading as th; th.Thread(target=...)` registers the
+    target as a thread root all the same."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "worker.py").write_text(
+        "import threading as th\n"
+        "_flag = None\n"
+        "def probe():\n"
+        "    global _flag\n"
+        "    _flag = True\n"
+        "def work():\n"
+        "    probe()\n"
+        "def spawn():\n"
+        "    th.Thread(target=work).start()\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        ("R4x", "worker.py", 5)
+    ]
+
+
+def test_r2x_stale_acknowledged_source_marker_is_flagged(tmp_path):
+    """An R2x marker whose sync is gone is stale even in a NON-hot file
+    (acknowledged-source entries are emitted regardless of hotness, so
+    the inventory must not accrete)."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "cold.py").write_text(
+        "def fetch(v):\n"
+        "    # jaxlint: ignore[R2x] acknowledged sync that no longer exists\n"
+        "    return v\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        (SUPPRESSION_RULE, "cold.py", 2)
+    ]
+
+
+def test_r1x_annassign_jit_alias_tracks_statics(tmp_path):
+    """`jfit: Callable = jax.jit(fn, static_argnames=...)` at module
+    scope carries its statics to cross-module call sites."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "kernels.py").write_text(
+        "from typing import Callable\n"
+        "import jax\n"
+        "def plain(x, k):\n"
+        "    return x\n"
+        "jfit: Callable = jax.jit(plain, static_argnames=('k',))\n"
+    )
+    (pack / "driver.py").write_text(
+        "from .kernels import jfit\n"
+        "def run(xs):\n"
+        "    for i in range(4):\n"
+        "        jfit(xs, k=i)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        ("R1x", "driver.py", 4)
+    ]
+
+
+def test_r2x_while_test_is_in_the_loop(tmp_path):
+    """A while-loop's test re-evaluates every iteration: a sync-tainted
+    helper called there must fire R2x (parity with the per-file R2,
+    which treats the test as loop context)."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "helpers.py").write_text(
+        "def pending(v):\n    return v.item()\n"
+    )
+    (pack / "hot_driver.py").write_text(
+        "from .helpers import pending\n"
+        "def drain(v):\n"
+        "    while pending(v):\n"
+        "        pass\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        hot_modules=["*hot*"], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        ("R2x", "hot_driver.py", 3)
+    ]
+
+
+def test_pack_scan_is_deterministic():
+    a = pack_found(lint_pack("r4x_violation"))
+    b = pack_found(lint_pack("r4x_violation"))
+    assert a == b
+    msgs_a = [
+        f.message for r in lint_pack("r4x_violation") for f in r.findings
+    ]
+    msgs_b = [
+        f.message for r in lint_pack("r4x_violation") for f in r.findings
+    ]
+    assert msgs_a == msgs_b

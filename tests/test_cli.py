@@ -76,6 +76,19 @@ def test_truncated_graph_file_is_one_line_error(tmp_path, capsys):
     assert bad in err and "Traceback" not in err
 
 
+def test_resume_run_with_shard_sweep_rejected_names_workaround(capsys):
+    """--resume-run + --shard-sweep is rejected (job-sharded sweeps are
+    journal-free and restart instead of resuming), and the one-line
+    error names the workaround: restart with --output-dir journaling."""
+    rc = main(["--resume-run", "/tmp/does-not-exist", "--shard-sweep"])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "--resume-run cannot be combined with --shard-sweep" in err
+    assert "--output-dir" in err  # the workaround, not just the refusal
+    assert err.strip().count("\n") == 0  # exactly one line
+    assert "Traceback" not in err
+
+
 def test_help_exits_zero():
     with pytest.raises(SystemExit) as e:
         main(["--help"])

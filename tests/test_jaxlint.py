@@ -30,7 +30,10 @@ def test_package_scan_has_zero_unsuppressed_findings():
 
 def test_config_comes_from_pyproject():
     config = load_config(ROOT)
-    assert config.rules == ["R1", "R2", "R3", "R4", "R5"]
+    assert config.rules == [
+        "R1", "R2", "R3", "R4", "R5", "R1x", "R2x", "R4x",
+    ]
+    assert config.whole_program  # cross-module pass is on in the gate
     assert "sboxgates_tpu/search/lut.py" in config.hot_modules
     assert config.is_hot("sboxgates_tpu/ops/sweeps.py")
     assert not config.is_hot("sboxgates_tpu/search/context.py")
@@ -72,3 +75,100 @@ def test_cli_baseline_mode_passes():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_whole_program_pass_runs_in_gate_and_under_budget(monkeypatch):
+    """The shared AST cache keeps the full whole-program scan (per-file
+    rules + call graph + R1x/R2x/R4x) inside the CI budget.  The
+    structural guard is the real regression net: each module is parsed
+    EXACTLY once, however many passes run over it — re-parsing per pass
+    is what would blow the wall clock on a big tree."""
+    import ast
+    import time
+
+    calls = {"n": 0}
+    real_parse = ast.parse
+
+    def counting_parse(*args, **kwargs):
+        calls["n"] += 1
+        return real_parse(*args, **kwargs)
+
+    monkeypatch.setattr(ast, "parse", counting_parse)
+    config = load_config(ROOT)
+    assert config.whole_program
+    t0 = time.monotonic()
+    reports = lint_paths(config=config)
+    elapsed = time.monotonic() - t0
+    assert calls["n"] == len(reports), (
+        f"{calls['n']} ast.parse calls for {len(reports)} files — the "
+        "whole-program pass must share one parse per module"
+    )
+    if elapsed >= 5.0:
+        # A transient load spike shouldn't flake the gate: retry once
+        # and hold the best of the two runs to the budget.
+        t0 = time.monotonic()
+        lint_paths(config=config)
+        elapsed = min(elapsed, time.monotonic() - t0)
+    assert elapsed < 5.0, f"whole-program lint took {elapsed:.1f}s"
+    # The cross-module pass really ran: the acknowledged-source R2x
+    # entries (deliberate compact-verdict syncs) only exist under it.
+    sup_rules = {f.rule for r in reports for f in r.suppressed}
+    assert "R2x" in sup_rules
+
+
+def test_whole_program_json_is_deterministic():
+    """Two scans of the same tree are byte-identical (sorted traversal
+    everywhere — an unsorted dict walk would flake the baseline gate)."""
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "sboxgates_tpu.analysis",
+                "--format", "json",
+            ],
+            cwd=ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1]
+
+
+def test_cli_graph_dump():
+    """--graph emits the resolved call graph as deterministic JSON:
+    functions, lock/loop-annotated edges, thread and jit roots."""
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-m", "sboxgates_tpu.analysis", "--graph"],
+            cwd=ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1]
+    graph = json.loads(outs[0])
+    assert (
+        "sboxgates_tpu.ops.combinatorics:ChunkPrefetcher._work"
+        in graph["thread_roots"]
+    )
+    assert (
+        "sboxgates_tpu.resilience.deadline:run_with_deadline.<locals>.work"
+        in graph["thread_roots"]
+    )
+    assert graph["jit_roots"], "jit-boundary roots missing"
+    assert graph["edges"], "call graph has no edges"
+    edge_keys = set(graph["edges"][0])
+    assert {"caller", "callee", "locked", "in_loop"} <= edge_keys
+    # the canonical transitive path exists edge by edge
+    pairs = {(e["caller"], e["callee"]) for e in graph["edges"]}
+    pre = "sboxgates_tpu.ops.combinatorics:ChunkPrefetcher."
+    assert (pre + "_work", pre + "_produce_one") in pairs
+    assert (
+        pre + "_produce_one",
+        "sboxgates_tpu.ops.combinatorics:CombinationStream.next_chunk",
+    ) in pairs
