@@ -35,6 +35,11 @@ os.environ.setdefault(
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+# Background kernel warmup stays off in the bench process: a warmer
+# AOT-compiling the next bucket's ladder would contend for CPU inside
+# measured windows.  --cold-start measures the compile-latency subsystem
+# explicitly, in subprocesses it controls.
+os.environ.setdefault("SBG_WARMUP", "0")
 
 import numpy as np
 
@@ -527,6 +532,113 @@ def bench_gather_compaction() -> dict:
             if line.startswith("GATHERBENCH "):
                 return json.loads(line[len("GATHERBENCH "):])
     raise RuntimeError(f"no GATHERBENCH line: {outs}")
+
+
+def _cold_start_worker() -> None:
+    """Child half of :func:`bench_cold_start`: measures time from process
+    entry to the first resolved sweep dispatch of a fresh search context
+    — the user-visible time-to-first-candidate cost that the persistent
+    compilation cache (SBG_COMPILE_CACHE, set by the parent) turns from
+    an XLA compile into an executable deserialize.  Prints one JSON line
+    {t_import_s, t_first_dispatch_s, kernel_compiles, compile_stall_s}.
+    """
+    t0 = time.perf_counter()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sboxgates_tpu.search.warmup import configure_compile_cache
+
+    configure_compile_cache(os.environ.get("SBG_COMPILE_CACHE"))
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.lut import lut3_search
+
+    t_import = time.perf_counter() - t0
+    rng = np.random.default_rng(11)
+    st = State.init_inputs(8)
+    while st.num_gates < 24:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    target = np.asarray(rng.integers(0, 2**32, size=8), dtype=np.uint32)
+    ctx = SearchContext(Options(
+        seed=11, lut_graph=True, randomize=False, host_small_steps=False,
+        warmup=False,
+    ))
+    lut3_search(ctx, st, target, tt.mask_table(8), [])
+    print("COLDSTART " + json.dumps({
+        "t_import_s": round(t_import, 4),
+        "t_first_dispatch_s": round(time.perf_counter() - t0, 4),
+        "kernel_compiles": ctx.stats["kernel_compiles"],
+        "compile_stall_s": round(ctx.stats["compile_stall_s"], 4),
+    }), flush=True)
+
+
+def bench_cold_start() -> list:
+    """Cold vs warm persistent-compile-cache time-to-first-dispatch.
+
+    Runs :func:`_cold_start_worker` twice in fresh subprocesses sharing
+    one empty cache directory: the first run pays the full XLA compiles
+    and populates the cache; the second — the restart / ``--resume-run``
+    shape — deserializes them.  The delta is exactly the compile latency
+    the persistent cache removes from a restarted search's critical
+    path."""
+    import subprocess
+    import sys
+    import tempfile
+
+    entries = []
+    with tempfile.TemporaryDirectory(prefix="sbg_coldstart_") as cache:
+        env = {
+            k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
+        }
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SBG_COMPILE_CACHE"] = cache
+        # The measurement is THIS process tree's cache, not the repo's.
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        results = []
+        for arm in ("cold", "warm"):
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--cold-start-worker"],
+                capture_output=True, text=True, timeout=1200, env=env,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"cold-start worker ({arm}) failed: {r.stderr[-800:]}"
+                )
+            line = next(
+                ln for ln in r.stdout.splitlines()
+                if ln.startswith("COLDSTART ")
+            )
+            results.append(json.loads(line[len("COLDSTART "):]))
+        cold, warm = results
+        entries.append({
+            "metric": "cold_start_first_dispatch", "unit": "s",
+            "value": cold["t_first_dispatch_s"], **{
+                f"cold_{k}": v for k, v in cold.items()
+            },
+        })
+        entries.append({
+            "metric": "warm_start_first_dispatch", "unit": "s",
+            "value": warm["t_first_dispatch_s"], **{
+                f"warm_{k}": v for k, v in warm.items()
+            },
+        })
+        stall_saved = cold["compile_stall_s"] - warm["compile_stall_s"]
+        entries.append({
+            "metric": "cold_start_speedup",
+            "unit": "x (cold/warm time-to-first-dispatch)",
+            "value": (
+                round(cold["t_first_dispatch_s"]
+                      / warm["t_first_dispatch_s"], 3)
+                if warm["t_first_dispatch_s"] > 0 else None
+            ),
+            "compile_stall_saved_s": round(stall_saved, 4),
+        })
+    return entries
 
 
 def bench_mesh_scaling() -> dict:
@@ -1647,6 +1759,18 @@ def main() -> None:
     if "--gather-bench-worker" in sys.argv:
         i = sys.argv.index("--gather-bench-worker")
         _gather_bench_worker(int(sys.argv[i + 1]), sys.argv[i + 2])
+        return
+    if "--cold-start-worker" in sys.argv:
+        _cold_start_worker()
+        return
+    if "--cold-start" in sys.argv:
+        # Standalone mode: cold vs warm persistent-compile-cache
+        # time-to-first-dispatch (the restart / --resume-run shape),
+        # written to BENCH_COLDSTART.json.  Needs no accelerator.
+        detail = bench_cold_start()
+        with open(os.path.join(HERE, "BENCH_COLDSTART.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+        print(json.dumps(detail[-1]))
         return
     if "--host-stream" in sys.argv:
         # Standalone mode: just the serial-vs-pipelined host-stream A/B

@@ -17,6 +17,7 @@ the ones production code fires today):
 ``prefetch.produce``      producing one chunk in the streaming prefetcher
 ``dispatch.sweep``        issuing/resolving one device sweep dispatch
 ``native.devcb``          servicing one native-engine device-work callback
+``warmup.compile``        one background AOT kernel compile (KernelWarmer)
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -56,6 +57,7 @@ KNOWN_SITES = (
     "prefetch.produce",
     "dispatch.sweep",
     "native.devcb",
+    "warmup.compile",
 )
 
 

@@ -1,3 +1,4 @@
+from . import warmup  # noqa: F401
 from .context import Options, SearchContext  # noqa: F401
 from .kwan import create_circuit  # noqa: F401
 from .lut import lut_search  # noqa: F401

@@ -8,9 +8,10 @@ candidate spaces through the jitted kernels in :mod:`sboxgates_tpu.ops.sweeps`.
 
 from __future__ import annotations
 
-import functools
+import hashlib
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -22,7 +23,9 @@ from ..graph.state import GATES, State
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience import deadline as _deadline
+from ..utils import guards as _guards
 from ..utils.profile import PhaseProfiler
+from . import warmup as _warmup
 
 # Gate-count buckets: live tables are zero-padded up to the next bucket so
 # jitted sweeps see a small, fixed set of shapes.  Two buckets only — gather
@@ -179,6 +182,23 @@ class Options:
     # randomized runs stay seed-deterministic but draw from the
     # engine's own PRNG stream.
     native_engine: bool = True
+    # Background kernel warmup (search/warmup.py KernelWarmer): on entry
+    # to a gate-count bucket, AOT-compile the next bucket's sweep-kernel
+    # set off the critical path, so the mid-search bucket crossing pays
+    # zero compile stall.  Warmup only compiles, never executes — first
+    # hits and final circuits are bit-identical with it on or off
+    # (parity-tested).  Also gated by SBG_WARMUP (0 disables globally;
+    # the test suite and bench set it to keep background compiles out of
+    # measured/timed regions).  Single-device contexts only: mesh runs
+    # keep the lazy path (warmed avals would need the run's sharding
+    # layouts; the persistent compile cache still covers them).
+    warmup: bool = True
+    # Persistent XLA compilation cache directory (--compile-cache /
+    # SBG_COMPILE_CACHE; default: an xla_cache/ subdir of --output-dir).
+    # Restarts and --resume-run then deserialize every previously built
+    # sweep executable instead of recompiling it.  None = leave jax's
+    # configuration untouched.
+    compile_cache: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -345,7 +365,40 @@ class SearchContext:
             # guard counters.
             "dispatch_retries": 0,
             "deadline_breaches": 0,
+            # Compile-latency subsystem (search/warmup.py): lazy jit
+            # compiles taken on the dispatch path (with their stall time)
+            # and warm-cache consults; per-kernel compile stalls land as
+            # ``compile[<kernel>]`` profiler rows.
+            "kernel_compiles": 0,
+            "compile_stall_s": 0.0,
+            "warm_hits": 0,
+            "warm_misses": 0,
+            # Device-resident table cache: uploads actually performed vs
+            # dispatches served from the memoized placed buffer.
+            "table_uploads": 0,
+            "table_cache_hits": 0,
         }
+        # Device-resident live-table cache (device_tables): placed
+        # [bucket, 8] buffers memoized on content digest.  Shared BY
+        # REFERENCE (dict + lock) with every RestartContext view, so
+        # concurrent mux branches reuse each other's uploads.
+        self._table_cache: "OrderedDict" = OrderedDict()
+        self._table_lock = threading.Lock()
+        # Background next-bucket kernel warmer (search/warmup.py); None
+        # when disabled or under a mesh (sharded avals are run-specific).
+        # Persistent compilation cache (Options.compile_cache): applied
+        # here so library users and bench get it too, not just the CLI
+        # (which configures it earlier, before this context exists — the
+        # call is idempotent).
+        if opt.compile_cache:
+            _warmup.configure_compile_cache(opt.compile_cache)
+        self.warmer = None
+        if mesh_plan is None and opt.warmup:
+            warmer = _warmup.KernelWarmer(_warmup.WarmPlan.from_context(self))
+            # SBG_WARMUP=0 disables globally (tests, bench timing loops);
+            # keep None rather than a dead warmer so dispatch telemetry
+            # doesn't count phantom warm misses.
+            self.warmer = warmer if warmer.enabled else None
         # Deadline policy for blocking sweep resolves (guarded_dispatch).
         self.deadline_cfg = _deadline.config_from_env()
         if opt.dispatch_timeout_s is not None:
@@ -493,13 +546,115 @@ class SearchContext:
         self._seed_buf = (buf, pos + 1)
         return int(buf[pos])
 
+    #: Entries kept in the device-table cache: deep mux recursions touch a
+    #: handful of sibling states per level; 8 covers the working set while
+    #: bounding device memory to 8 * [512, 8] uint32 = 128 KiB.
+    TABLE_CACHE_SLOTS = 8
+
     def device_tables(self, st: State):
-        """Zero-padded [bucket, 8] live tables (replicated across the mesh)."""
+        """Device-resident zero-padded [bucket, 8] live tables (replicated
+        across the mesh), memoized on (bucket, content digest): repeated
+        dispatches for an unchanged state reuse the placed buffer instead
+        of rebuilding and re-uploading the padded host array every time.
+
+        Invalidation is by content: ANY state mutation changes the live
+        tables' bytes, so a mutated state always digests to a new key and
+        gets a fresh upload (property-tested).  Content keying is
+        deliberate — states are value-copied around the mux recursion
+        (identical bytes reuse the same buffer across copies), and kwan's
+        best-branch adoption assigns ``st.tables`` directly, which any
+        identity- or version-based invalidation would miss."""
         g = st.num_gates
         b = bucket_size(g)
+        live = np.ascontiguousarray(st.live_tables())
+        key = (b, hashlib.blake2b(live.tobytes(), digest_size=16).digest())
+        with self._table_lock:
+            hit = self._table_cache.get(key)
+            if hit is not None:
+                self._table_cache.move_to_end(key)
+                self.stats["table_cache_hits"] += 1
+                return hit
         padded = np.zeros((b, 8), dtype=np.uint32)
-        padded[:g] = st.live_tables()
-        return self.place_replicated(padded), g
+        padded[:g] = live
+        placed = self.place_replicated(padded)
+        with self._table_lock:
+            # A concurrent mux branch may have uploaded the same key while
+            # we placed; last write wins — both buffers hold identical
+            # bytes, so either is correct.
+            self.stats["table_uploads"] += 1
+            self._table_cache[key] = placed
+            while len(self._table_cache) > self.TABLE_CACHE_SLOTS:
+                self._table_cache.popitem(last=False)
+        return placed
+
+    def table_bucket(self, st: State) -> int:
+        """The shape bucket ``device_tables(st)`` pads to — the companion
+        accessor for call sites that need the padded height without the
+        placed buffer."""
+        return bucket_size(st.num_gates)
+
+    def invalidate_device_tables(self) -> None:
+        """Drops every memoized placed table (the next dispatch re-uploads).
+        The content-digest keys make this unnecessary for correctness; it
+        exists for explicit lifecycle control (tests, device resets)."""
+        with self._table_lock:
+            self._table_cache.clear()
+
+    def kernel_call(self, name: str, statics: dict, args: tuple, g=None):
+        """Registry-routed jitted-kernel invocation (search/warmup.py):
+        the kernel is built from the warmup registry — the same table the
+        background warmer compiles from, so the warmed set cannot drift
+        from this call site.  Returns the kernel's raw output pytree
+        (async dispatch, unresolved).
+
+        ``g`` is the dispatching state's gate count: it drives the
+        warmer's bucket-entry detection.  A warmed dispatch calls the AOT
+        ``Compiled`` executable directly — zero tracing, zero compiles; a
+        miss takes the ordinary lazy jit path, with the compile stall (if
+        one happened) recorded in ``ctx.stats`` and as a
+        ``compile[<kernel>]`` profiler row."""
+        warmer = self.warmer
+        if warmer is not None:
+            warmer.note_gates(g)
+            compiled = warmer.lookup(name, statics, args)
+            if _warmup.KERNELS[name].warmable:
+                self.stats[
+                    "warm_hits" if compiled is not None else "warm_misses"
+                ] += 1
+            if compiled is not None:
+                try:
+                    return compiled(*args)
+                except TypeError as e:
+                    # Aval drift between the warm spec and the live call
+                    # site — fall back to the lazy path (results are
+                    # unaffected) and count it; the registry-parity test
+                    # keeps this at zero.
+                    warmer.count("warm_aval_mismatches")
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "warmed kernel %s rejected the live operands "
+                        "(%s); recompiling lazily", name, e
+                    )
+        fn = _warmup.kernel(name, statics)
+        before = _guards.jit_cache_size(_warmup.KERNELS[name].fn)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        if before is not None and (
+            _guards.jit_cache_size(_warmup.KERNELS[name].fn) or 0
+        ) > before:
+            # The call traced + compiled a new executable: the elapsed
+            # wall time is compile stall (execution is async-dispatched).
+            dt = time.perf_counter() - t0
+            self.stats["kernel_compiles"] += 1
+            self.stats["compile_stall_s"] += dt
+            self.prof.add(f"compile[{name}]", dt)
+        return out
+
+    def warmup_stats(self) -> dict:
+        """Warmer-side telemetry (compiled/failed/in-flight counts) for
+        the -vv summary and bench reports; {} when the warmer is off."""
+        return {} if self.warmer is None else self.warmer.stats_snapshot()
 
     def place_chunk(self, arr, fill=0):
         """Shards a [N, ...] candidate array over the mesh (no-op without one)."""
@@ -603,7 +758,7 @@ class SearchContext:
         ((tables, binom, g, target, mask, excl), total, chunk)."""
         g = st.num_gates
         total = comb.n_choose_k(g, k)
-        tables, _ = self.device_tables(st)
+        tables = self.device_tables(st)
         chunk = pick_chunk(total, STREAM_CHUNK[k])
         return (
             (
@@ -667,8 +822,12 @@ class SearchContext:
                     self.mesh_plan, *args, k=k, chunk=chunk
                 )
         else:
+            gk = st.num_gates
+
             def issue():
-                return sweeps.feasible_stream(*args, k=k, chunk=chunk)
+                return self.kernel_call(
+                    "feasible_stream", dict(k=k, chunk=chunk), args, g=gk
+                )
 
         # Issued asynchronously NOW; a deadline retry re-issues the whole
         # dispatch (resolving a wedged RPC again would block on the same
@@ -744,16 +903,32 @@ class SearchContext:
 
     # -- sweep drivers ----------------------------------------------------
 
-    def _dispatch(self, key, kernel, args, shared=()) -> np.ndarray:
-        """Executes one fixed-shape sweep kernel, returning its packed
-        verdict.  With a rendezvous attached (``self.rdv``), same-``key``
-        dispatches from concurrent threads (mux branches, batched
-        restarts) merge into one vmapped call; ``shared`` marks arg
-        indices identical across threads (mapped in_axes=None instead of
-        stacked)."""
-        if self.rdv is not None:
-            return self.rdv.submit(key, kernel, args, shared)
-        return np.asarray(kernel(*args))
+    def _dispatch(self, name, statics, args, shared=(), g=None) -> np.ndarray:
+        """Executes one fixed-shape sweep kernel from the warmup registry
+        (``name`` + ``statics`` resolve through search/warmup.py KERNELS,
+        the same table the background warmer compiles from), returning its
+        packed verdict.  With a rendezvous attached (``self.rdv``) AND
+        other live threads, same-signature dispatches from concurrent
+        threads (mux branches, batched restarts) merge into one vmapped
+        call; ``shared`` marks arg indices identical across threads
+        (mapped in_axes=None instead of stacked).
+
+        A sole live thread takes the registry path directly: the
+        rendezvous would execute a 1-entry group as the identical direct
+        call anyway (batched._run_group), and routing it through
+        kernel_call keeps the warm-AOT lookup and compile telemetry on
+        the accelerator default (parallel_mux auto-on builds a
+        Rendezvous(1) there; only actual mux concurrency should forfeit
+        warm reuse for dispatch merging).  Reading ``live`` unlocked is
+        safe: it can only exceed 1 while helper threads this thread
+        spawned are attached, and a helper observing a transient 1 is by
+        then genuinely alone in the pool."""
+        if self.rdv is not None and self.rdv.live > 1:
+            key = _warmup.warm_key(name, statics, args)
+            return self.rdv.submit(
+                key, _warmup.kernel(name, statics), args, shared
+            )
+        return np.asarray(self.kernel_call(name, statics, args, g=g))
 
     def _node_operands(self, st: State, target, mask):
         """Operand preamble shared by the fused per-node head dispatches
@@ -761,8 +936,9 @@ class SearchContext:
         combo grid, and placed target/mask.  Kept in one place so the
         rendezvous ``shared`` index lists stay consistent with a single
         argument layout."""
-        tables, g = self.device_tables(st)
-        b = tables.shape[0]
+        tables = self.device_tables(st)
+        g = st.num_gates
+        b = self.table_bucket(st)
         valid_g = jnp.arange(b) < g
         combos = self._pair_combos(b)
         pair_valid = (combos < g).all(axis=1)
@@ -1006,11 +1182,8 @@ class SearchContext:
         chunk3 = pick_chunk(max(total3, 1), STREAM_CHUNK[3])
         with self.prof.phase("gate_step"):
             v = self._dispatch(
-                ("gstep", b, chunk3, has_not, has_triple),
-                functools.partial(
-                    sweeps.gate_step_stream,
-                    chunk3=chunk3, has_not=has_not, has_triple=has_triple,
-                ),
+                "gate_step_stream",
+                dict(chunk3=chunk3, has_not=has_not, has_triple=has_triple),
                 (
                     tables,
                     valid_g,
@@ -1031,6 +1204,7 @@ class SearchContext:
                 # binomial table, (empty) exclusion list, and the three
                 # match tables
                 shared=(2, 4, 8, 10, 11, 12),
+                g=g,
             )
         step = int(v[0])
         if step == 0 or step >= 3:
@@ -1109,12 +1283,9 @@ class SearchContext:
         jw, jm = self._lut5_tabs
         with self.prof.phase("lut_step"):
             v = self._dispatch(
-                ("lstep", b, chunk3, chunk5, has5),
-                functools.partial(
-                    sweeps.lut_step_stream,
-                    chunk3=chunk3, chunk5=chunk5, has5=has5,
-                    solve_rows=LUT5_HEAD_SOLVE_ROWS,
-                ),
+                "lut_step_stream",
+                dict(chunk3=chunk3, chunk5=chunk5, has5=has5,
+                     solve_rows=LUT5_HEAD_SOLVE_ROWS),
                 (
                     tables,
                     valid_g,
@@ -1135,6 +1306,7 @@ class SearchContext:
                 # identical across restarts under one key: combo grid,
                 # binomial table, pair match table, 5-LUT split tables
                 shared=(2, 4, 11, 12, 13),
+                g=g,
             )
         step = int(v[0])
         if step == 0 or step >= 3:
@@ -1200,8 +1372,8 @@ class SearchContext:
                 jidx, jpp = self._lut7_tabs()
                 with self.prof.phase("lut7_step"):
                     sol = self._dispatch(
-                        ("l7solve", solve7),
-                        sweeps.lut7_solve,
+                        "lut7_solve",
+                        {},
                         (
                             self.place_replicated(sr1),
                             self.place_replicated(sr0),
@@ -1210,6 +1382,7 @@ class SearchContext:
                             seed ^ 0x77A1,
                         ),
                         shared=(2, 3),
+                        g=g,
                     )
             found, best_t, sigma, flat = (int(x) for x in sol)
             overflow = nfeas > solve7 and not found
@@ -1236,15 +1409,12 @@ class SearchContext:
         g = st.num_gates
         total7 = comb.n_choose_k(g, 7)
         chunk7 = pick_chunk(max(total7, 1), STREAM_CHUNK[7])
-        tables, _ = self.device_tables(st)
+        tables = self.device_tables(st)
         jidx, jpp = self._lut7_tabs()
         with self.prof.phase("lut7_step"):
             v = self._dispatch(
-                ("l7step", tables.shape[0], chunk7),
-                functools.partial(
-                    sweeps.lut7_step_stream, chunk7=chunk7,
-                    solve7=LUT7_HEAD_SOLVE_ROWS,
-                ),
+                "lut7_step_stream",
+                dict(chunk7=chunk7, solve7=LUT7_HEAD_SOLVE_ROWS),
                 (
                     tables,
                     self.binom,
@@ -1260,6 +1430,7 @@ class SearchContext:
                 # identical across restarts under one key: binomial table
                 # and the 7-LUT pair tables
                 shared=(1, 7, 8),
+                g=g,
             )
         self.stats["lut7_candidates"] += int(v[4])
         self.stats["lut7_solved"] += int(v[5])
@@ -1287,14 +1458,16 @@ class SearchContext:
         entries = self.not_entries if use_not_table else self.pair_entries
         if table is None:
             return False, 0, 0, None
-        tables, g = self.device_tables(st)
-        combos = self._pair_combos(tables.shape[0])
+        tables = self.device_tables(st)
+        g = st.num_gates
+        b = self.table_bucket(st)
+        combos = self._pair_combos(b)
         valid = (combos < g).all(axis=1)
         self.stats["pair_candidates"] += g * (g - 1) // 2
         with self.prof.phase("pair_sweep"):
             v = self._dispatch(
-                ("pair", tables.shape[0], use_not_table),
-                functools.partial(sweeps.tuple_match_sweep, num_cells=4),
+                "tuple_match_sweep",
+                dict(num_cells=4),
                 (
                     tables,
                     combos,
@@ -1304,10 +1477,11 @@ class SearchContext:
                     table,
                     self.next_seed(),
                 ),
+                g=g,
             )
         if not bool(v[0]):
             return False, 0, 0, None
-        pair = self._pair_combos_np(tables.shape[0])[int(v[1])]
+        pair = self._pair_combos_np(b)[int(v[1])]
         entry = entries[int(v[2])]
         gids = [int(pair[p]) for p in entry.perm]
         return True, gids[0], gids[1], entry
@@ -1320,14 +1494,12 @@ class SearchContext:
         total = comb.n_choose_k(g, 3)
         if total == 0:
             return False, None, None
-        tables, _ = self.device_tables(st)
+        tables = self.device_tables(st)
         chunk = pick_chunk(total, STREAM_CHUNK[3])
         with self.prof.phase("triple_sweep"):
             v = self._dispatch(
-                ("triple", tables.shape[0], chunk),
-                functools.partial(
-                    sweeps.match_stream, k=3, chunk=chunk, num_cells=8
-                ),
+                "match_stream",
+                dict(k=3, chunk=chunk, num_cells=8),
                 (
                     tables,
                     self.binom,
@@ -1340,6 +1512,7 @@ class SearchContext:
                     self.triple_table,
                     self.next_seed(),
                 ),
+                g=g,
             )
         self.stats["triple_candidates"] += int(v[3])
         if not bool(v[0]):

@@ -46,6 +46,14 @@ def _create_circuit(
     opt = ctx.opt
     metric = opt.metric
 
+    # Bucket-entry hook for the background kernel warmer: every search
+    # node reports its gate count, so the next bucket's sweep-kernel set
+    # starts compiling off the critical path as soon as the current
+    # bucket is entered — including on natively-routed nodes, whose
+    # pivot/staged continuations still dispatch device kernels.
+    if ctx.warmer is not None:
+        ctx.warmer.note_gates(st.num_gates)
+
     # The whole recursion runs in a native engine when available
     # (csrc sbg_gate_engine / sbg_lut_engine) — Python only replays the
     # final adopted gate additions and re-verifies.  Bit-identical to

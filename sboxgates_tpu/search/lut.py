@@ -105,9 +105,10 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
         args, total, chunk = ctx.stream_args(st, target, mask, [], 3)
         seed = ctx.next_seed()
         v = ctx.guarded_dispatch(
-            lambda: np.asarray(
-                sweeps.lut3_stream(*args, 0, total, seed, chunk=chunk)
-            ),
+            lambda: np.asarray(ctx.kernel_call(
+                "lut3_stream", dict(chunk=chunk),
+                (*args, 0, total, seed), g=g,
+            )),
             "lut3.stream",
         )
         ctx.stats["lut3_candidates"] += int(v[4])
@@ -195,12 +196,16 @@ def _solve_lut5_rows(
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
-            lambda a=p1, b=p0: np.asarray(sweeps.lut5_solve(
-                ctx.place_chunk(a, fill=0xFFFFFFFF),
-                ctx.place_chunk(b, fill=0xFFFFFFFF),
-                jw,
-                jm,
-                seed,
+            lambda a=p1, b=p0: np.asarray(ctx.kernel_call(
+                "lut5_solve", {},
+                (
+                    ctx.place_chunk(a, fill=0xFFFFFFFF),
+                    ctx.place_chunk(b, fill=0xFFFFFFFF),
+                    jw,
+                    jm,
+                    seed,
+                ),
+                g=st.num_gates,
             )),
             "lut5.solve",
         )
@@ -362,7 +367,7 @@ def _lut5_search_pivot(
     g = st.num_gates
     tl, th = pivot_tile_shape(g)
     excl = [b for b in inbits if b >= 0]
-    dev_tables, _ = ctx.device_tables(st)
+    dev_tables = ctx.device_tables(st)
     ops = PivotOperands(
         g, tl, th, excl, dev_tables, target, mask, ctx.place_replicated
     )
@@ -390,9 +395,9 @@ def _lut5_search_pivot(
     def redrive_tile(t_over: int) -> Optional[dict]:
         """Overflow fallback: fetch one tile's full feasibility data and
         solve every feasible tuple (no in-kernel row cap)."""
-        feas, r1, r0 = sweeps.lut5_pivot_tile(
-            tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over,
-            tl=tl, th=th,
+        feas, r1, r0 = ctx.kernel_call(
+            "lut5_pivot_tile", dict(tl=tl, th=th),
+            (tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over), g=g,
         )
         # jaxlint: ignore[R2x] deliberate compact-verdict sync: the pivot tile's feasibility bitmap must reach the host to drive redrive/solve
         rows = np.nonzero(np.asarray(feas))[0]
@@ -483,14 +488,19 @@ def _lut5_search_pivot(
         seed = ctx.next_seed()
         v = ctx.guarded_dispatch(
             # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
-            lambda s=start_t: np.asarray(sweeps.lut5_pivot_stream(
-                tables, lc1, lc0, hc, jlv, jhv, jdescs, s, t_real,
-                jw, jm, seed, tl=tl, th=th,
-                tile_batch=(
-                    1 if backend.startswith("pallas")
-                    else pivot_tile_batch()
+            lambda s=start_t: np.asarray(ctx.kernel_call(
+                "lut5_pivot_stream",
+                dict(
+                    tl=tl, th=th,
+                    tile_batch=(
+                        1 if backend.startswith("pallas")
+                        else pivot_tile_batch()
+                    ),
+                    pipeline=pivot_pipeline(), backend=backend,
                 ),
-                pipeline=pivot_pipeline(), backend=backend,
+                (tables, lc1, lc0, hc, jlv, jhv, jdescs, s, t_real,
+                 jw, jm, seed),
+                g=g,
             )),
             "lut5.pivot",
         )
@@ -629,8 +639,9 @@ def _lut5_stream_loop(
         seed = ctx.next_seed()
         v = ctx.guarded_dispatch(
             # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
-            lambda s=start: np.asarray(sweeps.lut5_stream(
-                *args, s, total, jw, jm, seed, chunk=chunk
+            lambda s=start: np.asarray(ctx.kernel_call(
+                "lut5_stream", dict(chunk=chunk),
+                (*args, s, total, jw, jm, seed), g=g,
             )),
             "lut5.stream",
         )
@@ -761,7 +772,7 @@ def _host_feasible_chunks(
     (depth=1) loops.  Drivers iterate under ``contextlib.closing`` so an
     early exit unwinds the generator and joins the producer promptly."""
     g = st.num_gates
-    tables, _ = ctx.device_tables(st)
+    tables = ctx.device_tables(st)
     jtarget, jmask = ctx.place_replicated(target), ctx.place_replicated(mask)
     excl = [b for b in inbits if b >= 0]
     stream = comb.CombinationStream(g, k)
@@ -781,8 +792,11 @@ def _host_feasible_chunks(
                     break
                 padded, nvalid = item
                 valid = ctx.place_chunk(np.arange(csize) < nvalid)
-                feas, req1p, req0p = sweeps.lut_filter(
-                    tables, ctx.place_chunk(padded), valid, jtarget, jmask
+                feas, req1p, req0p = ctx.kernel_call(
+                    "lut_filter", {},
+                    (tables, ctx.place_chunk(padded), valid, jtarget,
+                     jmask),
+                    g=g,
                 )
                 # Compact per-chunk verdict: pad rows are invalid and so
                 # never feasible, so any(feas) == any(feas[:csize]).
@@ -1011,11 +1025,12 @@ def lut7_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     if len(combos) == 0:
         return None
     with ctx.prof.phase("lut7.stageB"):
-        return _lut7_solve_hits(ctx, combos, req1, req0)
+        return _lut7_solve_hits(ctx, combos, req1, req0, g=st.num_gates)
 
 
 def _lut7_solve_hits(
-    ctx: SearchContext, combos: np.ndarray, req1: np.ndarray, req0: np.ndarray
+    ctx: SearchContext, combos: np.ndarray, req1: np.ndarray,
+    req0: np.ndarray, g: Optional[int] = None,
 ) -> Optional[dict]:
     """Stage B: sweep (ordering x outer x middle) function space over the
     collected hit list (reference: lut.c:416-475)."""
@@ -1032,12 +1047,16 @@ def _lut7_solve_hits(
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
-            lambda a=r1, b=r0: np.asarray(sweeps.lut7_solve(
-                ctx.place_chunk(a, fill=0xFFFFFFFF),
-                ctx.place_chunk(b, fill=0xFFFFFFFF),
-                jidx,
-                jpp,
-                seed,
+            lambda a=r1, b=r0: np.asarray(ctx.kernel_call(
+                "lut7_solve", {},
+                (
+                    ctx.place_chunk(a, fill=0xFFFFFFFF),
+                    ctx.place_chunk(b, fill=0xFFFFFFFF),
+                    jidx,
+                    jpp,
+                    seed,
+                ),
+                g=g,
             )),
             "lut7.solve",
         )

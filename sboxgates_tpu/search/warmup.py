@@ -1,0 +1,591 @@
+"""Compile-latency subsystem: kernel registry, background warmup, and the
+persistent compilation cache.
+
+The search grows ``st.num_gates`` through shape buckets
+(:data:`sboxgates_tpu.search.context.BUCKETS`); every bucket crossing
+changes the padded table shapes and therefore recompiles the whole jitted
+sweep ladder in :mod:`sboxgates_tpu.ops.sweeps` — on real silicon each XLA
+compile is seconds, paid **mid-search, on the critical path**.  Three
+coordinated parts eliminate that latency:
+
+1. **Persistent compilation cache** (:func:`configure_compile_cache`):
+   ``jax_compilation_cache_dir`` behind ``Options.compile_cache`` /
+   ``--compile-cache DIR`` / ``SBG_COMPILE_CACHE``, so restarts and
+   ``--resume-run`` deserialize every previously built executable instead
+   of recompiling it.
+
+2. **Kernel registry** (:data:`KERNELS` / :func:`kernel` /
+   :func:`warm_specs`): ONE declarative table mapping registry names to
+   the jitted sweep entry points, their static-argument names, and — for
+   the bucket-shaped kernels — a warm-spec enumerator that reproduces the
+   exact (statics, arg avals) the live drivers dispatch at a given gate
+   count.  The drivers (``search/context.py`` dispatch methods consumed by
+   ``lut.py``/``kwan.py``) fetch their kernels *from this registry* via
+   :meth:`SearchContext.kernel_call`, so the warmed set cannot drift from
+   the live call sites: a driver cannot dispatch a kernel the registry
+   does not know, and the registry validates statics against the jitted
+   function's own ``static_argnames``.
+
+3. **KernelWarmer**: a background daemon thread that, on entry to bucket
+   *b*, AOT-compiles (``fn.lower(ShapeDtypeStruct...).compile()``) the
+   sweep-kernel set for the NEXT bucket off the critical path.  Warmup
+   only compiles, never executes — results are bit-identical with it on
+   or off.  Warmed executables are handed back to the dispatchers through
+   :meth:`KernelWarmer.lookup`; a hit calls the AOT ``Compiled`` object
+   directly, which performs **zero** tracing and zero compilation (the
+   basis of the strict ``recompile_guard`` bucket-transition test).
+
+Pivot-structured kernels (``lut5_pivot_stream`` / ``lut5_pivot_tile``) are
+registered (so their dispatches flow through the same accounting) but not
+warmable: their operand shapes are keyed to the exact gate count via the
+pair grids, not to the bucket, so there is no "next bucket" shape to build
+ahead of time — the persistent cache still covers them across restarts.
+
+Mesh runs keep the lazy path: warmed avals would need the run's sharding
+layouts, and GSPMD compiles are exactly the executables the persistent
+cache is for.
+
+Thread-safety: the warmer's shared state (compiled map, schedule, stats)
+is guarded by one lock; the worker is registered as a jaxlint thread root
+(``[tool.jaxlint] thread_roots``) and the whole-program R4x pass checks
+the discipline.  A failed or hung background compile (fault site
+``warmup.compile``) degrades to the ordinary lazy-compile behavior — the
+search never blocks on the warmer, and shutdown joins with a bounded
+deadline.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import combinatorics as comb
+from ..ops import sweeps
+from ..resilience.faults import fault_point
+
+logger = logging.getLogger(__name__)
+
+#: Truth-table row: 8 little-endian uint32 words (core.ttable.N_WORDS).
+_N_WORDS = 8
+
+
+# -------------------------------------------------------------------------
+# Persistent compilation cache
+# -------------------------------------------------------------------------
+
+
+def compile_cache_dir(
+    explicit: Optional[str] = None, output_dir: Optional[str] = None
+) -> Optional[str]:
+    """Resolves the persistent-compile-cache directory: the explicit
+    setting (``--compile-cache`` / ``Options.compile_cache``) wins, then
+    ``SBG_COMPILE_CACHE``, then an ``xla_cache/`` subdir of the run's
+    output directory.  Returns None (cache off) when nothing is set; an
+    explicit empty string or ``SBG_COMPILE_CACHE=""`` disables it."""
+    if explicit is not None:
+        return explicit or None
+    env = os.environ.get("SBG_COMPILE_CACHE")
+    if env is not None:
+        return env or None
+    if output_dir is not None:
+        return os.path.join(output_dir, "xla_cache")
+    return None
+
+
+def configure_compile_cache(path: Optional[str]) -> Optional[str]:
+    """Points jax's persistent compilation cache at ``path`` (created if
+    missing) and removes the size/time floors so every sweep-kernel
+    executable is cached — a restarted or ``--resume-run`` search then
+    deserializes instead of recompiling.  No-op on None.  Safe to call
+    before any kernel compiles; returns the applied path."""
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Default floors skip tiny/fast executables; the whole point here is
+    # that EVERY ladder kernel (some compile in <1s on CPU but seconds on
+    # real silicon) is reusable next run.
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return path
+
+
+# -------------------------------------------------------------------------
+# Kernel registry
+# -------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KernelDef:
+    """One registry entry: the jitted sweep entry point (resolved from
+    :mod:`sboxgates_tpu.ops.sweeps` at call time, so test seams that
+    monkeypatch the module keep working) and its static-arg names.
+
+    ``warmable`` marks bucket-shaped kernels :func:`warm_specs` can build
+    ahead of time; pivot kernels are registered but not warmable (shapes
+    keyed to the exact g, not the bucket)."""
+
+    name: str
+    static_names: Tuple[str, ...]
+    warmable: bool = True
+
+    @property
+    def fn(self) -> Callable:
+        return getattr(sweeps, self.name)
+
+
+#: Name -> definition for every jitted sweep entry point the drivers
+#: dispatch (registry names ARE the sweeps attribute names).
+#: ``search/context.py`` builds its kernels from this table (see
+#: ``SearchContext.kernel_call``), so a dispatch of an unregistered
+#: kernel is impossible by construction.
+KERNELS: Dict[str, KernelDef] = {
+    d.name: d
+    for d in (
+        KernelDef("gate_step_stream", ("chunk3", "has_not", "has_triple")),
+        KernelDef("lut_step_stream",
+                  ("chunk3", "chunk5", "has5", "solve_rows")),
+        KernelDef("lut7_step_stream", ("chunk7", "solve7")),
+        KernelDef("lut3_stream", ("chunk",)),
+        KernelDef("lut5_stream", ("chunk", "solve_rows")),
+        KernelDef("feasible_stream", ("k", "chunk")),
+        KernelDef("lut_filter", ()),
+        KernelDef("lut5_solve", ()),
+        KernelDef("lut7_solve", ()),
+        KernelDef("tuple_match_sweep", ("num_cells",)),
+        KernelDef("match_stream", ("k", "chunk", "num_cells")),
+        KernelDef("lut5_pivot_stream",
+                  ("tl", "th", "solve_rows", "tile_batch", "pipeline",
+                   "backend"),
+                  warmable=False),
+        KernelDef("lut5_pivot_tile", ("tl", "th"), warmable=False),
+    )
+}
+
+
+def kernel(name: str, statics: dict) -> Callable:
+    """The statically-bound jitted callable for a registry entry — the
+    single source both the live dispatchers and the warmer compile from.
+    Validates the static names against the registry so a drifted call
+    site fails loudly instead of silently retracing."""
+    import functools
+
+    d = KERNELS[name]
+    unknown = set(statics) - set(d.static_names)
+    if unknown:
+        raise TypeError(
+            f"kernel {name!r} does not take static args {sorted(unknown)}; "
+            f"registry declares {d.static_names}"
+        )
+    return functools.partial(d.fn, **statics) if statics else d.fn
+
+
+def arg_signature(args: Sequence) -> tuple:
+    """Hashable shape/dtype signature of positional kernel operands —
+    the warm-cache key half that pins the compiled executable to the
+    exact avals the dispatch traces.  Arrays sign as (shape, dtype);
+    Python scalars by type (they become weak-typed avals, distinct from
+    an equal-valued numpy scalar)."""
+    out = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            out.append((tuple(shape), str(dtype)))
+        else:
+            out.append((type(a).__name__,))
+    return tuple(out)
+
+
+def warm_key(name: str, statics: dict, args: Sequence) -> tuple:
+    return (name, tuple(sorted(statics.items())), arg_signature(args))
+
+
+@dataclass(frozen=True)
+class WarmSpec:
+    """One ahead-of-time compile target: registry name, the exact static
+    args, and positional avals (ShapeDtypeStructs for arrays, concrete
+    Python scalars for weak-typed operands)."""
+
+    name: str
+    statics: tuple  # sorted (name, value) items — hashable
+    avals: tuple
+
+    @property
+    def key(self) -> tuple:
+        return (self.name, self.statics, arg_signature(self.avals))
+
+
+@dataclass
+class WarmPlan:
+    """Configuration snapshot the warm-spec enumerator needs — captured
+    from the context on the MAIN thread so the worker never touches live
+    context state."""
+
+    lut_graph: bool
+    has_not: bool  # gate-mode NOT-augmented pair table present
+    pair_table: Tuple[tuple, str]  # (shape, dtype) of the match tables
+    not_table: Optional[Tuple[tuple, str]]
+    triple_table: Tuple[tuple, str]
+
+    @classmethod
+    def from_context(cls, ctx) -> "WarmPlan":
+        def sd(a):
+            return (tuple(a.shape), str(a.dtype))
+
+        return cls(
+            lut_graph=ctx.opt.lut_graph,
+            has_not=bool(ctx.not_entries) and not ctx.opt.lut_graph,
+            pair_table=sd(ctx.pair_table_np),
+            not_table=(
+                sd(ctx.not_table_np) if ctx.not_table_np is not None else None
+            ),
+            triple_table=sd(ctx.triple_table_np),
+        )
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def warm_specs(plan: WarmPlan, g: int) -> List[WarmSpec]:
+    """The sweep-kernel set the drivers dispatch at gate count ``g`` (a
+    bucket's entry point), as AOT-compile targets.
+
+    This mirrors — and is tested for parity against — the static-arg and
+    operand-shape choices of the live dispatch sites: the fused node
+    heads (``ctx.gate_step`` / ``ctx.lut_step`` / ``ctx.lut7_step``), the
+    standalone LUT streams, the feasible-stream resume loops, and the
+    decomposition solvers."""
+    # Deferred: context imports this module at top level.
+    from . import context as C
+
+    specs: List[WarmSpec] = []
+    b = C.bucket_size(g)
+    npairs = b * (b - 1) // 2
+    tables = _sds((b, _N_WORDS), np.uint32)
+    valid_g = _sds((b,), np.bool_)
+    combos = _sds((npairs, 2), np.int32)
+    pair_valid = _sds((npairs,), np.bool_)
+    bt = sweeps.binom_table()
+    binom = _sds(bt.shape, bt.dtype)
+    tgt = _sds((_N_WORDS,), np.uint32)
+    excl = _sds((8,), np.int32)
+    # Python scalars: weak-typed avals, exactly like the live call sites'
+    # int operands (g, totals, start, seed).
+    gi, start, seed = 0, 0, 0
+
+    def add(name, statics, avals):
+        specs.append(WarmSpec(name, tuple(sorted(statics.items())), avals))
+
+    total3 = comb.n_choose_k(g, 3)
+    chunk3 = C.pick_chunk(max(total3, 1), C.STREAM_CHUNK[3])
+
+    if not plan.lut_graph:
+        nt = plan.not_table if plan.has_not else plan.pair_table
+        add(
+            "gate_step_stream",
+            dict(chunk3=chunk3, has_not=plan.has_not, has_triple=g >= 3),
+            (tables, valid_g, combos, pair_valid, binom, gi, tgt, tgt,
+             excl, total3, _sds(*plan.pair_table), _sds(*nt),
+             _sds(*plan.triple_table), seed),
+        )
+        return specs
+
+    # LUT mode: fused head + the standalone streams/solvers it hands off
+    # to at this gate count.
+    total5 = comb.n_choose_k(g, 5)
+    has5 = C.lut_head_has5(g)
+    chunk5 = C.pick_chunk(max(total5, 1), C.STREAM_CHUNK[5]) if has5 else 1024
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw, jm = _sds(w_tab.shape, w_tab.dtype), _sds(m_tab.shape, m_tab.dtype)
+    add(
+        "lut_step_stream",
+        dict(chunk3=chunk3, chunk5=chunk5, has5=has5,
+             solve_rows=C.LUT5_HEAD_SOLVE_ROWS),
+        (tables, valid_g, combos, pair_valid, binom, gi, tgt, tgt, excl,
+         total3, total5, _sds(*plan.pair_table), jw, jm, seed),
+    )
+    if g >= 3:
+        # Standalone fused 3-LUT stream (lut3_search outside the head).
+        add("lut3_stream", dict(chunk=chunk3),
+            (tables, binom, gi, tgt, tgt, excl, start, total3, seed))
+    if g >= 5 and total5 < C.PIVOT_MIN_TOTAL:
+        chunk5s = C.pick_chunk(total5, C.STREAM_CHUNK[5])
+        add("lut5_stream", dict(chunk=chunk5s),
+            (tables, binom, gi, tgt, tgt, excl, start, total5, jw, jm,
+             seed))
+        # Overflow re-drive of one flagged chunk (two-phase path).
+        add("feasible_stream", dict(k=5, chunk=chunk5s),
+            (tables, binom, gi, tgt, tgt, excl, start, total5))
+        # The packed-cell decomposition solver, at both compiled pads.
+        for rows in (C.CHUNK_SIZES[0], C.LUT5_SOLVE_CHUNK):
+            req = _sds((rows,), np.uint32)
+            add("lut5_solve", {}, (req, req, jw, jm, seed))
+    if g >= 7:
+        total7 = comb.n_choose_k(g, 7)
+        chunk7 = C.pick_chunk(max(total7, 1), C.STREAM_CHUNK[7])
+        idx_tab, pp_tab = sweeps.lut7_pair_tables()
+        jidx = _sds(idx_tab.shape, idx_tab.dtype)
+        jpp = _sds(pp_tab.shape, pp_tab.dtype)
+        if C.lut_head_has7(g):
+            add("lut7_step_stream",
+                dict(chunk7=chunk7, solve7=C.LUT7_HEAD_SOLVE_ROWS),
+                (tables, binom, gi, tgt, tgt, excl, total7, jidx, jpp,
+                 seed))
+        elif sweeps.device_rank_limit(g, 7):
+            # Staged path stage A: the chunked feasible stream.
+            add("feasible_stream", dict(k=7, chunk=chunk7),
+                (tables, binom, gi, tgt, tgt, excl, start, total7))
+        else:
+            # Rank past int32 (g >= 76): stage A runs the host-chunked
+            # driver, whose device work is the lut_filter dispatches.
+            csize = C.pick_chunk(total7, C.LUT7_CHUNK)
+            add("lut_filter", {},
+                (tables, _sds((csize, 7), np.int32),
+                 _sds((csize,), np.bool_), tgt, tgt))
+        # Stage B solver at its smallest pad (the native stage-A hybrid
+        # and small hit lists; larger pads compile lazily on first use).
+        r7 = _sds((C.LUT7_SOLVE_SIZES[0], 4), np.uint32)
+        add("lut7_solve", {}, (r7, r7, jidx, jpp, seed))
+    return specs
+
+
+# -------------------------------------------------------------------------
+# Background warmer
+# -------------------------------------------------------------------------
+
+#: Process-wide warmed-executable cache, shared by every KernelWarmer:
+#: AOT executables are keyed purely on (kernel, statics, avals), so a
+#: second context with the same configuration reuses the first's compiles
+#: instead of re-warming.  Every access holds _WARM_LOCK.
+_WARM_LOCK = threading.Lock()
+_WARM_COMPILED: Dict[tuple, Callable] = {}
+
+
+def drop_warm_cache() -> None:
+    """Clears the process-wide warmed-executable cache (tests)."""
+    with _WARM_LOCK:
+        _WARM_COMPILED.clear()
+
+
+def next_bucket(b: int) -> Optional[int]:
+    from . import context as C
+
+    for nb in C.BUCKETS:
+        if nb > b:
+            return nb
+    return None
+
+
+#: Seconds the warm worker idles on an empty queue before retiring
+#: itself (a later schedule spawns a fresh one).  Without this, every
+#: warmup-enabled context in a long-lived library process would park one
+#: daemon thread forever after its warm set finished.
+WORKER_IDLE_EXIT_S = 60.0
+
+
+class KernelWarmer:
+    """Background ahead-of-time compiler for the next bucket's kernels.
+
+    Dispatch sites report their gate count through :meth:`note_gates`
+    (via ``SearchContext.kernel_call``); the first dispatch inside bucket
+    *b* schedules an AOT compile of bucket ``next(b)``'s warm-spec set on
+    a daemon worker.  :meth:`lookup` hands a warmed ``Compiled`` back to
+    the dispatcher — calling it performs no tracing and no compilation,
+    so a warmed bucket transition is compile-free under a strict
+    ``recompile_guard``.
+
+    All shared state (compiled map, schedule, stats) lives under one
+    lock; the public API never blocks on a compile.  A failed compile
+    (``warmup.compile`` fault site, or any real error) is counted and
+    skipped — the dispatcher simply falls back to lazy compilation.
+    """
+
+    def __init__(self, plan: WarmPlan, enabled: bool = True):
+        self.plan = plan
+        self.enabled = enabled and os.environ.get("SBG_WARMUP", "1") != "0"
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._scheduled: set = set()   # buckets queued or done
+        self._inflight = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # Worker-side telemetry; dispatch-side hit/miss tallies live in
+        # ctx.stats (kernel_call) — ONE owner per counter, so the -vv
+        # report and the warmup: line can never disagree.
+        self.stats = {
+            "warm_compiled": 0,
+            "warm_failed": 0,
+        }
+
+    # -- main-thread API ---------------------------------------------------
+
+    def note_gates(self, g: Optional[int]) -> None:
+        """Bucket-entry hook: called by every registry dispatch that
+        knows its gate count.  Cheap when nothing new (one lock'd set
+        probe); schedules the next bucket's warm set otherwise, for the
+        first gate count the drivers will dispatch after crossing the
+        boundary."""
+        if not self.enabled or g is None:
+            return
+        from . import context as C
+
+        b = C.bucket_size(g)
+        if next_bucket(b) is None:
+            return
+        self._schedule(("bucket", b), b + 1)
+
+    def prewarm(self, g: Optional[int]) -> None:
+        """Schedules an AOT build of gate count ``g``'s OWN kernel set
+        (vs :meth:`note_gates`' next-bucket trigger): with a persistent
+        compile cache, a restarted or resumed search rebuilds its current
+        bucket's executables off the critical path — time-to-first-
+        dispatch pays a cache deserialize in the background instead of a
+        compile in the foreground."""
+        if self.enabled and g is not None:
+            self._schedule(("exact", g), g)
+
+    def _schedule(self, key, g: int) -> None:
+        with self._cv:
+            if key in self._scheduled or self._stop:
+                return
+            self._scheduled.add(key)
+            self._queue.append(g)
+            self._inflight += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._work, name="sbg-warmup", daemon=True
+                )
+                self._thread.start()
+            self._cv.notify()
+
+    def lookup(self, name: str, statics: dict, args: Sequence):
+        """The warmed executable for this exact dispatch, or None (lazy
+        path).  Hit/miss accounting is the caller's (kernel_call tallies
+        into ctx.stats, warmable kernels only)."""
+        if not self.enabled:
+            return None
+        key = warm_key(name, statics, args)
+        with _WARM_LOCK:
+            return _WARM_COMPILED.get(key)
+
+    def count(self, key: str) -> None:
+        """Bumps one telemetry counter under the warmer lock (used by the
+        dispatchers for events the warmer itself cannot see, e.g. an aval
+        mismatch surfacing at call time)."""
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["warm_inflight"] = self._inflight
+            return out
+
+    def wait_idle(self, timeout: float) -> bool:
+        """Blocks until every scheduled warm finished (tests/bench); True
+        when idle, False on timeout."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._inflight == 0 and not self._queue, timeout
+            )
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        """Deadline-bounded join: a worker parked in a hung compile (the
+        ``warmup.compile`` hang injection, or a wedged backend) is simply
+        abandoned — it is a daemon thread and never blocks process
+        exit."""
+        with self._cv:
+            self._stop = True
+            # Queued-but-unstarted buckets will never run: release their
+            # in-flight claims so wait_idle/stats stay truthful.
+            self._inflight -= len(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    # -- worker ------------------------------------------------------------
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                got = self._cv.wait_for(
+                    lambda: self._queue or self._stop,
+                    timeout=WORKER_IDLE_EXIT_S,
+                )
+                if self._stop:
+                    return
+                if not got:
+                    # Idle long enough: retire (under the lock, so a
+                    # concurrent _schedule either sees this thread alive
+                    # or spawns a successor — never neither).
+                    self._thread = None
+                    return
+                g = self._queue.popleft()
+            try:
+                self._warm_bucket(g)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _warm_bucket(self, g: int) -> None:
+        try:
+            specs = warm_specs(self.plan, g)
+        except Exception as e:
+            # Spec enumeration failing must degrade exactly like a failed
+            # compile — counted and skipped — never kill the worker (a
+            # dead singleton thread would silently disable warmup for the
+            # rest of the run while _schedule kept queueing onto it).
+            logger.warning(
+                "warm-spec enumeration for g=%d failed (%s); skipping "
+                "this warm set", g, e
+            )
+            self.count("warm_failed")
+            return
+        for spec in specs:
+            with self._lock:
+                if self._stop:
+                    return
+            with _WARM_LOCK:
+                if spec.key in _WARM_COMPILED:
+                    continue
+            try:
+                # Fault site: raise degrades this spec to lazy compile,
+                # hang parks this daemon worker forever (the search is
+                # untouched; shutdown abandons it after the bounded
+                # join).
+                fault_point("warmup.compile")
+                # .lower on the registry's underlying jitted fn (the
+                # partial bound by kernel() has no AOT surface); statics
+                # ride as keywords exactly as the live call passes them.
+                compiled = KERNELS[spec.name].fn.lower(
+                    *spec.avals, **dict(spec.statics)
+                ).compile()
+            except Exception as e:
+                # Any failure means "no warm entry": the dispatcher lazy-
+                # compiles exactly as without a warmer.  Never propagate —
+                # a background compile must not be able to fail the search.
+                logger.warning(
+                    "background warmup of %s%s failed (%s); falling back "
+                    "to lazy compilation", spec.name, dict(spec.statics), e
+                )
+                self.count("warm_failed")
+                continue
+            with _WARM_LOCK:
+                _WARM_COMPILED[spec.key] = compiled
+            self.count("warm_compiled")

@@ -5,6 +5,7 @@ from .guards import (  # noqa: F401
     GuardReport,
     RecompileError,
     SyncError,
+    jit_cache_size,
     recompile_guard,
     sync_guard,
 )

@@ -42,6 +42,20 @@ class RecompileError(RuntimeError):
     """An unexpected jax.jit compilation happened inside a recompile_guard."""
 
 
+def jit_cache_size(fn) -> Optional[int]:
+    """Compiled-signature count of a ``jax.jit`` callable, or None when
+    unavailable — the same per-function counter :func:`recompile_guard`
+    uses for its ``fns=`` mode.  Unwraps ``functools.partial`` so a
+    statically-bound kernel reports its underlying jit cache.  The
+    compile-latency telemetry (``SearchContext.kernel_call``) samples
+    this around each lazy dispatch to attribute compile stalls."""
+    f = getattr(fn, "func", fn)
+    try:
+        return f._cache_size()
+    except AttributeError:
+        return None
+
+
 class SyncError(RuntimeError):
     """An unexpected host-device sync happened inside a sync_guard."""
 

@@ -27,12 +27,31 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
+# Background kernel warmup off by default: the suite builds hundreds of
+# small contexts, and each would otherwise schedule an AOT compile of the
+# next bucket's whole sweep ladder — background CPU work that slows every
+# test and contaminates timing-sensitive ones.  The dedicated warmup tests
+# re-enable it per-test (SBG_WARMUP=1 via monkeypatch before the context
+# is built).
+os.environ.setdefault("SBG_WARMUP", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
+
+
+@pytest.fixture(autouse=True)
+def _restore_compile_cache_dir():
+    """A CLI run with an explicit --output-dir points the persistent
+    compile cache there (by design); in-process cli.main() tests must not
+    leave the rest of the suite caching into deleted tmp directories."""
+    old = jax.config.jax_compilation_cache_dir
+    yield
+    if jax.config.jax_compilation_cache_dir != old:
+        jax.config.update("jax_compilation_cache_dir", old)
 
 
 @pytest.fixture

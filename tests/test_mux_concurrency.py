@@ -114,7 +114,11 @@ def test_run_mux_jobs_inline_error_joins_children(monkeypatch):
     def sweeping_job(cctx):
         # Blocks in rdv.submit until the pool quiesces — deadlocks
         # forever if the inline error path skips the suspend/join.
-        v = cctx._dispatch(("t",), lambda x: jnp.stack([x, x]), (np.int32(3),))
+        # (Direct submit: the synthetic kernel is not a warmup-registry
+        # entry, and the blocking behavior under test lives here.)
+        v = cctx.rdv.submit(
+            ("t",), lambda x: jnp.stack([x, x]), (np.int32(3),), ()
+        )
         return int(v[0])
 
     def bad_job(cctx):

@@ -21,6 +21,19 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+#: Compile-latency telemetry (search/warmup.py): legitimately differs
+#: between a native-routed context (no device dispatch, no jit compile)
+#: and its device twin — excluded from the sweep-verdict stat parity.
+_TELEMETRY_KEYS = frozenset((
+    "kernel_compiles", "compile_stall_s", "warm_hits", "warm_misses",
+    "table_uploads", "table_cache_hits",
+))
+
+
+def _sweep_stats(ctx) -> dict:
+    return {k: v for k, v in ctx.stats.items() if k not in _TELEMETRY_KEYS}
+
+
 def _state_bytes(st: State) -> bytes:
     """The serialized layout state_fingerprint absorbs (xmlio docstring)."""
     import struct
@@ -271,7 +284,7 @@ def test_gate_step_native_bitwise_matches_kernel(randomize, try_nots):
             assert got_n == got_d, (
                 f"case {case}: native {got_n} != kernel {got_d}"
             )
-        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        assert _sweep_stats(ctx_n) == _sweep_stats(ctx_d), f"case {case}"
         steps_seen.add(got_n[0])
     assert {1, 2, 3}.issubset(steps_seen), steps_seen
 
@@ -327,7 +340,7 @@ def test_gate_step_native_matches_kernel_large_bucket():
                 assert got_n[0] == 0
             else:
                 assert got_n == got_d
-            assert ctx_n.stats == ctx_d.stats
+            assert _sweep_stats(ctx_n) == _sweep_stats(ctx_d)
 
 
 @pytest.mark.parametrize("randomize", [False, True])
@@ -387,7 +400,7 @@ def test_lut_step_native_bitwise_matches_kernel(randomize):
             assert got_n == got_d, (
                 f"case {case}: native {got_n} != kernel {got_d}"
             )
-        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        assert _sweep_stats(ctx_n) == _sweep_stats(ctx_d), f"case {case}"
         steps_seen.add(got_n[0])
     assert {1, 4, 5}.issubset(steps_seen), steps_seen
 
@@ -460,7 +473,7 @@ def test_gate_step_native_not_pair_and_triple_verdicts():
             got_d = ctx_d.gate_step(st, target, mask)
             assert got_d[0] == want_step, (got_d, want_step, try_nots, seed)
             assert got_n == got_d, (got_n, got_d)
-            assert ctx_n.stats == ctx_d.stats
+            assert _sweep_stats(ctx_n) == _sweep_stats(ctx_d)
 
 
 def test_lut_step_native_overflow_parity():
@@ -495,7 +508,7 @@ def test_lut_step_native_overflow_parity():
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
 
     ctx = SearchContext(Options(seed=1, lut_graph=True))
-    tables, _ = ctx.device_tables(st)
+    tables = ctx.device_tables(st)
     b = tables.shape[0]
     combos = ctx._pair_combos(b)
     excl = ctx.excl_array([])
@@ -586,7 +599,7 @@ def test_lut7_step_native_matches_kernel(randomize):
         # full verdict parity — on misses too (the top feasible row's
         # rank/constraints and sigma=-1 are reproduced exactly)
         assert got_n == got_d, f"case {case}: {got_n} vs {got_d}"
-        assert ctx_n.stats == ctx_d.stats, f"case {case}"
+        assert _sweep_stats(ctx_n) == _sweep_stats(ctx_d), f"case {case}"
         statuses.add(got_d[0])
     assert {0, 1}.issubset(statuses), statuses
 

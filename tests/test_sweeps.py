@@ -513,7 +513,7 @@ def test_pivot_pallas_backend_bit_identical():
     assert pivot_tile_shape(g) == (256, 512)
     for tl, th in ((256, 512), (512, 512)):
         ctx = SearchContext(Options(seed=1, lut_graph=True, randomize=False))
-        dev_tables, _ = ctx.device_tables(st)
+        dev_tables = ctx.device_tables(st)
         ops = PivotOperands(
             g, tl, th, [], dev_tables, target, mask, ctx.place_replicated
         )
