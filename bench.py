@@ -641,6 +641,137 @@ def bench_cold_start() -> list:
     return entries
 
 
+def bench_fleet() -> list:
+    """Fleet-batched search ladder (BENCH_FLEET.json): jobs/hour and
+    per-round device dispatch counts at 1 vs 8 vs 64 jobs.
+
+    Two sections:
+
+    - ``fleet_dispatch_ladder`` — device-routed toy fleets, where every
+      node head dispatches: records total rendezvous device dispatches
+      (groups) per fleet size and the ratio vs the 1-job baseline.  The
+      O(N)->O(1) claim is ``dispatch_ratio_vs_1job`` staying O(1): a
+      fleet of N merges its same-kind sweeps, so total dispatches track
+      the LONGEST job, not the sum (acceptance: <= 2x at 8 jobs).
+    - ``fleet_des_jobs_ladder`` — the production configuration (8 DES
+      boxes, LUT mode, native-routed heads): jobs/hour at 1/8/64 jobs,
+      fleet vs the serial per-job loop (the t1 baseline measured in the
+      same window), with bit-equality of the per-box best gate counts
+      asserted between arms.
+    """
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.multibox import (
+        load_box_jobs,
+        search_boxes_one_output,
+    )
+
+    entries = []
+
+    # -- section 1: dispatch counts, device-routed toys ------------------
+    dev = dict(
+        seed=7, lut_graph=True, randomize=False, host_small_steps=False,
+        native_engine=False,
+    )
+
+    def run_toys(n_jobs):
+        from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+        boxes = toy_fleet_boxes(min(n_jobs, 8))
+        iters = max(1, n_jobs // len(boxes))
+        ctx = SearchContext(Options(fleet=True, iterations=iters, **dev))
+        t0 = time.perf_counter()
+        res = search_boxes_one_output(
+            ctx, boxes, 0, save_dir=None, log=lambda s: None,
+            batched="fleet",
+        )
+        dt = time.perf_counter() - t0
+        assert all(sts for sts in res.values())
+        return dt, ctx.stats
+
+    ladder = (1, 8, 16) if SMOKE else (1, 8, 64)
+    run_toys(ladder[1])  # warm the kernel shapes out of the timed arms
+    run_toys(ladder[0])
+    base_dispatches = None
+    for n_jobs in ladder:
+        dt, st = run_toys(n_jobs)
+        dispatches = st.get("device_dispatches", 0)
+        if base_dispatches is None:
+            base_dispatches = max(dispatches, 1)
+        entries.append({
+            "metric": f"fleet_dispatch_ladder_{n_jobs}job",
+            "unit": "device dispatches (total for the fleet)",
+            "value": dispatches,
+            "jobs": n_jobs,
+            "wall_s": round(dt, 3),
+            "jobs_per_hour": round(n_jobs / dt * 3600, 1),
+            "sweep_submits": st.get("fleet_submits", 0),
+            "merged_rows_per_dispatch": round(
+                st.get("fleet_lanes", 0)
+                / max(st.get("fleet_dispatches", 0), 1), 2,
+            ),
+            "dispatch_ratio_vs_1job": round(
+                dispatches / base_dispatches, 2
+            ),
+        })
+
+    # -- section 2: the DES fleet, production configuration --------------
+    paths = [os.path.join(HERE, f"sboxes/des_s{i}.txt") for i in range(1, 9)]
+
+    def run_des(n_jobs, fleet):
+        boxes = load_box_jobs(paths[: min(n_jobs, 8)])
+        iters = max(1, n_jobs // len(boxes))
+        ctx = SearchContext(Options(
+            seed=7, lut_graph=True, randomize=False, iterations=iters,
+            fleet=fleet,
+        ))
+        t0 = time.perf_counter()
+        res = search_boxes_one_output(
+            ctx, boxes, 0, save_dir=None, log=lambda s: None,
+            batched="fleet" if fleet else False,
+        )
+        dt = time.perf_counter() - t0
+        gates = {
+            n: (min(s.num_gates - s.num_inputs for s in sts) if sts else None)
+            for n, sts in res.items()
+        }
+        return dt, gates
+
+    des_ladder = (1, 8) if SMOKE else (1, 8, 64)
+    run_des(des_ladder[1], True)  # warm
+    headline = None
+    for n_jobs in des_ladder:
+        fdt, fgates = run_des(n_jobs, True)
+        sdt, sgates = run_des(n_jobs, False)
+        assert fgates == sgates, (fgates, sgates)
+        e = {
+            "metric": f"fleet_des_jobs_ladder_{n_jobs}job",
+            "unit": "jobs/hour",
+            "value": round(n_jobs / fdt * 3600, 1),
+            "jobs": n_jobs,
+            "wall_s": round(fdt, 3),
+            # t1 = the serial per-job loop, measured in this window.
+            "t1_jobs_per_hour": round(n_jobs / sdt * 3600, 1),
+            "t1_wall_s": round(sdt, 3),
+            "vs_t1": round(sdt / fdt, 3),
+            "gates": fgates,
+        }
+        entries.append(e)
+        if n_jobs == 8:
+            headline = e
+    entries.append({
+        "metric": "fleet_headline",
+        "unit": "jobs/hour (8-job DES fleet, t1-normalized)",
+        "value": headline["value"],
+        "vs_t1": headline["vs_t1"],
+        "dispatch_ratio_8job_vs_1job": next(
+            e["dispatch_ratio_vs_1job"] for e in entries
+            if e["metric"] == "fleet_dispatch_ladder_8job"
+        ),
+        "smoke": SMOKE,
+    })
+    return entries
+
+
 def bench_mesh_scaling() -> dict:
     """CPU-mesh relative scaling of the sharded pivot / feasible streams
     (VERDICT r3 item 3): spawns a subprocess pinned to CPU with 8 virtual
@@ -1762,6 +1893,22 @@ def main() -> None:
         return
     if "--cold-start-worker" in sys.argv:
         _cold_start_worker()
+        return
+    if "--fleet" in sys.argv:
+        # Standalone mode: the fleet-batched search ladder (jobs/hour +
+        # device dispatch counts at 1/8/64 jobs), written to
+        # BENCH_FLEET.json.  Honors JAX_PLATFORMS — on a CPU-only box
+        # run `JAX_PLATFORMS=cpu python bench.py --fleet` (optionally
+        # SBG_BENCH_SMOKE=1 for the short ladder).
+        if SMOKE:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_fleet()
+        with open(os.path.join(HERE, "BENCH_FLEET.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+        print(json.dumps(detail[-1]))
         return
     if "--cold-start" in sys.argv:
         # Standalone mode: cold vs warm persistent-compile-cache

@@ -680,6 +680,21 @@ def pivot_pair_grids(g: int):
     return lows, highs, offs
 
 
+def pivot_tile_count(g: int, tl: int, th: int) -> int:
+    """Exact row count :func:`pivot_tile_descs` produces for an
+    exclusion-free sweep at gate count ``g``, in closed form (no
+    descriptor materialization).  Exclusions only remove tiles, so this
+    is the per-bucket maximum a bucket-padded descriptor shape must
+    cover (search.lut.pivot_padded_shapes)."""
+    n = 0
+    for m in range(2, g - 2):
+        nlo = m * (m - 1) // 2
+        nhi = (g - 1 - m) * (g - 2 - m) // 2
+        if nlo and nhi:
+            n += -(-nlo // tl) * (-(-nhi // th))
+    return n
+
+
 def pivot_tile_descs(g: int, tl: int, th: int, excl=()) -> np.ndarray:
     """Tile descriptors [T, 5]: (pivot m, lo0, lo_end, hi0, hi_end) covering
     every 5-set exactly once (lo/hi are absolute rows into the grids)."""

@@ -1,6 +1,8 @@
 from .mesh import (  # noqa: F401
+    FleetPlan,
     MeshPlan,
     lut5_fused_step,
+    make_fleet_mesh,
     make_mesh,
     sharded_feasible_stream,
     sharded_pivot_stream,

@@ -57,6 +57,7 @@ from ..ops import sweeps
 
 CANDIDATES_AXIS = "candidates"
 RESTARTS_AXIS = "restarts"
+JOBS_AXIS = "jobs"
 
 # Multi-host gather budget: the compacted feasible-stream gather ships at
 # most this many rows per device over DCN instead of the whole chunk
@@ -94,6 +95,55 @@ def make_mesh(
     assert n % restarts == 0, (n, restarts)
     arr = np.asarray(devices).reshape(restarts, n // restarts)
     return Mesh(arr, (RESTARTS_AXIS, CANDIDATES_AXIS))
+
+
+def make_fleet_mesh(
+    devices: Optional[Sequence] = None, candidates: int = 1
+) -> Mesh:
+    """A 2-D ``(jobs, candidates)`` mesh for fleet-batched search: the
+    job batch axis of the stacked ``[jobs, bucket, 8]`` sweeps shards
+    over ``"jobs"`` (the partitioned-SPMD pjit pattern — one compiled
+    kernel, many problems), composing with the existing ``"candidates"``
+    axis for within-job candidate sharding.  Default puts every device
+    on the job axis (a fleet's parallelism lives in its jobs)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % candidates:
+        raise ValueError(f"{n} devices do not split into {candidates} "
+                         "candidate shards")
+    arr = np.asarray(devices).reshape(n // candidates, candidates)
+    return Mesh(arr, (JOBS_AXIS, CANDIDATES_AXIS))
+
+
+class FleetPlan:
+    """Sharding helper for the job batch axis (search.fleet): placement
+    of per-job stacked tensors (``P("jobs")`` on the leading axis) and
+    replicated operands on a :func:`make_fleet_mesh`.
+
+    Process-spanning fleet meshes are rejected for now: the fleet
+    dispatcher stacks host-produced per-job operands, which must stay
+    fully addressable — multi-host fleets run job-sharded instead
+    (``--shard-sweep``, one local fleet per process)."""
+
+    def __init__(self, mesh: Mesh):
+        if mesh_spans_processes(mesh):
+            raise ValueError(
+                "fleet meshes must be process-local; use --shard-sweep "
+                "to split a fleet across hosts"
+            )
+        self.mesh = mesh
+        self.n_job_shards = mesh.shape[JOBS_AXIS]
+        self._jobs = NamedSharding(mesh, P(JOBS_AXIS))
+        self._replicated = NamedSharding(mesh, P())
+
+    def shard_jobs(self, arr):
+        """Places a [jobs, ...] stacked tensor sharded on the job axis
+        (jobs must be a multiple of the job shards — the fleet buckets
+        guarantee it)."""
+        return jax.device_put(arr, self._jobs)
+
+    def replicate(self, arr):
+        return jax.device_put(arr, self._replicated)
 
 
 class MeshPlan:
@@ -259,15 +309,40 @@ def _sharded_stream_fn(mesh: Mesh, k: int, chunk: int, compact: bool = False):
     )
 
 
+def _mesh_warm_lookup(name: str, mesh: Mesh, statics: dict, args):
+    """Warmed sharded executable for this dispatch, or None.  Deferred
+    import: the warm registry lives in search/ and the cache is only
+    populated when a KernelWarmer runs under a pinned mesh."""
+    from ..search import warmup as _warmup
+
+    return _warmup.mesh_warm_lookup(name, mesh, statics, args)
+
+
 def sharded_feasible_stream(
     plan: "MeshPlan", tables, binom, g, target, mask, excl, start, total,
     *, k: int, chunk: int, compact: bool = False
 ):
     """Mesh-sharded counterpart of sweeps.feasible_stream (same contract
     single-host; see :func:`_sharded_stream_fn` for the multi-host
-    compact/full output contracts)."""
+    compact/full output contracts).  A mesh-shaped warm spec
+    (search.warmup.mesh_warm_specs) built for these exact avals serves
+    the dispatch with zero tracing; any signature drift falls back to
+    the lazy jit path."""
+    args = (tables, binom, g, target, mask, excl, start, total)
+    compiled = _mesh_warm_lookup(
+        "sharded_feasible_stream", plan.mesh,
+        dict(k=k, chunk=chunk, compact=compact), args,
+    )
+    if compiled is not None:
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # Aval drift raises TypeError; an input-SHARDING mismatch
+            # from an AOT Compiled call raises ValueError — either way
+            # the lazy path below is always correct.
+            pass
     fn = _sharded_stream_fn(plan.mesh, k, chunk, compact)
-    return fn(tables, binom, g, target, mask, excl, start, total)
+    return fn(*args)
 
 
 @functools.lru_cache(maxsize=None)
@@ -418,6 +493,16 @@ def _note_pallas_fallback(backend: str, stats) -> None:
         )
 
 
+def pivot_accum_name(backend: str) -> str:
+    """Count-matrix accumulation dtype name for a pivot backend — ONE
+    mapping shared by the live dispatch statics below and the mesh
+    warm-spec keys (warmup.mesh_warm_specs), so the two can never drift
+    apart and silently defeat the warm cache."""
+    return {
+        "xla_bf16": "bfloat16", "xla_f8": "float8_e4m3fn",
+    }.get(backend, "int32")
+
+
 def sharded_pivot_stream(
     plan: "MeshPlan", tables, lc1, lc0, hc, lowvalid, highvalid, descs,
     start_t, t_end, w_tab, m_tab, seed, *, tl: int, th: int,
@@ -452,16 +537,30 @@ def sharded_pivot_stream(
         backend = "xla"
     if backend not in ("xla", "xla_bf16", "xla_f8"):
         raise ValueError(f"unknown pivot backend {backend!r}")
-    accum_dtype = {
-        "xla_bf16": jnp.bfloat16, "xla_f8": jnp.float8_e4m3fn,
-    }.get(backend, jnp.int32)
-    fn = _sharded_pivot_fn(
-        plan.mesh, tl, th, solve_rows, bool(pipeline), accum_dtype
-    )
-    return fn(
+    accum = pivot_accum_name(backend)
+    accum_dtype = getattr(jnp, accum)
+    args = (
         tables, lc1, lc0, hc, lowvalid, highvalid, descs, start_t, t_end,
         w_tab, m_tab, seed,
     )
+    compiled = _mesh_warm_lookup(
+        "sharded_pivot_stream", plan.mesh,
+        dict(tl=tl, th=th, solve_rows=solve_rows, pipeline=bool(pipeline),
+             accum=accum),
+        args,
+    )
+    if compiled is not None:
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # Aval drift raises TypeError; an input-SHARDING mismatch
+            # from an AOT Compiled call raises ValueError — either way
+            # the lazy path below is always correct.
+            pass
+    fn = _sharded_pivot_fn(
+        plan.mesh, tl, th, solve_rows, bool(pipeline), accum_dtype
+    )
+    return fn(*args)
 
 
 def restart_batched_filter():

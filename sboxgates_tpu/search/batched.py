@@ -87,13 +87,15 @@ class Rendezvous:
         self._vmapped = vmap_cache if vmap_cache is not None else _VMAP_CACHE
         self.stats = {"submits": 0, "dispatches": 0, "batched_rows": 0}
 
-    def submit(self, key, kernel: Callable, args, shared=()) -> np.ndarray:
+    def submit(self, key, kernel: Callable, args, shared=(), g=None) -> np.ndarray:
         """``shared``: indices of args that are identical across restarts
         for this key (match tables, combo grids, ...) — mapped with
-        in_axes=None instead of being stacked R-way."""
+        in_axes=None instead of being stacked R-way.  ``g`` is the
+        submitting state's gate count (fleet warm-bucket detection; the
+        base rendezvous ignores it)."""
         entry = {
             "key": key, "kernel": kernel, "args": args,
-            "shared": tuple(shared), "done": False,
+            "shared": tuple(shared), "done": False, "g": g,
         }
         with self.cv:
             self.stats["submits"] += 1
@@ -314,6 +316,15 @@ def run_batched_circuits(
     always do (their later nodes make real dispatches worth merging —
     bench_batch_axis_pivot measures that regime)."""
     import os
+
+    # Fleet contexts route their job waves through the fleet dispatcher
+    # (fixed jobs buckets, warm fleet kernels, job-axis sharding) — same
+    # worker/seed discipline, so results are bit-identical to this
+    # driver given identical per-job outcomes.
+    if ctx.opt.fleet or ctx.fleet_plan is not None:
+        from .fleet import run_fleet_waves
+
+        return run_fleet_waves(ctx, jobs)
 
     n = len(jobs)
     rdv = Rendezvous(n)

@@ -199,6 +199,13 @@ class Options:
     # sweep executable instead of recompiling it.  None = leave jax's
     # configuration untouched.
     compile_cache: Optional[str] = None
+    # Fleet-batched execution (--fleet, search/fleet.py): concurrent
+    # jobs' same-kind node sweeps merge into ONE vmapped fleet-kernel
+    # dispatch padded to fixed jobs buckets, optionally pjit-sharded
+    # over a (jobs, candidates) mesh (SearchContext fleet_plan).  Routes
+    # the multibox/restart drivers through FleetRendezvous; per-round
+    # device round trips for an N-job fleet drop from O(N) to O(1).
+    fleet: bool = False
 
 
 @dataclass(frozen=True)
@@ -269,6 +276,13 @@ def _build_triple_table(funs: Sequence[bf.BoolFunc]):
     return table, entries
 
 
+def table_digest(live: np.ndarray) -> bytes:
+    """Content key of a live-table block — ONE digest definition shared
+    by the per-state device-table cache and the stacked fleet cache, so
+    their invalidation semantics can never diverge."""
+    return hashlib.blake2b(live.tobytes(), digest_size=16).digest()
+
+
 def bucket_size(n: int) -> int:
     for b in BUCKETS:
         if n <= b:
@@ -298,9 +312,23 @@ class SearchContext:
     candidate axis and small operands replicated; kernels are unchanged
     (GSPMD partitions them)."""
 
-    def __init__(self, opt: Options, mesh_plan=None):
+    def __init__(self, opt: Options, mesh_plan=None, fleet_plan=None):
         self.opt = opt
         self.mesh_plan = mesh_plan
+        # Fleet job-axis sharding (parallel.mesh.FleetPlan): exclusive
+        # with candidate-mesh execution — a fleet owns its devices
+        # through the stacked job axis, a MeshPlan through GSPMD
+        # candidate sharding; mixing them would double-book the chips.
+        if mesh_plan is not None and (fleet_plan is not None or opt.fleet):
+            # Rejected at construction so every driver behaves the same
+            # — the orchestrator would otherwise silently fall back to
+            # the serial restart loop while multibox raises.
+            raise ValueError(
+                "fleet execution and a candidate mesh are mutually "
+                "exclusive: the fleet shards the job axis over the mesh "
+                "itself (drop the MeshPlan, or Options.fleet)"
+            )
+        self.fleet_plan = fleet_plan
         self.rng = np.random.default_rng(opt.seed)
         self.avail_gates = bf.create_avail_gates(opt.avail_gates_bitfield)
         self.avail_not = (
@@ -365,6 +393,11 @@ class SearchContext:
             # guard counters.
             "dispatch_retries": 0,
             "deadline_breaches": 0,
+            # Every device dispatch, whichever path issues it: direct
+            # registry calls (kernel_call) and rendezvous/fleet groups.
+            # The fleet bench's O(N)->O(1) dispatch-count claim reads
+            # this.
+            "device_dispatches": 0,
             # Compile-latency subsystem (search/warmup.py): lazy jit
             # compiles taken on the dispatch path (with their stall time)
             # and warm-cache consults; per-kernel compile stalls land as
@@ -392,8 +425,22 @@ class SearchContext:
         # call is idempotent).
         if opt.compile_cache:
             _warmup.configure_compile_cache(opt.compile_cache)
+        # Stacked-fleet device-table cache (fleet_device_tables): placed
+        # [jobs_bucket, bucket, 8] stacks memoized on per-job content
+        # digests; shared BY REFERENCE with RestartContext views like
+        # the per-job table cache above.
+        from .fleet import FleetStackCache
+
+        self.fleet_stack = FleetStackCache()
         self.warmer = None
-        if mesh_plan is None and opt.warmup:
+        # A PINNED single-process mesh gets a warmer too (PR 6): its
+        # warm sets are the mesh-shaped sharded stream executables
+        # (warmup.mesh_warm_specs) — first-run GSPMD compiles move off
+        # the critical path, not just restarts via the persistent cache.
+        # Process-spanning meshes keep the lazy path (background compiles
+        # must not skew cross-host lockstep timing).
+        mesh_pinned = mesh_plan is None or not mesh_plan.spans_processes
+        if opt.warmup and mesh_pinned:
             warmer = _warmup.KernelWarmer(_warmup.WarmPlan.from_context(self))
             # SBG_WARMUP=0 disables globally (tests, bench timing loops);
             # keep None rather than a dead warmer so dispatch telemetry
@@ -567,7 +614,7 @@ class SearchContext:
         g = st.num_gates
         b = bucket_size(g)
         live = np.ascontiguousarray(st.live_tables())
-        key = (b, hashlib.blake2b(live.tobytes(), digest_size=16).digest())
+        key = (b, table_digest(live))
         with self._table_lock:
             hit = self._table_cache.get(key)
             if hit is not None:
@@ -594,11 +641,64 @@ class SearchContext:
         return bucket_size(st.num_gates)
 
     def invalidate_device_tables(self) -> None:
-        """Drops every memoized placed table (the next dispatch re-uploads).
-        The content-digest keys make this unnecessary for correctness; it
+        """Drops every memoized placed table — per-state AND stacked
+        fleet buffers — so the next dispatch re-uploads.  The
+        content-digest keys make this unnecessary for correctness; it
         exists for explicit lifecycle control (tests, device resets)."""
         with self._table_lock:
             self._table_cache.clear()
+        self.fleet_stack.clear()
+
+    def fleet_device_tables(
+        self, states, done=None, lanes: Optional[int] = None,
+        bucket: Optional[int] = None,
+    ):
+        """Stacked-fleet variant of :meth:`device_tables`: the whole
+        fleet's padded live tables as ONE placed ``[jobs_bucket, bucket,
+        8]`` tensor, job-sharded under a fleet plan and memoized on the
+        tuple of per-job content digests (``done`` lanes contribute
+        zeroed no-op rows, which keeps the digest tuple — and therefore
+        the resident stack — stable once a job retires).  Pad lanes past
+        the last job are zeros too."""
+        from .fleet import fleet_bucket
+
+        n = len(states)
+        done = [False] * n if done is None else list(done)
+        if bucket is None:
+            bucket = max(bucket_size(st.num_gates) for st in states)
+        if lanes is None:
+            shards = (
+                1 if self.fleet_plan is None
+                else self.fleet_plan.n_job_shards
+            )
+            lanes = fleet_bucket(n, shards)
+        rows = []
+        digs = []
+        for st, d in zip(states, done):
+            if d:
+                rows.append(None)
+                digs.append(b"retired")
+                continue
+            live = np.ascontiguousarray(st.live_tables())
+            digs.append(table_digest(live))
+            rows.append(live)
+        key = (lanes, bucket, tuple(digs))
+
+        def build():
+            stacked = np.zeros((lanes, bucket, 8), dtype=np.uint32)
+            for i, live in enumerate(rows):
+                if live is not None:
+                    stacked[i, : live.shape[0]] = live
+            self.stats["table_uploads"] += 1
+            if self.fleet_plan is not None:
+                return self.fleet_plan.shard_jobs(stacked)
+            return jnp.asarray(stacked)
+
+        before = self.fleet_stack.hits
+        out = self.fleet_stack.get_or_put(key, build)
+        if self.fleet_stack.hits > before:
+            self.stats["table_cache_hits"] += 1
+        return out
 
     def kernel_call(self, name: str, statics: dict, args: tuple, g=None):
         """Registry-routed jitted-kernel invocation (search/warmup.py):
@@ -613,6 +713,7 @@ class SearchContext:
         miss takes the ordinary lazy jit path, with the compile stall (if
         one happened) recorded in ``ctx.stats`` and as a
         ``compile[<kernel>]`` profiler row."""
+        self.stats["device_dispatches"] += 1
         warmer = self.warmer
         if warmer is not None:
             warmer.note_gates(g)
@@ -624,9 +725,12 @@ class SearchContext:
             if compiled is not None:
                 try:
                     return compiled(*args)
-                except TypeError as e:
+                except (TypeError, ValueError) as e:
                     # Aval drift between the warm spec and the live call
-                    # site — fall back to the lazy path (results are
+                    # site raises TypeError; a sharding mismatch from
+                    # the AOT Compiled call (fleet-committed operands vs
+                    # a sharding-less warm lowering) raises ValueError —
+                    # fall back to the lazy path (results are
                     # unaffected) and count it; the registry-parity test
                     # keeps this at zero.
                     warmer.count("warm_aval_mismatches")
@@ -659,12 +763,22 @@ class SearchContext:
     def place_chunk(self, arr, fill=0):
         """Shards a [N, ...] candidate array over the mesh (no-op without one)."""
         if self.mesh_plan is None:
+            # Fleet plans replicate chunks across the whole mesh so the
+            # job-sharded fleet kernels find every operand resident on
+            # every job shard (candidate sharding inside a fleet lane is
+            # the 2-D mesh's future axis).
+            if self.fleet_plan is not None:
+                # jaxlint: ignore[R2x] host->device placement of the host-produced chunk before fleet replication; the copy is the upload, not a sync
+                return self.fleet_plan.replicate(np.asarray(arr))
             return jnp.asarray(arr)
         # jaxlint: ignore[R2x] host->device placement normalizes the host-produced chunk before sharding; the copy is the upload, not a sync
         return self.mesh_plan.shard_chunk(np.asarray(arr), fill=fill)
 
     def place_replicated(self, arr):
         if self.mesh_plan is None:
+            if self.fleet_plan is not None:
+                # jaxlint: ignore[R2x] host->device placement of host-built tables before fleet replication; the copy is the upload, not a sync
+                return self.fleet_plan.replicate(np.asarray(arr))
             return jnp.asarray(arr)
         # jaxlint: ignore[R2x] host->device placement of host-built tables before replication; the copy is the upload, not a sync
         return self.mesh_plan.replicate(np.asarray(arr))
@@ -926,7 +1040,7 @@ class SearchContext:
         if self.rdv is not None and self.rdv.live > 1:
             key = _warmup.warm_key(name, statics, args)
             return self.rdv.submit(
-                key, _warmup.kernel(name, statics), args, shared
+                key, _warmup.kernel(name, statics), args, shared, g=g
             )
         return np.asarray(self.kernel_call(name, statics, args, g=g))
 

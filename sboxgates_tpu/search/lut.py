@@ -227,6 +227,28 @@ def _solve_lut5_rows(
     return None
 
 
+# Pivot g-buckets: every pivot operand shape (pair-grid pad, tile-desc
+# pad, tile shape) keys on the bucket covering the gate count, not the
+# exact g, so one compiled executable serves the whole bucket and the
+# pivot kernels become warmable (PR 5 left them registered-but-
+# unwarmable: _next_pow2(C(g,2)) crossings made the shapes unpredictable
+# at warm time).  The ladder is finer than context.BUCKETS because the
+# tile count grows ~g^5/(tl*th): padding a g=70 search to a 512 bucket
+# would carry ~1000x the descriptors, while a <=1.5x g step bounds the
+# padded-descriptor overhead at ~7.6x worst case right past a boundary.
+# Pad tiles are never executed (t_end stops the stream at the real tile
+# count and validity masks kill pad pair rows) — they cost descriptor
+# upload bytes only, and results are bit-identical to exact-g shapes.
+PIVOT_G_BUCKETS = (64, 96, 128, 192, 256, 384, 512)
+
+
+def pivot_g_bucket(g: int) -> int:
+    for b in PIVOT_G_BUCKETS:
+        if g <= b:
+            return b
+    raise ValueError(f"too many gates for the pivot sweep: {g}")
+
+
 # Pivot sweep tile shape (low x high pair block): trades MXU feed size
 # against padding waste on boundary tiles and the cache residency of the
 # [2, 4, tl, 4, th] int32 matmul intermediates.
@@ -235,10 +257,26 @@ def pivot_tile_shape(g: int) -> Tuple[int, int]:
     (512,512) runs 2.9G cand/s vs 1.9G for the old (512,1024), and at
     G=500 3.5G vs 2.6G — the wider tile's [2,4,tl,4,th] int32 matmul
     intermediates blow past useful cache/VMEM residency.  Below G=128 the
-    whole space is padding-dominated and shape barely matters."""
-    if g <= 128:
+    whole space is padding-dominated and shape barely matters.
+
+    Keyed on the pivot g-bucket (not exact g) so every search in a
+    bucket shares one compiled tile shape; 128 is a bucket edge, so the
+    selected shapes are unchanged from the per-g rule."""
+    if pivot_g_bucket(g) <= 128:
         return 256, 512
     return 512, 512
+
+
+def pivot_padded_shapes(g: int, tl: int, th: int) -> Tuple[int, int]:
+    """(pair-grid pad, tile-descriptor pad) for gate count ``g`` — the
+    bucket-keyed shapes every pivot operand pads to, shared by
+    :class:`PivotOperands` and the warm-spec enumerator
+    (search.warmup.warm_specs) so the warmed executables are exactly the
+    ones the live driver dispatches."""
+    b = pivot_g_bucket(g)
+    p2pad = _next_pow2(b * (b - 1) // 2 + max(tl, th))
+    tpad = _next_pow2(max(1, sweeps.pivot_tile_count(b, tl, th)))
+    return p2pad, tpad
 
 
 def pivot_tile_batch() -> int:
@@ -311,7 +349,8 @@ class PivotOperands:
     placement).
     """
 
-    def __init__(self, g, tl, th, excl, tables, target, mask, put):
+    def __init__(self, g, tl, th, excl, tables, target, mask, put,
+                 kernel_call=None):
         self.g, self.tl, self.th = g, tl, th
         lows, highs, _ = sweeps.pivot_pair_grids(g)
         self.lows, self.highs = lows, highs
@@ -327,8 +366,11 @@ class PivotOperands:
         self.size_cum = np.concatenate([[0], np.cumsum(tile_sizes)])
 
         p2 = lows.shape[0]
-        p2pad = _next_pow2(p2 + max(tl, th))
-        tpad = _next_pow2(self.t_real)
+        # Bucket-keyed pads (see PIVOT_G_BUCKETS): stable for every g in
+        # the bucket — and for every exclusion list, which only shrinks
+        # t_real — so the compiled pivot executables are warmable.
+        p2pad, tpad = pivot_padded_shapes(g, tl, th)
+        assert p2pad >= p2 + max(tl, th) and tpad >= self.t_real
         descs_p = np.zeros((tpad, 5), np.int32)
         descs_p[: self.t_real] = descs
         lowvalid = np.zeros(p2pad, bool)
@@ -343,9 +385,18 @@ class PivotOperands:
         self.tables = tables
         jt = put(np.asarray(target))
         jmk = put(np.asarray(mask))
-        self.lc1, self.lc0, self.hc = sweeps.pivot_pair_cells(
-            tables, put(lows_p), put(highs_p), jt, jmk
-        )
+        # Registry-routed when the caller passes its context's
+        # kernel_call (warm lookup + compile telemetry); the bare jitted
+        # kernel otherwise (bench microkernels).
+        if kernel_call is None:
+            self.lc1, self.lc0, self.hc = sweeps.pivot_pair_cells(
+                tables, put(lows_p), put(highs_p), jt, jmk
+            )
+        else:
+            self.lc1, self.lc0, self.hc = kernel_call(
+                "pivot_pair_cells", {},
+                (tables, put(lows_p), put(highs_p), jt, jmk), g=g,
+            )
         self.jdescs = put(descs_p)
         self.jlv = put(lowvalid)
         self.jhv = put(highvalid)
@@ -369,7 +420,8 @@ def _lut5_search_pivot(
     excl = [b for b in inbits if b >= 0]
     dev_tables = ctx.device_tables(st)
     ops = PivotOperands(
-        g, tl, th, excl, dev_tables, target, mask, ctx.place_replicated
+        g, tl, th, excl, dev_tables, target, mask, ctx.place_replicated,
+        kernel_call=ctx.kernel_call,
     )
     t_real = ops.t_real
     if t_real == 0:

@@ -113,9 +113,13 @@ MAX_WAVE_JOBS = 32
 def _run_jobs(
     ctx: SearchContext,
     jobs: List[Tuple[State, np.ndarray, np.ndarray]],
-    batched: bool,
+    batched,
 ) -> List[Tuple[State, int]]:
-    if batched and len(jobs) > 1:
+    if batched == "fleet" and len(jobs) > 1:
+        from .fleet import run_fleet_waves
+
+        return run_fleet_waves(ctx, jobs)
+    if batched and batched != "fleet" and len(jobs) > 1:
         from .batched import run_batched_circuits
 
         out = []
@@ -130,12 +134,23 @@ def _run_jobs(
 
 def _auto_batched(
     ctx: SearchContext,
-    batched: Optional[bool],
+    batched,
     boxes: Sequence[BoxJob] = (),
-) -> bool:
-    """Resolves ``batched=None``: serial under a mesh (GSPMD owns the
-    devices) or when the job family's measured default is serial
-    (BoxJob.prefer_serial — see permute_sweep_jobs); batched otherwise."""
+):
+    """Resolves the execution mode: ``"fleet"`` when the context is
+    fleet-configured (Options.fleet / a FleetPlan) or the caller passes
+    ``batched="fleet"`` explicitly; otherwise ``batched=None`` resolves
+    serial under a mesh (GSPMD owns the devices) or when the job
+    family's measured default is serial (BoxJob.prefer_serial — see
+    permute_sweep_jobs), batched elsewhere."""
+    fleet_ctx = ctx.opt.fleet or ctx.fleet_plan is not None
+    if batched == "fleet" or (batched is None and fleet_ctx):
+        if ctx.mesh_plan is not None:
+            raise ValueError(
+                "fleet execution shards the job axis over its own mesh "
+                "and cannot run under a candidate mesh; drop --mesh"
+            )
+        return "fleet"
     if batched is None:
         if ctx.mesh_plan is not None:
             return False
@@ -146,6 +161,12 @@ def _auto_batched(
             "under a mesh (GSPMD owns the devices); pass batched=False"
         )
     return batched
+
+
+def _mode_name(batched) -> str:
+    if batched == "fleet":
+        return "fleet"
+    return "batched" if batched else "serial"
 
 
 def _save_dir_for(save_dir: Optional[str], name: str) -> Optional[str]:
@@ -191,7 +212,7 @@ def search_boxes_one_output(
     log(
         f"Searching output {output} of {len(boxes)} S-boxes, "
         f"{r} iteration{'s' if r != 1 else ''} each "
-        f"({len(jobs)} {'batched' if batched else 'serial'} jobs)..."
+        f"({len(jobs)} {_mode_name(batched)} jobs)..."
     )
     results: dict = {box.name: [] for box in boxes}
     for box, (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):
@@ -281,7 +302,7 @@ def search_boxes_all_outputs(
                         meta.append((box, output))
         log(
             f"Round {rnd}: {len(jobs)} "
-            f"{'batched' if batched else 'serial'} jobs over "
+            f"{_mode_name(batched)} jobs over "
             f"{len(live)} box{'es' if len(live) != 1 else ''}..."
         )
         for (box, output), (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):

@@ -102,8 +102,14 @@ def generate_graph_one_output(
     # dispatches; under a mesh GSPMD owns the devices (and multi-host
     # runs require a deterministic cross-process collective order that
     # threads cannot guarantee), so the flag degrades to the serial
-    # loop there, like the multibox drivers' _auto_batched.
-    if opt.batch_restarts and opt.iterations > 1 and ctx.mesh_plan is None:
+    # loop there, like the multibox drivers' _auto_batched.  Fleet
+    # contexts take the same driver — run_batched_circuits reroutes the
+    # wave through the fleet dispatcher (search/fleet.py).
+    if (
+        (opt.batch_restarts or opt.fleet or ctx.fleet_plan is not None)
+        and opt.iterations > 1
+        and ctx.mesh_plan is None
+    ):
         from .batched import generate_graph_one_output_batched
 
         return generate_graph_one_output_batched(
@@ -203,12 +209,15 @@ def generate_graph(
             if beam.consider(nst, output) and save_dir is not None:
                 save_state(nst, save_dir)
 
-        if opt.batch_restarts and ctx.mesh_plan is None:
-            # One rendezvous-batched round: every (iteration x start x
-            # missing output) job runs concurrently with round-start
-            # budgets (parallel-restart semantics — the mid-round budget
-            # tightening of the serial loop does not apply), then results
-            # fold through the identical beam logic in serial order.
+        if (
+            opt.batch_restarts or opt.fleet or ctx.fleet_plan is not None
+        ) and ctx.mesh_plan is None:
+            # One rendezvous-batched (or fleet-dispatched) round: every
+            # (iteration x start x missing output) job runs concurrently
+            # with round-start budgets (parallel-restart semantics — the
+            # mid-round budget tightening of the serial loop does not
+            # apply), then results fold through the identical beam logic
+            # in serial order.
             from .batched import run_batched_circuits
 
             jobs, meta = [], []

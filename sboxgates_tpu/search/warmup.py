@@ -162,12 +162,29 @@ KERNELS: Dict[str, KernelDef] = {
         KernelDef("lut7_solve", ()),
         KernelDef("tuple_match_sweep", ("num_cells",)),
         KernelDef("match_stream", ("k", "chunk", "num_cells")),
+        # Pivot kernels: warmable since the bucket-keyed shape refactor
+        # (search.lut.PIVOT_G_BUCKETS) — every pivot operand pads to its
+        # g-bucket, so warm_specs can reproduce the exact live avals.
         KernelDef("lut5_pivot_stream",
                   ("tl", "th", "solve_rows", "tile_batch", "pipeline",
-                   "backend"),
-                  warmable=False),
-        KernelDef("lut5_pivot_tile", ("tl", "th"), warmable=False),
+                   "backend")),
+        KernelDef("lut5_pivot_tile", ("tl", "th")),
+        KernelDef("pivot_pair_cells", ()),
     )
+}
+
+
+#: Rendezvous/fleet shared-argument indices per kernel (operands
+#: identical across restarts/jobs, mapped ``in_axes=None`` instead of
+#: gaining a job axis).  MUST mirror the ``shared=`` tuples at the
+#: ``SearchContext._dispatch`` call sites — the fleet warm specs are
+#: enumerated from this table, and the registry parity test
+#: (tests/test_fleet.py) asserts live submissions agree with it.
+FLEET_SHARED: Dict[str, Tuple[int, ...]] = {
+    "gate_step_stream": (2, 4, 8, 10, 11, 12),
+    "lut_step_stream": (2, 4, 11, 12, 13),
+    "lut7_step_stream": (1, 7, 8),
+    "lut7_solve": (2, 3),
 }
 
 
@@ -228,19 +245,39 @@ class WarmSpec:
 class WarmPlan:
     """Configuration snapshot the warm-spec enumerator needs — captured
     from the context on the MAIN thread so the worker never touches live
-    context state."""
+    context state.
+
+    ``pivot`` pins the pivot-stream levers (tile_batch, pipeline,
+    backend) at context creation so the warmed executables match the
+    live dispatches; None disables pivot warm specs (pallas backends —
+    their Mosaic compiles are single-device A/B territory).
+
+    ``fleet_mesh`` / ``mesh`` pin the sharding configuration: a fleet
+    mesh makes the fleet specs lower with the job-axis out-sharding the
+    dispatcher uses; a (single-process) candidate mesh switches the warm
+    sets to the sharded stream executables (mesh_warm_specs)."""
 
     lut_graph: bool
     has_not: bool  # gate-mode NOT-augmented pair table present
     pair_table: Tuple[tuple, str]  # (shape, dtype) of the match tables
     not_table: Optional[Tuple[tuple, str]]
     triple_table: Tuple[tuple, str]
+    pivot: Optional[tuple] = None
+    fleet_mesh: Optional[object] = None  # jax.sharding.Mesh
+    mesh: Optional[object] = None        # jax.sharding.Mesh
 
     @classmethod
     def from_context(cls, ctx) -> "WarmPlan":
         def sd(a):
             return (tuple(a.shape), str(a.dtype))
 
+        from . import lut as L  # deferred: lut imports context
+
+        backend = L.pivot_backend()
+        pivot = (
+            None if backend.startswith("pallas")
+            else (L.pivot_tile_batch(), L.pivot_pipeline(), backend)
+        )
         return cls(
             lut_graph=ctx.opt.lut_graph,
             has_not=bool(ctx.not_entries) and not ctx.opt.lut_graph,
@@ -249,6 +286,13 @@ class WarmPlan:
                 sd(ctx.not_table_np) if ctx.not_table_np is not None else None
             ),
             triple_table=sd(ctx.triple_table_np),
+            pivot=pivot,
+            fleet_mesh=(
+                ctx.fleet_plan.mesh if ctx.fleet_plan is not None else None
+            ),
+            mesh=(
+                ctx.mesh_plan.mesh if ctx.mesh_plan is not None else None
+            ),
         )
 
 
@@ -320,6 +364,36 @@ def warm_specs(plan: WarmPlan, g: int) -> List[WarmSpec]:
         # Standalone fused 3-LUT stream (lut3_search outside the head).
         add("lut3_stream", dict(chunk=chunk3),
             (tables, binom, gi, tgt, tgt, excl, start, total3, seed))
+    if g >= 5 and total5 >= C.PIVOT_MIN_TOTAL and plan.pivot is not None:
+        # Pivot-structured whole-space sweep: shapes key on the pivot
+        # g-bucket (search.lut.PIVOT_G_BUCKETS), so these avals are
+        # exactly what _lut5_search_pivot dispatches for every g and
+        # exclusion list in the bucket.
+        from . import lut as L
+
+        tile_batch, pipeline, backend = plan.pivot
+        tl, th = L.pivot_tile_shape(g)
+        p2pad, tpad = L.pivot_padded_shapes(g, tl, th)
+        cells = _sds((4, p2pad, _N_WORDS), np.uint32)
+        pvalid = _sds((p2pad,), np.bool_)
+        pgrid = _sds((p2pad, 2), np.int32)
+        pdescs = _sds((tpad, 5), np.int32)
+        add("pivot_pair_cells", {}, (tables, pgrid, pgrid, tgt, tgt))
+        add(
+            "lut5_pivot_stream",
+            dict(tl=tl, th=th, tile_batch=tile_batch, pipeline=pipeline,
+                 backend=backend),
+            (tables, cells, cells, cells, pvalid, pvalid, pdescs, start,
+             start, jw, jm, seed),
+        )
+        # Overflow re-drive of one flagged tile.
+        add("lut5_pivot_tile", dict(tl=tl, th=th),
+            (tables, cells, cells, cells, pvalid, pvalid, pdescs, start))
+        # The re-driven tile's feasible rows solve through lut5_solve at
+        # its compiled pads.
+        for rows in (C.CHUNK_SIZES[0], C.LUT5_SOLVE_CHUNK):
+            req = _sds((rows,), np.uint32)
+            add("lut5_solve", {}, (req, req, jw, jm, seed))
     if g >= 5 and total5 < C.PIVOT_MIN_TOTAL:
         chunk5s = C.pick_chunk(total5, C.STREAM_CHUNK[5])
         add("lut5_stream", dict(chunk=chunk5s),
@@ -359,6 +433,239 @@ def warm_specs(plan: WarmPlan, g: int) -> List[WarmSpec]:
         r7 = _sds((C.LUT7_SOLVE_SIZES[0], 4), np.uint32)
         add("lut7_solve", {}, (r7, r7, jidx, jpp, seed))
     return specs
+
+
+# -------------------------------------------------------------------------
+# Fleet kernels: one compiled executable sweeping a whole job batch
+# -------------------------------------------------------------------------
+
+#: jit(vmap(kernel)) wrappers for the fleet dispatch path, keyed on
+#: (name, statics, shared, nargs, lanes, mesh).  Process-wide for the
+#: same reason as the rendezvous _VMAP_CACHE: re-tracing the fused heads
+#: per context costs seconds of host time.
+_FLEET_LOCK = threading.Lock()
+_FLEET_JIT: Dict[tuple, Callable] = {}
+
+
+def fleet_kernel(
+    name: str, statics: dict, shared: Tuple[int, ...], nargs: int,
+    lanes: int, mesh=None, stacked: bool = False,
+) -> Callable:
+    """The fleet-batched form of a registry kernel: ``lanes`` jobs'
+    sweeps execute as ONE compiled dispatch (``jax.vmap`` over a leading
+    job axis; with ``mesh`` the job axis is pjit-sharded over its
+    ``"jobs"`` mesh axis via the output sharding, composing with the
+    ``"candidates"`` axis of a 2-D fleet mesh).
+
+    Default (``stacked=False``, the rendezvous dispatch shape): the
+    wrapper takes FLAT per-job operands — one argument per ``shared``
+    index, ``lanes`` arguments per batched index, in argument-major
+    order — and stacks the job axis INSIDE the jit, so a warmed fleet
+    dispatch runs zero eager ops: no host-side jnp.stack, no tracing,
+    no compiles (the basis of the fleet bucket-crossing
+    ``recompile_guard(allowed=0)`` gate).
+
+    ``stacked=True`` (the lockstep ``fleet_gate_step`` shape): operands
+    arrive pre-stacked ``[lanes, ...]`` and the vmap applies directly;
+    ``lanes`` is then irrelevant to the compiled shape and ignored in
+    the cache key."""
+    import jax
+
+    key = (
+        name, tuple(sorted(statics.items())), tuple(shared), nargs,
+        "stacked" if stacked else lanes, mesh,
+    )
+    with _FLEET_LOCK:
+        fn = _FLEET_JIT.get(key)
+    if fn is not None:
+        return fn
+    import jax.numpy as jnp
+
+    base = kernel(name, statics)
+    shared_set = set(shared)
+    in_axes = [None if i in shared_set else 0 for i in range(nargs)]
+    vm = jax.vmap(base, in_axes=in_axes)
+
+    if stacked:
+        call = vm
+    else:
+        def call(*flat):
+            args, k = [], 0
+            for i in range(nargs):
+                if i in shared_set:
+                    args.append(flat[k])
+                    k += 1
+                else:
+                    args.append(jnp.stack(flat[k : k + lanes]))
+                    k += lanes
+            return vm(*args)
+
+    if mesh is None:
+        fn = jax.jit(call)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import JOBS_AXIS
+
+        fn = jax.jit(
+            call,
+            out_shardings=NamedSharding(mesh, PartitionSpec(JOBS_AXIS)),
+        )
+    with _FLEET_LOCK:
+        fn = _FLEET_JIT.setdefault(key, fn)
+    return fn
+
+
+def fleet_warm_key(
+    name: str, statics: dict, shared: Tuple[int, ...], lanes: int,
+    flat_args: Sequence, mesh=None,
+) -> tuple:
+    """Warm-cache key for one fleet dispatch — the (jobs_bucket, bucket)
+    keying the ISSUE names: ``lanes`` is the jobs bucket, the flat-arg
+    signature carries the padded table bucket."""
+    return (
+        "fleet", name, tuple(sorted(statics.items())), tuple(shared),
+        lanes, arg_signature(flat_args), mesh,
+    )
+
+
+def fleet_flat_avals(spec: WarmSpec, shared: Tuple[int, ...], lanes: int):
+    """Flattens one per-job WarmSpec into the fleet wrapper's flat
+    operand list: shared avals once, batched avals ``lanes`` times.
+    Batched Python-scalar avals become int32 scalar arrays — the fleet
+    dispatcher normalizes per-job scalars to np.int32 so the in-jit
+    stack sees one strong dtype per argument."""
+    flat = []
+    for i, a in enumerate(spec.avals):
+        if i in shared:
+            flat.append(a)
+            continue
+        if not hasattr(a, "shape"):
+            a = _sds((), np.int32)
+        flat.extend([a] * lanes)
+    return tuple(flat)
+
+
+def fleet_warm_specs(plan: WarmPlan, g: int, lanes: int) -> List[tuple]:
+    """AOT-compile targets for the fleet dispatch path at gate count
+    ``g`` and jobs bucket ``lanes``: every rendezvous-merged kernel of
+    ``warm_specs(plan, g)``, lifted to its flat fleet form.  Returns
+    (warm_key, name, statics, shared, nargs, flat_avals) tuples."""
+    out = []
+    for spec in warm_specs(plan, g):
+        shared = FLEET_SHARED.get(spec.name)
+        if shared is None:
+            continue
+        statics = dict(spec.statics)
+        flat = fleet_flat_avals(spec, shared, lanes)
+        out.append((
+            fleet_warm_key(
+                spec.name, statics, shared, lanes, flat, plan.fleet_mesh
+            ),
+            spec.name, statics, shared, len(spec.avals), flat,
+        ))
+    return out
+
+
+# -------------------------------------------------------------------------
+# Mesh-shaped warm specs: AOT builds of the sharded stream executables
+# -------------------------------------------------------------------------
+
+
+def mesh_warm_specs(plan: WarmPlan, g: int) -> List[tuple]:
+    """AOT-compile targets for a PINNED single-process candidate mesh:
+    the sharded feasible/pivot stream executables the drivers dispatch at
+    gate count ``g`` (under a mesh the node heads route to the native
+    host, so the sharded streams ARE the device surface).  Returns
+    (warm_key, builder, avals) with ``builder()`` resolving the jitted
+    shard_map callable to lower.
+
+    PR 5 left mesh coverage to the persistent compile cache (restarts
+    only); these specs move the FIRST run's GSPMD compiles off the
+    critical path too."""
+    if plan.mesh is None or not plan.lut_graph:
+        return []
+    from . import context as C
+
+    mesh = plan.mesh
+    specs: List[tuple] = []
+    b = C.bucket_size(g)
+    tables = _sds((b, _N_WORDS), np.uint32)
+    bt = sweeps.binom_table()
+    binom = _sds(bt.shape, bt.dtype)
+    tgt = _sds((_N_WORDS,), np.uint32)
+    excl = _sds((8,), np.int32)
+    gi, start, seed = 0, 0, 0
+
+    def add(kind, statics, builder, avals):
+        specs.append((
+            ("mesh", kind, tuple(sorted(statics.items())),
+             arg_signature(avals), mesh),
+            builder, avals,
+        ))
+
+    from ..parallel import mesh as M
+
+    nshards = mesh.shape[M.CANDIDATES_AXIS]
+    for k in (3, 5, 7):
+        total = comb.n_choose_k(g, k)
+        if total <= 0 or not sweeps.device_rank_limit(g, k):
+            continue
+        if k == 5 and total >= C.PIVOT_MIN_TOTAL:
+            continue  # pivot-sized spaces take the sharded pivot stream
+        chunk = C.pick_chunk(max(total, 1), C.STREAM_CHUNK[k])
+        chunk = -(-chunk // nshards) * nshards
+        add(
+            "sharded_feasible_stream",
+            dict(k=k, chunk=chunk, compact=False),
+            lambda k=k, chunk=chunk: M._sharded_stream_fn(
+                mesh, k, chunk, False
+            ),
+            (tables, binom, gi, tgt, tgt, excl, start, total),
+        )
+    total5 = comb.n_choose_k(g, 5)
+    if g >= 5 and total5 >= C.PIVOT_MIN_TOTAL and plan.pivot is not None:
+        from . import lut as L
+
+        _tile_batch, pipeline, backend = plan.pivot
+        if not backend.startswith("pallas"):
+            accum = M.pivot_accum_name(backend)
+            tl, th = L.pivot_tile_shape(g)
+            p2pad, tpad = L.pivot_padded_shapes(g, tl, th)
+            cells = _sds((4, p2pad, _N_WORDS), np.uint32)
+            pvalid = _sds((p2pad,), np.bool_)
+            pdescs = _sds((tpad, 5), np.int32)
+            _, w_tab, m_tab = sweeps.lut5_split_tables()
+            jw = _sds(w_tab.shape, w_tab.dtype)
+            jm = _sds(m_tab.shape, m_tab.dtype)
+
+            def pivot_builder(tl=tl, th=th, pipeline=pipeline, accum=accum):
+                import jax.numpy as jnp
+
+                return M._sharded_pivot_fn(
+                    mesh, tl, th, 64, bool(pipeline),
+                    getattr(jnp, accum),
+                )
+
+            add(
+                "sharded_pivot_stream",
+                dict(tl=tl, th=th, solve_rows=64, pipeline=bool(pipeline),
+                     accum=accum),
+                pivot_builder,
+                (tables, cells, cells, cells, pvalid, pvalid, pdescs,
+                 start, start, jw, jm, seed),
+            )
+    return specs
+
+
+def mesh_warm_lookup(kind: tuple, mesh, statics: dict, args: Sequence):
+    """Warmed sharded executable for one live mesh dispatch, or None."""
+    key = (
+        "mesh", kind, tuple(sorted(statics.items())), arg_signature(args),
+        mesh,
+    )
+    with _WARM_LOCK:
+        return _WARM_COMPILED.get(key)
 
 
 # -------------------------------------------------------------------------
@@ -437,15 +744,22 @@ class KernelWarmer:
         knows its gate count.  Cheap when nothing new (one lock'd set
         probe); schedules the next bucket's warm set otherwise, for the
         first gate count the drivers will dispatch after crossing the
-        boundary."""
+        boundary.  LUT plans additionally warm the next PIVOT g-bucket
+        (search.lut.PIVOT_G_BUCKETS — finer than the table buckets), so
+        a mid-bucket pivot-shape crossing is compile-free too."""
         if not self.enabled or g is None:
             return
         from . import context as C
 
         b = C.bucket_size(g)
-        if next_bucket(b) is None:
-            return
-        self._schedule(("bucket", b), b + 1)
+        if next_bucket(b) is not None:
+            self._schedule(("bucket", b), ("specs", b + 1))
+        if self.plan.lut_graph and self.plan.pivot is not None:
+            from . import lut as L
+
+            pb = L.pivot_g_bucket(g)
+            if pb < L.PIVOT_G_BUCKETS[-1]:
+                self._schedule(("pivotb", pb), ("specs", pb + 1))
 
     def prewarm(self, g: Optional[int]) -> None:
         """Schedules an AOT build of gate count ``g``'s OWN kernel set
@@ -455,14 +769,42 @@ class KernelWarmer:
         dispatch pays a cache deserialize in the background instead of a
         compile in the foreground."""
         if self.enabled and g is not None:
-            self._schedule(("exact", g), g)
+            self._schedule(("exact", g), ("specs", g))
 
-    def _schedule(self, key, g: int) -> None:
+    def note_fleet(self, g: Optional[int], lanes: int) -> None:
+        """Fleet-dispatch hook (search.fleet.FleetRendezvous): warm specs
+        are keyed on (jobs_bucket, bucket), and both axes cross mid-run —
+        the fleet shrinks as jobs retire, the tables grow through gate
+        buckets — so entry to (lanes, bucket) schedules the set itself
+        plus its two successors: the next gate bucket at these lanes and
+        the next SMALLER jobs bucket at this gate count."""
+        if not self.enabled or g is None:
+            return
+        from . import context as C
+        from .fleet import prev_fleet_bucket
+
+        b = C.bucket_size(g)
+        gates = [g] + ([b + 1] if next_bucket(b) is not None else [])
+        pl = prev_fleet_bucket(lanes)
+        # A 1-lane group bypasses the fleet wrapper entirely (the
+        # rendezvous runs singletons through the registry kernel), so
+        # lanes<2 sets would warm executables nothing dispatches.
+        lane_set = [lanes] + ([pl] if pl is not None and pl >= 2 else [])
+        # Full cross product: the fleet can cross both axes at once (a
+        # job retires in the same round the survivors' tables grow past
+        # the bucket), so the diagonal set must be warm too.
+        targets = [(gg, ll) for gg in gates for ll in lane_set]
+        for gg, ll in targets:
+            self._schedule(
+                ("fleet", C.bucket_size(gg), ll), ("fleet", gg, ll)
+            )
+
+    def _schedule(self, key, item: tuple) -> None:
         with self._cv:
             if key in self._scheduled or self._stop:
                 return
             self._scheduled.add(key)
-            self._queue.append(g)
+            self._queue.append(item)
             self._inflight += 1
             if self._thread is None:
                 self._thread = threading.Thread(
@@ -478,6 +820,14 @@ class KernelWarmer:
         if not self.enabled:
             return None
         key = warm_key(name, statics, args)
+        with _WARM_LOCK:
+            return _WARM_COMPILED.get(key)
+
+    def lookup_key(self, key: tuple):
+        """Warmed executable by prebuilt cache key (the fleet dispatcher
+        builds fleet_warm_key itself), or None."""
+        if not self.enabled:
+            return None
         with _WARM_LOCK:
             return _WARM_COMPILED.get(key)
 
@@ -535,9 +885,12 @@ class KernelWarmer:
                     # or spawns a successor — never neither).
                     self._thread = None
                     return
-                g = self._queue.popleft()
+                item = self._queue.popleft()
             try:
-                self._warm_bucket(g)
+                if item[0] == "fleet":
+                    self._warm_fleet(item[1], item[2])
+                else:
+                    self._warm_bucket(item[1])
             finally:
                 with self._cv:
                     self._inflight -= 1
@@ -545,7 +898,21 @@ class KernelWarmer:
 
     def _warm_bucket(self, g: int) -> None:
         try:
-            specs = warm_specs(self.plan, g)
+            if self.plan.mesh is not None:
+                jobs = [
+                    (key, (lambda b=builder: b().lower), avals, {})
+                    for key, builder, avals in mesh_warm_specs(self.plan, g)
+                ]
+            else:
+                jobs = [
+                    (
+                        spec.key,
+                        (lambda n=spec.name: KERNELS[n].fn.lower),
+                        spec.avals,
+                        dict(spec.statics),
+                    )
+                    for spec in warm_specs(self.plan, g)
+                ]
         except Exception as e:
             # Spec enumeration failing must degrade exactly like a failed
             # compile — counted and skipped — never kill the worker (a
@@ -557,12 +924,40 @@ class KernelWarmer:
             )
             self.count("warm_failed")
             return
-        for spec in specs:
+        self._compile_jobs(jobs)
+
+    def _warm_fleet(self, g: int, lanes: int) -> None:
+        try:
+            jobs = [
+                (
+                    key,
+                    (lambda n=name, s=statics, sh=shared, na=nargs:
+                        fleet_kernel(
+                            n, s, sh, na, lanes, self.plan.fleet_mesh
+                        ).lower),
+                    flat, {},
+                )
+                for key, name, statics, shared, nargs, flat
+                in fleet_warm_specs(self.plan, g, lanes)
+            ]
+        except Exception as e:
+            logger.warning(
+                "fleet warm-spec enumeration for g=%d lanes=%d failed "
+                "(%s); skipping this warm set", g, lanes, e
+            )
+            self.count("warm_failed")
+            return
+        self._compile_jobs(jobs)
+
+    def _compile_jobs(self, jobs) -> None:
+        """Shared AOT loop: each job is (cache key, lower-fn resolver,
+        positional avals, static kwargs)."""
+        for key, lower_of, avals, statics in jobs:
             with self._lock:
                 if self._stop:
                     return
             with _WARM_LOCK:
-                if spec.key in _WARM_COMPILED:
+                if key in _WARM_COMPILED:
                     continue
             try:
                 # Fault site: raise degrades this spec to lazy compile,
@@ -570,22 +965,20 @@ class KernelWarmer:
                 # untouched; shutdown abandons it after the bounded
                 # join).
                 fault_point("warmup.compile")
-                # .lower on the registry's underlying jitted fn (the
-                # partial bound by kernel() has no AOT surface); statics
-                # ride as keywords exactly as the live call passes them.
-                compiled = KERNELS[spec.name].fn.lower(
-                    *spec.avals, **dict(spec.statics)
-                ).compile()
+                # .lower on the underlying jitted callable (registry fn,
+                # fleet wrapper, or sharded stream); statics ride as
+                # keywords exactly as the live call passes them.
+                compiled = lower_of()(*avals, **statics).compile()
             except Exception as e:
                 # Any failure means "no warm entry": the dispatcher lazy-
                 # compiles exactly as without a warmer.  Never propagate —
                 # a background compile must not be able to fail the search.
                 logger.warning(
-                    "background warmup of %s%s failed (%s); falling back "
-                    "to lazy compilation", spec.name, dict(spec.statics), e
+                    "background warmup of %s failed (%s); falling back "
+                    "to lazy compilation", key[:2], e
                 )
                 self.count("warm_failed")
                 continue
             with _WARM_LOCK:
-                _WARM_COMPILED[spec.key] = compiled
+                _WARM_COMPILED[key] = compiled
             self.count("warm_compiled")

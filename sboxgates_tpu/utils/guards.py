@@ -78,13 +78,25 @@ class GuardReport:
 
 
 class _CompileLogCounter(logging.Handler):
-    """Counts 'Compiling <name> ...' records on the jax logger tree."""
+    """Counts 'Compiling <name> ...' records on the jax logger tree.
+
+    Compiles performed by the background kernel warmer's worker thread
+    (``sbg-warmup``) are excluded: they are BY DESIGN off the critical
+    path — the guard's contract is "nothing on the dispatch path
+    compiles", and a warm set scheduled mid-region (entering a new
+    bucket schedules its successors) must not fail it.  Logging handlers
+    run synchronously on the emitting thread, so the thread name
+    identifies the compiler."""
 
     def __init__(self, report: GuardReport) -> None:
         super().__init__(level=logging.DEBUG)
         self.report = report
 
     def emit(self, record: logging.LogRecord) -> None:
+        import threading
+
+        if threading.current_thread().name == "sbg-warmup":
+            return
         msg = record.getMessage()
         if msg.startswith("Compiling "):
             self.report.note("compile", msg.split(" in ")[0][:160])

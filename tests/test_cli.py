@@ -89,6 +89,34 @@ def test_resume_run_with_shard_sweep_rejected_names_workaround(capsys):
     assert "Traceback" not in err
 
 
+def test_fleet_incoherent_flag_combos_rejected(capsys):
+    """--fleet contradicts --shard-sweep (one mesh vs per-process
+    slices), --serial-jobs (nothing to merge), and --mesh (the fleet
+    builds its own 2-D mesh): each is a one-line error, no traceback."""
+    for argv in (
+        ["--fleet", "--shard-sweep", DES, FA],
+        ["--fleet", "--serial-jobs", DES, FA],
+        ["--fleet", "--mesh", DES, FA],
+    ):
+        rc = main(argv)
+        assert rc != 0, argv
+        err = capsys.readouterr().err
+        assert "--fleet" in err, argv
+        assert err.strip().count("\n") == 0, argv  # exactly one line
+        assert "Traceback" not in err
+
+
+def test_cli_fleet_end_to_end(tmp_path, monkeypatch):
+    """--fleet runs a 2-box sweep through the fleet dispatcher and
+    writes per-box state files like the serial driver."""
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--fleet", "-o", "0", "-i", "1", "-l", "--seed", "2",
+               "--output-dir", str(tmp_path), DES, FA])
+    assert rc == 0
+    assert list((tmp_path / "des_s1").glob("*.xml"))
+    assert list((tmp_path / "crypto1_fa").glob("*.xml"))
+
+
 def test_help_exits_zero():
     with pytest.raises(SystemExit) as e:
         main(["--help"])

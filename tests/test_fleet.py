@@ -1,0 +1,411 @@
+"""Fleet-batched search tests (ISSUE 6): the job batch axis.
+
+Headline properties:
+
+- PARITY: an 8-job DES fleet produces circuits bit-identical to the
+  serial per-job loop (and the fleet mesh changes nothing), including a
+  ragged fleet whose jobs finish at different rounds under done-masking.
+- WARM SHAPES: fleet kernels are warm-registry citizens keyed on
+  (jobs_bucket, bucket) — a warmed fleet bucket crossing performs ZERO
+  steady-state compiles under a strict ``recompile_guard``.
+- The dispatch merging itself: N jobs' same-kind node sweeps execute as
+  one vmapped dispatch (submits >> dispatches).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from planted import build_planted_lut5_small
+from sboxgates_tpu.core import boolfunc as bf
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import GATES, NO_GATE, State
+from sboxgates_tpu.search import Options, SearchContext, warmup
+from sboxgates_tpu.search.fleet import (
+    FLEET_BUCKETS,
+    FleetRendezvous,
+    fleet_bucket,
+    prev_fleet_bucket,
+    run_fleet_circuits,
+)
+from sboxgates_tpu.search.multibox import (
+    BoxJob,
+    load_box_jobs,
+    search_boxes_all_outputs,
+    search_boxes_one_output,
+)
+from sboxgates_tpu.utils import recompile_guard
+
+SBOXES = os.path.join(os.path.dirname(__file__), "..", "sboxes")
+
+#: Device-dispatch configuration: node heads dispatch to the (CPU)
+#: device instead of routing native, so the fleet rendezvous actually
+#: merges sweeps.  randomize=False makes per-job results independent of
+#: seed-block bookkeeping differences.
+DEV = dict(
+    seed=11, lut_graph=True, randomize=False, host_small_steps=False,
+    native_engine=False,
+)
+
+
+def _boxes(names):
+    return load_box_jobs([os.path.join(SBOXES, f"{n}.txt") for n in names])
+
+
+def _toy_boxes(n=8):
+    """The shared fixture corpus (cheap 3-input searches with real
+    dispatches in the DEV configuration) — same generator the bench's
+    dispatch ladder measures."""
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+    return toy_fleet_boxes(n)
+
+
+def _sig(res):
+    return {
+        name: [
+            [(g.type, g.in1, g.in2, g.in3, g.function) for g in s.gates]
+            for s in sts
+        ]
+        for name, sts in res.items()
+    }
+
+
+def _grow(g, seed=5):
+    rng = np.random.default_rng(seed)
+    st = State.init_inputs(8)
+    while st.num_gates < g:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    return st
+
+
+# -------------------------------------------------------------------------
+# Parity: fleet == serial per-job loop
+# -------------------------------------------------------------------------
+
+
+def test_fleet_bucket_resolution():
+    assert fleet_bucket(1) == 1
+    assert fleet_bucket(3) == 4
+    assert fleet_bucket(8) == 8
+    assert fleet_bucket(9) == 16
+    # bucket respects mesh job shards
+    assert fleet_bucket(3, shards=8) == 8
+    assert fleet_bucket(FLEET_BUCKETS[-1] + 1, shards=4) % 4 == 0
+    assert prev_fleet_bucket(8) == 4
+    assert prev_fleet_bucket(1) is None
+
+
+def test_fleet_parity_des8_vs_serial():
+    """The acceptance gate: all 8 DES S-boxes, output bit 0, as one
+    fleet — circuits bit-identical to the serial per-job loop."""
+    names = [f"des_s{i}" for i in range(1, 9)]
+    ctx_s = SearchContext(Options(seed=11, lut_graph=True, randomize=False))
+    res_s = search_boxes_one_output(
+        ctx_s, _boxes(names), 0, save_dir=None, log=lambda s: None,
+        batched=False,
+    )
+    ctx_f = SearchContext(
+        Options(seed=11, lut_graph=True, randomize=False, fleet=True)
+    )
+    res_f = search_boxes_one_output(
+        ctx_f, _boxes(names), 0, save_dir=None, log=lambda s: None,
+    )
+    assert _sig(res_f) == _sig(res_s)
+    for sts in res_f.values():
+        assert sts  # every box solved
+
+
+def test_fleet_device_dispatch_parity_and_merging():
+    """Device-routed toy fleet: bit-identical to the serial loop, and
+    the jobs' sweeps actually merged (one vmapped dispatch serves many
+    submits)."""
+    ctx_s = SearchContext(Options(**DEV))
+    res_s = search_boxes_one_output(
+        ctx_s, _toy_boxes(), 0, save_dir=None, log=lambda s: None,
+        batched=False,
+    )
+    ctx_f = SearchContext(Options(fleet=True, **DEV))
+    res_f = search_boxes_one_output(
+        ctx_f, _toy_boxes(), 0, save_dir=None, log=lambda s: None,
+        batched="fleet",
+    )
+    assert _sig(res_f) == _sig(res_s)
+    st = ctx_f.stats
+    assert st["fleet_submits"] > 0
+    # Merging: strictly fewer device dispatches than sweep submissions.
+    assert st["fleet_rounds"] < st["fleet_submits"]
+    assert st["fleet_dispatches"] >= 1
+    assert st["fleet_lanes"] >= 2 * st["fleet_dispatches"]
+
+
+def test_fleet_ragged_done_masking(tmp_path):
+    """Ragged fleet through the lockstep all-outputs driver: boxes
+    finish at different rounds (ident3 completes via step-1 reuse,
+    parmaj3 needs real gates), jobs retire mid-wave as their searches
+    end — results bit-identical to the rendezvous-batched driver, which
+    shares the seed discipline."""
+    ident = np.zeros(256, dtype=np.uint8)
+    ident[:8] = np.arange(8)
+    boxes = lambda: [BoxJob("ident3", ident.copy(), 3)] + _toy_boxes(3)  # noqa: E731
+    ctx_b = SearchContext(Options(**DEV))
+    res_b = search_boxes_all_outputs(
+        ctx_b, boxes(), save_dir=str(tmp_path / "b"), log=lambda s: None,
+        batched=True,
+    )
+    ctx_f = SearchContext(Options(fleet=True, **DEV))
+    res_f = search_boxes_all_outputs(
+        ctx_f, boxes(), save_dir=str(tmp_path / "f"), log=lambda s: None,
+    )
+    assert _sig(res_f) == _sig(res_b)
+    for name, sts in res_f.items():
+        assert sts, f"{name}: incomplete"
+    assert ctx_f.stats["fleet_rounds"] < ctx_f.stats["fleet_submits"]
+
+
+def test_fleet_mesh_sharded_parity():
+    """P("jobs")-sharded fleet (2-D mesh over the 8 virtual devices) is
+    bit-identical to the unsharded fleet and to the serial loop."""
+    from sboxgates_tpu.parallel import FleetPlan, make_fleet_mesh
+
+    plan = FleetPlan(make_fleet_mesh())
+    assert plan.n_job_shards >= 1
+    ctx_s = SearchContext(Options(**DEV))
+    res_s = search_boxes_one_output(
+        ctx_s, _toy_boxes(4), 0, save_dir=None, log=lambda s: None,
+        batched=False,
+    )
+    ctx_p = SearchContext(Options(fleet=True, **DEV), fleet_plan=plan)
+    res_p = search_boxes_one_output(
+        ctx_p, _toy_boxes(4), 0, save_dir=None, log=lambda s: None,
+    )
+    assert _sig(res_p) == _sig(res_s)
+    assert ctx_p.stats["fleet_dispatches"] >= 1
+
+
+def test_fleet_mesh_excludes_candidate_mesh():
+    from sboxgates_tpu.parallel import FleetPlan, MeshPlan, make_fleet_mesh, make_mesh
+
+    # Rejected at CONSTRUCTION (either form), so every driver behaves
+    # identically — the orchestrator cannot silently fall back serial.
+    with pytest.raises(ValueError):
+        SearchContext(
+            Options(seed=1), mesh_plan=MeshPlan(make_mesh()),
+            fleet_plan=FleetPlan(make_fleet_mesh()),
+        )
+    with pytest.raises(ValueError):
+        SearchContext(
+            Options(seed=1, fleet=True), mesh_plan=MeshPlan(make_mesh())
+        )
+    # An explicit batched="fleet" on a plain mesh context is rejected by
+    # the driver-level mode resolution.
+    ctx = SearchContext(Options(seed=1), mesh_plan=MeshPlan(make_mesh()))
+    with pytest.raises(ValueError):
+        search_boxes_one_output(
+            ctx, _toy_boxes(2), 0, save_dir=None, log=lambda s: None,
+            batched="fleet",
+        )
+
+
+# -------------------------------------------------------------------------
+# Warm shapes: (jobs_bucket, bucket)-keyed fleet kernels
+# -------------------------------------------------------------------------
+
+
+def _fleet_warm_ctx(monkeypatch, **kw):
+    monkeypatch.setenv("SBG_WARMUP", "1")
+    opt = dict(DEV, fleet=True)
+    opt.update(kw)
+    ctx = SearchContext(Options(**opt))
+    assert ctx.warmer is not None and ctx.warmer.enabled
+    return ctx
+
+
+def test_fleet_bucket_crossing_zero_compiles(monkeypatch):
+    """A warmed fleet crossing BOTH axes — the table bucket (64 -> 512)
+    and the jobs bucket (4 -> 2, jobs retiring) — performs zero
+    steady-state compiles: the (jobs_bucket, bucket) warm specs serve
+    the dispatches with AOT executables."""
+    ctx = _fleet_warm_ctx(monkeypatch, lut_graph=False)
+    mask = tt.mask_table(8)
+    st63 = _grow(63)
+    t63 = st63.table(50).copy()
+    try:
+        # Entry wave: 4 jobs at bucket 64 — one fleet dispatch each job
+        # (the target matches an existing gate, so each search is a
+        # single gate_step submit).  Schedules the warm cross product
+        # {bucket, next bucket} x {lanes, prev lanes}.
+        res = run_fleet_circuits(
+            ctx, [(st63.copy(), t63, mask) for _ in range(4)]
+        )
+        assert all(out == 50 for _, out in res)
+        assert ctx.warmer.wait_idle(300), "warmer never went idle"
+        ws = ctx.warmup_stats()
+        assert ws["warm_failed"] == 0, ws
+        assert ws["warm_compiled"] >= 4, ws
+
+        st65 = _grow(65)
+        t65 = st65.table(50).copy()
+        # The eager per-node helpers (combo grid, validity arange) for
+        # bucket 512 compile outside the guarded region: the guard gates
+        # the DISPATCH path, which is what the fleet warms.
+        ctx._node_operands(st65, t65, mask)
+        # Steady state: run each crossing shape once (warm-served, but
+        # each first entry to a (bucket, lanes) cell schedules ITS
+        # successors on the background worker — those compiles must
+        # drain before a process-wide zero-compile guard).
+        run_fleet_circuits(ctx, [(st65.copy(), t65, mask) for _ in range(4)])
+        run_fleet_circuits(ctx, [(st63.copy(), t63, mask) for _ in range(2)])
+        assert ctx.warmer.wait_idle(300)
+        h0 = ctx.stats["fleet_warm_hits"]
+        with recompile_guard(allowed=0, label="fleet bucket crossing") as rep:
+            # Gate-bucket crossing at held lanes.
+            res = run_fleet_circuits(
+                ctx, [(st65.copy(), t65, mask) for _ in range(4)]
+            )
+            assert all(out == 50 for _, out in res)
+            # Jobs-bucket crossing (fleet shrank 4 -> 2) at the old
+            # gate bucket — the diagonal was warmed too.
+            res = run_fleet_circuits(
+                ctx, [(st63.copy(), t63, mask) for _ in range(2)]
+            )
+            assert all(out == 50 for _, out in res)
+        assert rep.compiles == 0
+        assert ctx.stats["fleet_warm_hits"] >= h0 + 2
+        assert ctx.warmup_stats().get("warm_aval_mismatches", 0) == 0
+    finally:
+        ctx.warmer.shutdown()
+
+
+def test_fleet_lut_head_warm_hit(monkeypatch):
+    """LUT-mode fleet: a warmed (jobs_bucket, bucket) set serves the
+    fused head dispatch compile-free."""
+    st0, target, mask = build_planted_lut5_small()
+    ctx = _fleet_warm_ctx(monkeypatch)
+    try:
+        jobs = lambda: [(st0.copy(), target, mask) for _ in range(4)]  # noqa: E731
+        res1 = run_fleet_circuits(ctx, jobs())
+        assert all(out != NO_GATE for _, out in res1)
+        assert ctx.warmer.wait_idle(300)
+        ctx._node_operands(st0, target, mask)
+        with recompile_guard(allowed=0, label="warmed lut fleet wave") as rep:
+            res2 = run_fleet_circuits(ctx, jobs())
+        assert rep.compiles == 0
+        assert ctx.stats["fleet_warm_hits"] >= 1
+        assert [o for _, o in res2] == [o for _, o in res1]
+    finally:
+        ctx.warmer.shutdown()
+
+
+def test_fleet_registry_parity(monkeypatch):
+    """Live fleet submissions must agree with the warm registry: every
+    merged kernel's shared-argument tuple matches FLEET_SHARED (the
+    table fleet_warm_specs enumerates from), and the dispatcher's warm
+    key for each group is exactly a fleet_warm_specs key for that
+    (g, lanes)."""
+    recorded = []
+    orig = FleetRendezvous._run_group
+
+    def spy(self, key, entries):
+        recorded.append((key, tuple(entries[0]["shared"]),
+                         len(entries[0]["args"]),
+                         max((e.get("g") or 0) for e in entries),
+                         len(entries)))
+        return orig(self, key, entries)
+
+    monkeypatch.setattr(FleetRendezvous, "_run_group", spy)
+    ctx = SearchContext(Options(fleet=True, **DEV))
+    search_boxes_one_output(
+        ctx, _toy_boxes(4), 0, save_dir=None, log=lambda s: None,
+    )
+    gctx = SearchContext(Options(fleet=True, **dict(DEV, lut_graph=False)))
+    st = _grow(24)
+    run_fleet_circuits(
+        gctx, [(st.copy(), st.table(20).copy(), tt.mask_table(8))
+               for _ in range(2)]
+    )
+    assert recorded, "no fleet groups dispatched"
+    plans = {
+        True: warmup.WarmPlan.from_context(ctx),
+        False: warmup.WarmPlan.from_context(gctx),
+    }
+    seen = set()
+    for key, shared, nargs, g, n in recorded:
+        name = key[0]
+        seen.add(name)
+        if name in warmup.FLEET_SHARED:
+            assert shared == warmup.FLEET_SHARED[name], name
+        if name not in warmup.FLEET_SHARED or n < 2 or not g:
+            continue
+        lanes = fleet_bucket(n)
+        plan = plans[name != "gate_step_stream"]
+        specs = warmup.fleet_warm_specs(plan, g, lanes)
+        keys = {k for k, *_ in specs}
+        spec_sigs = {
+            (k[1], k[2], k[4]) for k in keys
+        }
+        assert (name, key[1], lanes) in spec_sigs, (
+            f"fleet dispatch {name} g={g} lanes={lanes} has no warm spec "
+            "— live call sites and FLEET_SHARED/warm_specs drifted"
+        )
+    assert "lut_step_stream" in seen
+
+
+# -------------------------------------------------------------------------
+# The stacked lockstep step + fleet table cache
+# -------------------------------------------------------------------------
+
+
+def test_fleet_gate_step_done_masking():
+    """The single-kernel [jobs, bucket, 8] lockstep sweep: per-job
+    verdicts match the per-job kernel, retired lanes ride as masked
+    no-op rows, and the stacked-table cache is content-keyed."""
+    from sboxgates_tpu.search.fleet import fleet_gate_step
+
+    ctx = SearchContext(Options(**dict(DEV, lut_graph=False)))
+    sts = [_grow(20, seed=s) for s in range(3)]
+    jobs = [
+        (st, st.table(12).copy(), tt.mask_table(8)) for st in sts
+    ]
+    out = fleet_gate_step(ctx, jobs)
+    assert out.shape[0] == 3
+    for (st, t, m), row in zip(jobs, out):
+        step, x0, _ = ctx.gate_step(st, t, m)
+        assert int(row[0]) == step and int(row[1]) == x0
+    # done-masking: retired lanes are zeroed, live lanes unchanged.
+    out2 = fleet_gate_step(ctx, jobs, done=[False, True, False])
+    assert (out2[1] == 0).all()
+    assert (out2[0] == out[0]).all() and (out2[2] == out[2]).all()
+    # stacked-table cache: same fleet content -> resident stack reused
+    # (a retired lane contributes a stable placeholder digest, so
+    # retirement does not churn the key); mutation always re-uploads.
+    h0, m0 = ctx.fleet_stack.hits, ctx.fleet_stack.misses
+    ctx.fleet_device_tables(sts, done=[False, True, False])
+    ctx.fleet_device_tables(sts, done=[False, True, False])
+    assert ctx.fleet_stack.hits >= h0 + 1
+    m1 = ctx.fleet_stack.misses
+    sts[0].add_gate(bf.XOR, 0, 1, GATES)
+    ctx.fleet_device_tables(sts, done=[False, True, False])
+    assert ctx.fleet_stack.misses == m1 + 1
+    # Explicit lifecycle control drops the stacked buffers too.
+    ctx.invalidate_device_tables()
+    ctx.fleet_device_tables(sts, done=[False, True, False])
+    assert ctx.fleet_stack.misses == m1 + 2
+
+
+def test_fleet_gate_step_sharded_matches():
+    from sboxgates_tpu.parallel import FleetPlan, make_fleet_mesh
+    from sboxgates_tpu.search.fleet import fleet_gate_step
+
+    ctx = SearchContext(Options(**dict(DEV, lut_graph=False)))
+    ctx_p = SearchContext(
+        Options(**dict(DEV, lut_graph=False)),
+        fleet_plan=FleetPlan(make_fleet_mesh()),
+    )
+    sts = [_grow(20, seed=s) for s in range(3)]
+    jobs = [(st, st.table(12).copy(), tt.mask_table(8)) for st in sts]
+    a = fleet_gate_step(ctx, jobs)
+    b = fleet_gate_step(ctx_p, jobs)
+    np.testing.assert_array_equal(a, b)

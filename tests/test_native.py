@@ -27,6 +27,9 @@ pytestmark = pytest.mark.skipif(
 _TELEMETRY_KEYS = frozenset((
     "kernel_compiles", "compile_stall_s", "warm_hits", "warm_misses",
     "table_uploads", "table_cache_hits",
+    # Execution-path counter, not a sweep-semantics one: the native
+    # route's whole point is zero device dispatches.
+    "device_dispatches",
 ))
 
 
@@ -863,6 +866,55 @@ def test_lut_engine_service_binds_per_context_views():
     assert dict(base.stats) == base_counts
 
 
+def test_engine_threaded_mux_service_machinery_parity(monkeypatch):
+    """Fast tier-1 twin of the full threaded-mux parity test below:
+    every device-work request is stubbed to a not-found verdict
+    (identically in every arm), so the whole mux tree walks at native
+    speed while the engine's THREADED fan-out still runs — concurrent
+    branch threads, ctypes callbacks from each, bit-order fold under
+    budget raises.  Results and summed counters must be bit-identical
+    across serial, 8-thread, and wave-capped arms.  (The threaded
+    PYTHON service's per-view plumbing keeps its own tier-1 coverage in
+    test_lut_engine_service_binds_per_context_views; the un-stubbed
+    whole-sweep walk is the slow twin below.)"""
+    import sys
+    from functools import reduce
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    def run(threads):
+        monkeypatch.setenv("SBG_ENGINE_MUX_THREADS", str(threads))
+        st, _, mask = build_planted_lut5()
+        miss = reduce(
+            lambda a, b: np.asarray(a) & np.asarray(b),
+            [st.table(i) for i in range(8)],
+        )
+        st.max_gates = st.num_gates + 3
+        ctx = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
+
+        def wrapped(kind, *args):
+            return None
+
+        ctx._lut_engine_service_fn = (ctx, wrapped)
+        out = create_circuit(ctx, st, miss, mask, [])
+        keys = ("engine_nodes", "engine_devcalls", "pair_candidates")
+        return out, st.num_gates, {k: ctx.stats.get(k, 0) for k in keys}
+
+    s_out, s_g, s_stats = run(1)
+    t_out, t_g, t_stats = run(8)
+    w_out, w_g, w_stats = run(2)
+    assert (s_out, s_g, s_stats) == (t_out, t_g, t_stats)
+    assert (s_out, s_g, s_stats) == (w_out, w_g, w_stats)
+    # The branches really issued service requests: the root plus each
+    # first-level branch asks for its 5-LUT sweep.
+    assert s_stats["engine_devcalls"] >= 9
+
+
+@pytest.mark.slow
 def test_engine_threaded_mux_matches_serial(monkeypatch):
     """SBG_ENGINE_MUX_THREADS > 1 fans the outermost mux over C++
     threads whose branches service their device work concurrently
@@ -871,7 +923,11 @@ def test_engine_threaded_mux_matches_serial(monkeypatch):
     the fold stays in bit order.  The target (AND of all 8 inputs) is
     unrealizable from the XOR state, so both arms walk the whole mux
     tree; kind-3 requests are suppressed (the staged 7-LUT's C(50,7)
-    stage A is minutes on CPU and identical in both arms)."""
+    stage A is minutes on CPU and identical in both arms).
+
+    Marked slow: three full-tree walks with real C(50,5) pivot sweeps
+    per node are ~4.5 min on a 2-core CPU host — the un-stubbed
+    extension of the tier-1 machinery-parity twin above."""
     import sys
     from functools import reduce
 

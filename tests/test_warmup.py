@@ -66,6 +66,10 @@ def test_bucket_transition_zero_steady_state_compiles(monkeypatch):
     ctx = _warm_ctx(monkeypatch)
     st = _grow_state(63)
     target, mask = _unrealizable_target(), tt.mask_table(8)
+    # Earlier tests (the fleet suite runs first) may have populated the
+    # process-wide warm cache with this bucket's specs; drop it so the
+    # compiled-count assertions below measure THIS schedule.
+    warmup.drop_warm_cache()
     try:
         # Bucket-64 dispatch: triggers warm scheduling for bucket 512.
         lut3_search(ctx, st, target, mask, [])
@@ -193,6 +197,129 @@ def test_warm_specs_enumerate_expected_set():
     assert [s.name for s in warmup.warm_specs(gate_plan, 65)] == [
         "gate_step_stream"
     ]
+
+
+# -------------------------------------------------------------------------
+# Bucket-keyed pivot kernels (ISSUE 6 satellite: registered AND warmable)
+# -------------------------------------------------------------------------
+
+
+def test_pivot_shapes_key_on_bucket():
+    """Pivot operand shapes are bucket functions: stable for every g in
+    a pivot bucket and every exclusion list, and the tile shape keeps
+    the measured 128 boundary (a bucket edge)."""
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search.lut import (
+        pivot_g_bucket,
+        pivot_padded_shapes,
+        pivot_tile_shape,
+    )
+
+    assert pivot_tile_shape(50) == (256, 512)
+    assert pivot_tile_shape(128) == (256, 512)
+    assert pivot_tile_shape(129) == (512, 512)
+    assert pivot_g_bucket(50) == pivot_g_bucket(64) == 64
+    tl, th = pivot_tile_shape(50)
+    assert pivot_padded_shapes(50, tl, th) == pivot_padded_shapes(64, tl, th)
+    # the pad covers the worst case in the bucket: the real descriptor
+    # count at the bucket top, exclusion-free
+    _, tpad = pivot_padded_shapes(50, tl, th)
+    assert tpad >= sweeps.pivot_tile_count(64, tl, th)
+    assert sweeps.pivot_tile_count(64, tl, th) == (
+        sweeps.pivot_tile_descs(64, tl, th).shape[0]
+    )
+
+
+def test_pivot_sweep_warm_zero_compiles(monkeypatch):
+    """A prewarmed pivot-sized 5-LUT sweep — the kernels PR 5 left
+    registered-but-unwarmable — dispatches with zero compiles under a
+    strict process-wide recompile_guard, and finds the planted
+    decomposition through the warmed executables."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from planted import build_planted_lut5
+
+    from sboxgates_tpu.search.lut import _lut5_search_pivot
+
+    st, target, mask = build_planted_lut5()
+    ctx = _warm_ctx(monkeypatch, native_engine=False)
+    try:
+        ctx.warmer.prewarm(st.num_gates)
+        assert ctx.warmer.wait_idle(300), "warmer never went idle"
+        assert ctx.warmup_stats()["warm_failed"] == 0
+        # First sweep triggers next-bucket scheduling; drain those
+        # background compiles before the strict guard.
+        res1 = _lut5_search_pivot(ctx, st, target, mask, [])
+        assert res1 is not None
+        assert ctx.warmer.wait_idle(300)
+        h0 = ctx.stats["warm_hits"]
+        with recompile_guard(allowed=0, label="warmed pivot sweep") as rep:
+            res2 = _lut5_search_pivot(ctx, st, target, mask, [])
+        assert rep.compiles == 0
+        assert res2 == res1
+        assert ctx.stats["warm_hits"] >= h0 + 2  # pair cells + stream
+    finally:
+        ctx.warmer.shutdown()
+
+
+# -------------------------------------------------------------------------
+# Mesh-shaped warm specs (ISSUE 6 satellite: pinned-mesh AOT coverage)
+# -------------------------------------------------------------------------
+
+
+def test_mesh_warm_specs_cover_sharded_streams(monkeypatch):
+    """A pinned single-process mesh gets a warmer whose sets are the
+    sharded stream executables; the live sharded dispatch is served by
+    the AOT build and results are identical to the lazy mesh path."""
+    from sboxgates_tpu.parallel import MeshPlan, make_mesh
+    from sboxgates_tpu.search.lut import lut3_search
+
+    st = _grow_state(24)
+    target, mask = _unrealizable_target(), tt.mask_table(8)
+
+    monkeypatch.setenv("SBG_WARMUP", "0")
+    lazy = SearchContext(
+        Options(seed=7, lut_graph=True, randomize=False,
+                host_small_steps=False, warmup=False),
+        mesh_plan=MeshPlan(make_mesh()),
+    )
+    out_lazy = lut3_search(lazy, st.copy(), target, mask, [])
+
+    monkeypatch.setenv("SBG_WARMUP", "1")
+    ctx = SearchContext(
+        Options(seed=7, lut_graph=True, randomize=False,
+                host_small_steps=False),
+        mesh_plan=MeshPlan(make_mesh()),
+    )
+    assert ctx.warmer is not None and ctx.warmer.enabled
+    try:
+        ctx.warmer.prewarm(st.num_gates)
+        assert ctx.warmer.wait_idle(300)
+        ws = ctx.warmup_stats()
+        assert ws["warm_compiled"] >= 2 and ws["warm_failed"] == 0, ws
+        from sboxgates_tpu.search import warmup as W
+
+        hits = {"n": 0}
+        orig = W.mesh_warm_lookup
+
+        def spy(name, mesh, statics, args):
+            r = orig(name, mesh, statics, args)
+            if r is not None:
+                hits["n"] += 1
+            return r
+
+        import sboxgates_tpu.parallel.mesh as M
+
+        monkeypatch.setattr(
+            M, "_mesh_warm_lookup",
+            lambda name, mesh, statics, args: spy(name, mesh, statics, args),
+        )
+        out_warm = lut3_search(ctx, st.copy(), target, mask, [])
+        assert out_warm == out_lazy
+        assert hits["n"] >= 1, "sharded dispatch missed the warm cache"
+    finally:
+        ctx.warmer.shutdown()
 
 
 # -------------------------------------------------------------------------
