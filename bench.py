@@ -933,7 +933,137 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
          # throughput numbers from such a window are suspect.
          "dispatch_retries": c2.stats.get("dispatch_retries", 0),
          "deadline_breaches": c2.stats.get("deadline_breaches", 0),
+         # Replicated degradation protocol counters (nonzero only on
+         # process-spanning meshes; zero here documents the single-host
+         # zero-barrier contract — and a nonzero value on a pod bench
+         # window means ranks were aborting in agreement mid-measure).
+         "breach_barriers": c2.stats.get("breach_barriers", 0),
+         "replicated_aborts": c2.stats.get("replicated_aborts", 0),
+         "degraded_ranks": c2.stats.get("degraded_ranks", 0),
          "guard_mode": "strict" if strict_guards else "count"},
+    ]
+
+
+def bench_degrade_protocol(windows: int = None) -> list:
+    """Per-dispatch verdict-barrier overhead of the replicated
+    degradation protocol (BENCH_DEGRADE.json).
+
+    Three arms over the same no-op dispatch (the dispatch is free, so
+    the window cost IS the guard/protocol overhead):
+
+    - ``deadline_guard_window`` — plain :func:`dispatch_with_retry`
+      (one abandonable worker per window): the single-host baseline.
+    - ``verdict_barrier_local`` — :func:`replicated_dispatch_with_retry`
+      with the real ``distributed.breach_verdict`` in a single-process
+      runtime (its zero-round-trip fast path) plus the
+      ``sbg-abort-watch`` worker: the protocol's bookkeeping floor.
+    - ``verdict_barrier_loopback`` — a 2-party in-process loopback
+      verdict (queue handoff to a live peer thread): the cross-thread
+      rendezvous a coordinator exchange rides on; a real pod adds one
+      coordinator RTT over DCN on top.
+
+    The protocol takes ONE barrier per guarded WINDOW — a sharded stream
+    resolve sweeps its whole rank window (many chunks) inside one
+    guarded dispatch — so these per-window costs amortize over the
+    in-dispatch chunk loop rather than multiplying it.
+
+    A final injected-hang sequence captures the protocol counters
+    (breach_barriers / replicated_aborts / degraded_ranks) exactly as a
+    degraded rank reports them in ctx.stats / --host-stream output."""
+    import queue
+    import threading
+
+    from sboxgates_tpu.parallel import distributed as dist
+    from sboxgates_tpu.resilience import faults
+    from sboxgates_tpu.resilience.deadline import (
+        DeadlineConfig,
+        DispatchTimeout,
+        dispatch_with_retry,
+        replicated_dispatch_with_retry,
+    )
+
+    if windows is None:
+        windows = 50 if SMOKE else 200
+    cfg = DeadlineConfig(budget_s=30.0, retries=0)
+
+    def timed(run_window):
+        # Median over REPEATS batches of `windows` windows each.
+        vals = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for _ in range(windows):
+                run_window()
+            vals.append((time.perf_counter() - t0) / windows)
+        vals.sort()
+        return vals[len(vals) // 2]
+
+    base = timed(lambda: dispatch_with_retry(lambda: None, cfg))
+    local = timed(
+        lambda: replicated_dispatch_with_retry(
+            lambda: None, cfg, verdict=dist.breach_verdict
+        )
+    )
+
+    q_in: "queue.Queue" = queue.Queue()
+    q_out: "queue.Queue" = queue.Queue()
+
+    def peer():
+        while True:
+            b = q_in.get()
+            if b is None:
+                return
+            q_out.put(bool(b))  # peer reports ok; agreement = any()
+
+    t = threading.Thread(target=peer, name="bench-verdict-peer",
+                         daemon=True)
+    t.start()
+
+    def loopback_verdict(breached):
+        q_in.put(breached)
+        return bool(q_out.get())
+
+    loop = timed(
+        lambda: replicated_dispatch_with_retry(
+            lambda: None, cfg, verdict=loopback_verdict
+        )
+    )
+    q_in.put(None)
+    t.join(timeout=5)
+
+    # Counter capture: one injected-hang schedule through the protocol.
+    faults.disarm("dispatch.sweep")
+    faults.arm("dispatch.sweep", "hang")
+    stats: dict = {}
+    try:
+        replicated_dispatch_with_retry(
+            lambda: None,
+            DeadlineConfig(budget_s=0.05, retries=2, backoff_s=0.01),
+            verdict=lambda breached: breached,
+            stats=stats,
+        )
+        raise AssertionError("injected hang did not breach")
+    except DispatchTimeout:
+        pass
+    finally:
+        faults.disarm("dispatch.sweep")
+
+    return [
+        {"metric": "deadline_guard_window", "value": base,
+         "unit": "s/dispatch", "windows": windows},
+        {"metric": "verdict_barrier_local", "value": local,
+         "unit": "s/dispatch", "overhead_vs_guard_s": local - base,
+         "windows": windows},
+        {"metric": "verdict_barrier_loopback", "value": loop,
+         "unit": "s/dispatch", "overhead_vs_guard_s": loop - base,
+         "windows": windows,
+         "note": "in-process 2-party rendezvous; a real pod adds one "
+                 "coordinator RTT over DCN per window"},
+        {"metric": "replicated_degrade_counters",
+         "breach_barriers": stats.get("breach_barriers", 0),
+         "replicated_aborts": stats.get("replicated_aborts", 0),
+         "degraded_ranks": stats.get("degraded_ranks", 0),
+         "dispatch_retries": stats.get("dispatch_retries", 0),
+         "deadline_breaches": stats.get("deadline_breaches", 0)},
     ]
 
 
@@ -1939,6 +2069,11 @@ def main() -> None:
         )
         with open(os.path.join(HERE, "BENCH_PIPELINE.json"), "w") as f:
             json.dump(detail, f, indent=1)
+        # Replicated-degradation protocol overhead + counters ride the
+        # same mode (the deadline-guard counters already report here).
+        degrade = bench_degrade_protocol()
+        with open(os.path.join(HERE, "BENCH_DEGRADE.json"), "w") as f:
+            json.dump(degrade, f, indent=1)
         pipelined = detail[-1]
         print(json.dumps({
             "metric": "lut5_host_stream_speedup",
@@ -1947,6 +2082,12 @@ def main() -> None:
             "overlap": pipelined.get("overlap"),
             "dispatch_retries": pipelined.get("dispatch_retries"),
             "deadline_breaches": pipelined.get("deadline_breaches"),
+            "breach_barriers": pipelined.get("breach_barriers"),
+            "replicated_aborts": pipelined.get("replicated_aborts"),
+            "degraded_ranks": pipelined.get("degraded_ranks"),
+            "verdict_barrier_overhead_s": degrade[2].get(
+                "overhead_vs_guard_s"
+            ),
         }))
         return
 

@@ -165,6 +165,7 @@ JOURNAL_CONFIG_KEYS = (
     "serial_mux",
     "mesh",
     "fleet",
+    "shard_sweep",
     "pipeline_depth",
 )
 
@@ -190,16 +191,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             SearchJournal,
         )
 
-        if args.shard_sweep:
-            # Job-sharded sweeps are journal-free (every process owns its
-            # own slice's side effects); silently restarting would look
-            # like a resume while discarding the journal's progress claim.
-            return _err(
-                "--resume-run cannot be combined with --shard-sweep: "
-                "job-sharded sweeps restart instead of resuming — restart "
-                "the sharded run with --output-dir to journal fresh "
-                "progress (ROADMAP open item)."
-            )
+        # The journaled configuration decides whether this is a sharded
+        # resume; an explicit --shard-sweep only cross-checks it (below).
+        shard_requested = args.shard_sweep
         try:
             journal = SearchJournal.resume(args.resume_run)
         except JournalError as e:
@@ -223,6 +217,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"Error: journal in {args.resume_run} lacks the recorded "
                 f"setting {e}; it was written by an incompatible build — "
                 "re-run the search instead of resuming."
+            )
+        if shard_requested and not args.shard_sweep:
+            return _err(
+                f"Error: journal in {args.resume_run} records a "
+                "non-sharded run, but --shard-sweep was given; resume "
+                "without it (the journaled configuration decides the "
+                "execution mode)."
             )
         if journal.complete:
             print(
@@ -409,15 +410,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"Target S-box only has {n_out} outputs."
         )
 
-    # Crash-safe journaling: on for every primary-process search with an
-    # output directory, except job-sharded sweeps (every process would
-    # contend for one journal) and the multibox one-output driver.
-    multibox_sweep = multibox or args.permute_sweep
-    journaling = (
-        args.output_dir is not None
-        and not args.shard_sweep
-        and not (multibox_sweep and args.single_output != -1)
-    )
+    # Crash-safe journaling: on for every search with an output
+    # directory.  Journals are coordinator-owned (resilience.journal):
+    # one writer each — the primary rank for the run journal, the
+    # slice-owning rank for a job-sharded sweep's shard journal, the
+    # job's coordinator for the per-job journals of the one-output
+    # multibox driver.
+    journaling = args.output_dir is not None
     if journaling and args.seed is None:
         # Materialize the seed so the journal can reproduce the run: a
         # resumed search must draw the exact same PRNG stream.
@@ -445,15 +444,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet=args.fleet,
     )
 
+    # ONE construction serves both the journal's recorded configuration
+    # and the multi-process startup agreement digest below — they must
+    # never drift (a key recorded but not digested would let desynced
+    # ranks pass the agreement).
+    run_config = {key: getattr(args, key) for key in JOURNAL_CONFIG_KEYS}
+    run_config["input"] = [os.path.abspath(p) for p in args.input]
+    run_config["graph"] = (
+        os.path.abspath(args.graph) if args.graph is not None else None
+    )
+    journal_config = None
+    if journaling:
+        journal_config = dict(run_config)
+        if args.shard_sweep:
+            journal_config["shard_processes"] = (
+                jax.process_count() if multiprocess else 1
+            )
     if journaling and not resume:
         from .resilience.journal import SearchJournal
 
-        config = {key: getattr(args, key) for key in JOURNAL_CONFIG_KEYS}
-        config["input"] = [os.path.abspath(p) for p in args.input]
-        config["graph"] = (
-            os.path.abspath(args.graph) if args.graph is not None else None
-        )
-        journal = SearchJournal.start(args.output_dir, config=config)
+        # The run journal is coordinator-owned: only the global primary
+        # writes it (for a job-sharded sweep it is config-only — each
+        # rank's progress goes to its own shard journal below).
+        if not multiprocess or jax.process_index() == 0:
+            journal = SearchJournal.start(
+                args.output_dir, config=journal_config
+            )
     elif journal is not None and not journaling:
         # Resuming on a process whose side effects are disabled (the
         # non-primary ranks of a multi-host run: output_dir was nulled
@@ -464,6 +480,73 @@ def main(argv: Optional[List[str]] = None) -> int:
         journal.readonly = True
     elif not journaling:
         journal = None
+
+    if journaling and args.shard_sweep:
+        # Job-sharded sweeps: each rank coordinates — and journals — its
+        # own slice under shard-NN/ (checkpoint paths stay relative to
+        # the top-level --output-dir, where the per-box subdirectories
+        # live).  Resume requires the same process count: the slice
+        # assignment is round-robin by rank.
+        from .resilience.journal import (
+            JournalError,
+            SearchJournal,
+            shard_dir,
+        )
+
+        rank = jax.process_index() if multiprocess else 0
+        nproc = jax.process_count() if multiprocess else 1
+        if resume:
+            rec_procs = (journal.config if journal is not None else {}).get(
+                "shard_processes"
+            )
+            if rec_procs != nproc:
+                return _err(
+                    f"Error: journal in {args.resume_run} records a "
+                    f"{rec_procs}-process --shard-sweep run; resume with "
+                    f"the same process count (this run has {nproc})."
+                )
+        scfg = dict(journal_config)
+        scfg["shard_index"] = rank
+        if resume:
+            try:
+                journal = SearchJournal.resume(
+                    shard_dir(args.output_dir, rank),
+                    ckpt_root=args.output_dir,
+                )
+            except JournalError:
+                # This rank crashed before its shard journal existed:
+                # its slice re-runs from scratch — deterministic, so the
+                # resumed sweep still matches the uninterrupted one.
+                journal = SearchJournal.start(
+                    shard_dir(args.output_dir, rank), config=scfg,
+                    ckpt_root=args.output_dir,
+                )
+        else:
+            journal = SearchJournal.start(
+                shard_dir(args.output_dir, rank), config=scfg,
+                ckpt_root=args.output_dir,
+            )
+
+    if multiprocess:
+        # Startup agreement on the run configuration (the
+        # journal_seq_check pattern at the run boundary): every rank —
+        # sharded or pod-wide — must be executing the same journaled
+        # configuration, or the first collective (or slice assignment)
+        # would silently diverge.
+        import hashlib
+        import json as _json
+
+        # run_config includes input/graph: two ranks resuming DIFFERENT
+        # run directories can share every flag (same explicit seed) yet
+        # target different S-boxes — exactly the silent divergence this
+        # check exists for.
+        digest = hashlib.sha256(
+            _json.dumps(run_config, sort_keys=True, default=str).encode()
+        ).hexdigest()
+        try:
+            dist.run_config_check(digest)
+        except RuntimeError as e:
+            return _err(f"Error: {e}")
     mesh_plan = None
     fleet_plan = None
     if args.shard_sweep or args.mesh:
@@ -551,11 +634,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         try:
             if args.single_output != -1:
-                # The one-output multibox driver is journal-free (see
-                # `journaling` above): a kill there restarts the sweep.
                 search_boxes_one_output(
                     ctx, boxes, args.single_output,
                     save_dir=args.output_dir, log=log, batched=batched,
+                    journal=journal,
                 )
             else:
                 search_boxes_all_outputs(

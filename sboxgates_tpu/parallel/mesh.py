@@ -31,6 +31,17 @@ resolves inside ``SearchContext._multihost_dispatch``'s deferred
 ``resolve()`` — dispatch now, DCN sync only when the consumer needs the
 verdict.
 
+Every blocking resolve of these process-spanning collectives
+(:func:`sharded_feasible_stream` verdict syncs via
+``SearchContext._multihost_dispatch``, :func:`sharded_pivot_stream`
+rounds via ``search.lut._lut5_search_pivot``) runs under
+``SearchContext.guarded_dispatch``, which on a spanning mesh is the
+replicated degradation protocol
+(``resilience.deadline.replicated_dispatch_with_retry``): a hung window
+is abandoned, re-issued, and — past the retry budget — degraded to the
+host-fallback drivers by pod-wide agreement, never by one host's local
+clock.
+
 A second mesh axis (``"restarts"``) batches independent randomized search
 restarts — parallelism the reference lacks (SURVEY.md §2.10): ``vmap`` over
 per-restart targets/seeds composes with the candidate sharding.

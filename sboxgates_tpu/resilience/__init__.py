@@ -20,7 +20,11 @@ cases.  This package makes every one of them survivable:
   hung-dispatch guard (generalized from bench.py's ad-hoc tunnel-death
   watchdog): a blocked device sweep raises :class:`DispatchTimeout`
   within the configured budget, retries with exponential backoff, and the
-  search drivers then degrade to the host-fallback path.
+  search drivers then degrade to the host-fallback path.  On
+  process-spanning meshes, :func:`replicated_dispatch_with_retry` makes
+  the abort/retry/degrade decisions by pod-wide agreement (one
+  breach-verdict barrier per guarded window), so every rank abandons a
+  hung collective together instead of one host deadlocking the others.
 """
 
 from .checkpoint import (
@@ -30,7 +34,12 @@ from .checkpoint import (
     verify_digest,
     with_digest,
 )
-from .deadline import DeadlineConfig, DispatchTimeout, dispatch_with_retry
+from .deadline import (
+    DeadlineConfig,
+    DispatchTimeout,
+    dispatch_with_retry,
+    replicated_dispatch_with_retry,
+)
 from .faults import InjectedFault, arm, disarm, fault_point
 from .journal import SearchJournal
 
@@ -43,6 +52,7 @@ __all__ = [
     "DeadlineConfig",
     "DispatchTimeout",
     "dispatch_with_retry",
+    "replicated_dispatch_with_retry",
     "InjectedFault",
     "arm",
     "disarm",

@@ -12,13 +12,23 @@ degrade to its host-fallback path (see ``search.lut.lut5_search``).
 Multi-host note: a process-spanning mesh runs its sweeps as pod-wide
 collectives, so abort/retry decisions MUST be replicated — a process that
 locally times out and re-issues while its peers keep waiting deadlocks
-the collective.  The guard is therefore disabled on process-spanning
-meshes unless explicitly forced (``SBG_DISPATCH_TIMEOUT_MULTIHOST=1``,
-for deployments whose budgets and clocks are tight enough that every
-process breaches together); the retry *schedule* itself is deterministic
-(fixed budget, fixed backoff), never derived from locally divergent
-state, so forced mode keeps processes aligned when their breaches do
-coincide.
+the collective.  :func:`replicated_dispatch_with_retry` is the
+process-spanning variant: every guarded window ends in ONE verdict
+barrier (``verdict``, normally
+:func:`sboxgates_tpu.parallel.distributed.breach_verdict`) where each
+host reports breach-vs-ok for its in-flight resolve and learns the
+agreed verdict (breach if ANY host breached).  On an agreed breach ALL
+hosts abandon the window together, re-issue on the same deterministic
+backoff schedule, and — when the schedule exhausts — raise
+:class:`DispatchTimeout` on every host in the same window, so the
+callers' host-fallback degradation (and the ``ctx.device_degraded``
+circuit breaker) flips in lockstep across the pod.  The barrier itself
+runs in an abandonable ``sbg-abort-watch`` worker under the same budget:
+a peer that cannot reach the barrier (killed rank, dead coordinator) is
+indistinguishable from a breach and is treated as one, so the survivors
+abort together instead of waiting forever.  The guard is ON by default
+on process-spanning meshes whenever a deadline budget is configured;
+``SBG_DISPATCH_TIMEOUT_MULTIHOST=0`` opts a deployment out.
 """
 
 from __future__ import annotations
@@ -57,7 +67,11 @@ class DeadlineConfig:
     budget_s: float = 0.0
     retries: int = 2
     backoff_s: float = 0.25
-    multihost: bool = False
+    #: Guard process-spanning meshes too (the replicated-verdict abort
+    #: protocol keeps abort/retry/degrade decisions in lockstep).  ON by
+    #: default; ``SBG_DISPATCH_TIMEOUT_MULTIHOST=0`` opts out for
+    #: deployments that prefer an unguarded pod.
+    multihost: bool = True
 
     @property
     def enabled(self) -> bool:
@@ -66,12 +80,13 @@ class DeadlineConfig:
 
 def config_from_env() -> DeadlineConfig:
     """SBG_DISPATCH_TIMEOUT_S / SBG_DISPATCH_RETRIES /
-    SBG_DISPATCH_BACKOFF_S / SBG_DISPATCH_TIMEOUT_MULTIHOST."""
+    SBG_DISPATCH_BACKOFF_S / SBG_DISPATCH_TIMEOUT_MULTIHOST (opt-out)."""
     return DeadlineConfig(
         budget_s=float(os.environ.get("SBG_DISPATCH_TIMEOUT_S", "0")),
         retries=max(0, int(os.environ.get("SBG_DISPATCH_RETRIES", "2"))),
         backoff_s=float(os.environ.get("SBG_DISPATCH_BACKOFF_S", "0.25")),
-        multihost=os.environ.get("SBG_DISPATCH_TIMEOUT_MULTIHOST", "0") == "1",
+        multihost=os.environ.get("SBG_DISPATCH_TIMEOUT_MULTIHOST", "1")
+        != "0",
     )
 
 
@@ -163,4 +178,160 @@ def dispatch_with_retry(
             delay *= 2
             if on_retry is not None:
                 on_retry()
+    raise AssertionError("unreachable")
+
+
+def _bump(stats: Optional[dict], key: str, by: int = 1) -> None:
+    if stats is not None:
+        with _stats_lock:
+            stats[key] = stats.get(key, 0) + by
+
+
+def verdict_transport_timeout(budget_s: float) -> float:
+    """How long the verdict TRANSPORT (the coordination-service barrier
+    in ``distributed.breach_verdict``) may wait for peers: two window
+    budgets — a peer that resolved instantly and one that breached at
+    the full budget enter the same barrier one budget apart — plus one
+    second of exchange slack.  ONE function shared by the transport and
+    the abort watcher's abandon bound (which adds its own margin on
+    top), so the two deadlines can never be tuned apart: a watcher that
+    gives up before the transport would have completed splits the
+    agreement."""
+    return 2.0 * max(budget_s, 0.0) + 1.0
+
+
+def _verdict_barrier(
+    verdict: Callable[[bool], bool], breached: bool, budget_s: float,
+    label: str = "",
+) -> bool:
+    """One replicated verdict-barrier round: report this host's
+    breach-vs-ok, learn the agreed verdict.
+
+    The barrier is itself a cross-host wait, and the failure it exists to
+    survive (a killed rank, a dead coordinator) makes it unreachable — so
+    it runs in its own abandonable ``sbg-abort-watch`` worker bounded by
+    :func:`verdict_transport_timeout` (twice the window budget: a
+    healthy peer may enter its verdict up to one full window later than
+    us — its resolve ran the whole budget before breaching) PLUS a fixed
+    margin, and only a barrier unreachable past that IS an agreed
+    breach: the peers that cannot answer are exactly the ones the abort
+    protocol must write off.  The margin ordering is load-bearing — the
+    watcher must outlast the transport's own deadline
+    (``breach_verdict`` waits exactly ``verdict_transport_timeout``), or
+    one rank could abandon a barrier its peers go on to complete,
+    splitting the "agreed" verdict and re-creating the unreplicated
+    abort this protocol exists to prevent.  Marks the ``dist.verdict`` fault site on
+    the watcher before entering the barrier (hang/crash injection there
+    exercises the unreachable-barrier path deterministically).  Barrier
+    errors other than a timeout propagate — a verdict transport raising
+    is a loud configuration/runtime bug, not a breach signal.
+    """
+    box: dict = {}
+    done = threading.Event()
+
+    def _abort_watch() -> None:
+        try:
+            fault_point("dist.verdict")
+            box["value"] = bool(verdict(breached))
+        except BaseException as e:  # delivered below
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=_abort_watch, name="sbg-abort-watch", daemon=True
+    )
+    worker.start()
+    abandon_s = verdict_transport_timeout(budget_s) + 5.0
+    if not done.wait(abandon_s):
+        logger.warning(
+            "verdict barrier%s unreachable within %.2gs (killed rank / "
+            "dead coordinator?); treating the window as an agreed breach",
+            f" [{label}]" if label else "", abandon_s,
+        )
+        return True
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def replicated_dispatch_with_retry(
+    fn: Callable,
+    cfg: Optional[DeadlineConfig],
+    verdict: Callable[[bool], bool],
+    stats: Optional[dict] = None,
+    label: str = "",
+    on_retry: Optional[Callable[[], None]] = None,
+    site: str = "dispatch.sweep",
+):
+    """Process-spanning counterpart of :func:`dispatch_with_retry`: the
+    replicated degradation protocol.
+
+    Every attempt window runs the blocking resolve under the deadline,
+    then joins exactly ONE verdict barrier (one barrier per window, never
+    per chunk — the sharded streams sweep many chunks inside one
+    resolve, and the barrier rides the resolve): each host reports
+    breach-vs-ok and ``verdict`` returns the agreed outcome.  On an
+    agreed OK the local result is returned (it is replicated by
+    construction — the sharded kernels all-gather their verdicts).  On an
+    agreed breach EVERY host — including ones whose local resolve
+    completed — abandons the window, sleeps the same deterministic
+    backoff, re-issues via ``on_retry``, and tries again; when the
+    schedule exhausts, every host raises :class:`DispatchTimeout` in the
+    same window, so driver degradation to the host-fallback paths (and
+    the ``ctx.device_degraded`` circuit-breaker flip) happens in
+    lockstep.
+
+    Counters (under the shared stats lock): ``breach_barriers`` (verdict
+    rounds joined), ``deadline_breaches`` (local breaches),
+    ``replicated_aborts`` (windows abandoned on an agreed breach, local
+    or remote), ``dispatch_retries`` (re-issues), and ``degraded_ranks``
+    (this rank exhausting its schedule and raising).
+
+    ``cfg=None`` / disabled short-circuits inline with zero barriers,
+    exactly like the single-host guard.
+    """
+
+    def attempt():
+        fault_point(site)
+        return fn()
+
+    if cfg is None or not cfg.enabled:
+        return attempt()
+    delay = cfg.backoff_s
+    for k in range(cfg.retries + 1):
+        breached = False
+        value = None
+        try:
+            value = run_with_deadline(attempt, cfg.budget_s, label)
+        except DispatchTimeout:
+            breached = True
+            _bump(stats, "deadline_breaches")
+        agreed = _verdict_barrier(verdict, breached, cfg.budget_s, label)
+        _bump(stats, "breach_barriers")
+        if not agreed:
+            return value
+        _bump(stats, "replicated_aborts")
+        if k == cfg.retries:
+            _bump(stats, "degraded_ranks")
+            logger.warning(
+                "replicated abort%s: agreed breach window %d/%d — retry "
+                "schedule exhausted, every rank degrades together",
+                f" [{label}]" if label else "", k + 1, cfg.retries + 1,
+            )
+            raise DispatchTimeout(
+                f"device dispatch{f' [{label}]' if label else ''} "
+                f"abandoned by replicated agreement after "
+                f"{cfg.retries + 1} windows of {cfg.budget_s:g}s"
+            )
+        _bump(stats, "dispatch_retries")
+        logger.warning(
+            "replicated abort%s: agreed breach (local %s); retry %d/%d "
+            "in %.2fs", f" [{label}]" if label else "",
+            "breach" if breached else "ok", k + 1, cfg.retries, delay,
+        )
+        time.sleep(delay)
+        delay *= 2
+        if on_retry is not None:
+            on_retry()
     raise AssertionError("unreachable")

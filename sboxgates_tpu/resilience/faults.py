@@ -18,6 +18,7 @@ the ones production code fires today):
 ``dispatch.sweep``        issuing/resolving one device sweep dispatch
 ``native.devcb``          servicing one native-engine device-work callback
 ``warmup.compile``        one background AOT kernel compile (KernelWarmer)
+``dist.verdict``          entering one replicated breach-verdict barrier
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -32,6 +33,16 @@ the Nth hit, ``N+`` on the Nth and every later one; omitted means ``1+``
 (every hit).  Hit counting is per-process and thread-safe; with a fixed
 seed the schedules are deterministic, so the same spec kills the same
 point every run.
+
+Rank targeting — a site name may carry an ``@rank:N`` suffix
+(``dispatch.sweep@rank:1:hang@2``): the fault then fires only on the
+process whose distributed rank is ``N`` (``set_rank``, called by
+``parallel.distributed.initialize``; overridable via ``SBG_FAULT_RANK``
+for single-process tests).  This is how the multi-process harness hangs
+or kills exactly one rank of a pod to exercise the replicated abort
+protocol deterministically — every process can share one ``SBG_FAULTS``
+value.  Hit counting for a rank-targeted site happens only on the
+matching rank.
 """
 
 from __future__ import annotations
@@ -58,6 +69,7 @@ KNOWN_SITES = (
     "dispatch.sweep",
     "native.devcb",
     "warmup.compile",
+    "dist.verdict",
 )
 
 
@@ -76,11 +88,48 @@ class _Spec:
 
 
 _WHEN_RE = re.compile(r"^(\d+)(\+?)$")
+_RANK_RE = re.compile(r"@rank:(\d+)$")
 
 _lock = threading.Lock()
 _specs: Dict[str, _Spec] = {}
 _hits: Dict[str, int] = {}
 _env_loaded = False
+_rank: Optional[int] = None
+#: True when any armed site is rank-targeted — recomputed under _lock by
+#: every _specs mutation, so fault_point's fast path reads ONE bool
+#: instead of iterating _specs (which background threads would race
+#: against a concurrent arm()/disarm() resize).
+_rank_targeted = False
+
+
+def _note_specs_changed() -> None:
+    """Caller holds _lock: refresh the rank-targeting flag."""
+    global _rank_targeted
+    _rank_targeted = any("@rank:" in s for s in _specs)
+
+
+def set_rank(rank: Optional[int]) -> None:
+    """Pins this process's distributed rank for ``@rank:N``-targeted
+    sites (called by ``parallel.distributed.initialize``); ``None``
+    restores the environment-variable fallback (tests)."""
+    global _rank
+    _rank = None if rank is None else int(rank)
+
+
+def _process_rank() -> int:
+    """Rank used for ``@rank:N`` matching: explicit :func:`set_rank` >
+    ``SBG_FAULT_RANK`` > ``JAX_PROCESS_ID`` > 0.  Never imports jax — the
+    unarmed fault fast path must stay a dict lookup."""
+    if _rank is not None:
+        return _rank
+    for var in ("SBG_FAULT_RANK", "JAX_PROCESS_ID"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
 
 
 def parse_spec(text: str) -> Dict[str, _Spec]:
@@ -90,12 +139,19 @@ def parse_spec(text: str) -> Dict[str, _Spec]:
         part = part.strip()
         if not part:
             continue
-        fields = part.split(":")
-        if len(fields) != 2:
+        # rsplit: the SITE itself may contain ':' (the @rank:N suffix).
+        fields = part.rsplit(":", 1)
+        if len(fields) != 2 or not fields[0]:
             raise ValueError(
-                f"bad fault spec {part!r}: expected 'site:action[@when]'"
+                f"bad fault spec {part!r}: expected "
+                "'site[@rank:N]:action[@when]'"
             )
         site, action = fields
+        if ":" in site and not _RANK_RE.search(site):
+            raise ValueError(
+                f"bad fault site {site!r} in {part!r}: a ':' in a site "
+                "name is only valid as an '@rank:N' suffix"
+            )
         when = "1+"
         if "@" in action:
             action, _, when = action.partition("@")
@@ -121,6 +177,7 @@ def _load_env() -> None:
     text = os.environ.get("SBG_FAULTS", "")
     if text:
         _specs.update(parse_spec(text))
+    _note_specs_changed()
 
 
 def arm(site: str, action: str, when: str = "1+") -> None:
@@ -129,6 +186,7 @@ def arm(site: str, action: str, when: str = "1+") -> None:
     with _lock:
         _load_env()
         _specs.update(spec)
+        _note_specs_changed()
 
 
 def disarm(site: Optional[str] = None) -> None:
@@ -142,6 +200,7 @@ def disarm(site: Optional[str] = None) -> None:
         else:
             _specs.pop(site, None)
             _hits.pop(site, None)
+        _note_specs_changed()
 
 
 def hit_count(site: str) -> int:
@@ -153,24 +212,37 @@ def hit_count(site: str) -> int:
 def fault_point(site: str) -> None:
     """Marks a named fault site; fires the armed action, if any.
 
-    The unarmed fast path is one dict lookup — cheap enough for
+    The unarmed fast path is one or two dict lookups (the plain name and
+    this process's ``@rank:N``-qualified variant) — cheap enough for
     per-chunk and per-node call sites.
     """
     if not _env_loaded and not _specs:
         with _lock:
             _load_env()
-    spec = _specs.get(site)
-    if spec is None:
+    # Both the plain name and this process's rank-qualified variant are
+    # live when armed — arming "X" pod-wide AND "X@rank:N" for one rank
+    # honors both schedules (each keeps its own hit counter; the plain
+    # spec fires first on a tie).  The rank-qualified lookup happens
+    # only when some armed site is rank-targeted, so the common unarmed
+    # path stays at most two dict gets.
+    names = [site]
+    if _rank_targeted:
+        names.append(f"{site}@rank:{_process_rank()}")
+    if all(_specs.get(n) is None for n in names):
         return
+    spec = None
+    hit = 0
     with _lock:
         # Re-read under the lock: a concurrent disarm() may have won.
-        spec = _specs.get(site)
-        if spec is None:
-            return
-        hit = _hits.get(site, 0) + 1
-        _hits[site] = hit
-        fire = spec.fires(hit)
-    if not fire:
+        for n in names:
+            s = _specs.get(n)
+            if s is None:
+                continue
+            h = _hits.get(n, 0) + 1
+            _hits[n] = h
+            if spec is None and s.fires(h):
+                spec, hit, site = s, h, n
+    if spec is None:
         return
     if spec.action == "raise":
         raise InjectedFault(f"injected fault at {site} (hit {hit})")

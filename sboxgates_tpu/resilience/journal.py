@@ -25,6 +25,20 @@ Granularity is the driver's natural unit (an iteration for the one-output
 driver, a beam round for the full-graph and multibox drivers): a kill
 anywhere inside a unit re-runs that unit from its recorded PRNG state,
 which reproduces it exactly.
+
+Ownership model (coordinator-owned journals): every journal has exactly
+ONE writer — its coordinator.  For a single-process run that is the
+process; for a pod-wide multi-host run the primary rank owns the run
+journal and the non-primary ranks hold the READONLY view (restore
+without racing the writer).  Process-spanning sweeps that shard JOBS
+across ranks (``--shard-sweep``) decompose into per-job journals keyed
+by job id under the run directory: each rank coordinates — and
+journals — only the jobs of its own slice (:func:`shard_dir` for the
+per-rank run journal, :meth:`SearchJournal.for_job` for a job's
+journal), so ``--resume-run`` restores every shard exactly with no
+cross-rank write contention.  The multibox one-output driver uses the
+same per-job journals (one per box, under the box's checkpoint
+subdirectory) whether sharded or not.
 """
 
 from __future__ import annotations
@@ -36,7 +50,10 @@ from typing import Any, Dict, List, Optional
 from .checkpoint import clean_stale_tmp, durable_write_text
 from .faults import fault_point
 
-JOURNAL_VERSION = 1
+#: Version 2: per-job / per-shard journal layout (shard-NN run journals,
+#: job_done / jobs_done records, ``shard_sweep`` + ``shard_processes``
+#: in the recorded configuration).
+JOURNAL_VERSION = 2
 JOURNAL_NAME = "search.journal.jsonl"
 SNAPSHOT_NAME = "search.journal.json"
 #: Snapshot refresh cadence (appends).  The JSONL is the source of truth
@@ -49,6 +66,12 @@ SNAPSHOT_EVERY = 8
 
 class JournalError(Exception):
     """The journal is missing, unreadable, or inconsistent."""
+
+
+def shard_dir(root: str, rank: int) -> str:
+    """Per-rank run-journal directory of a job-sharded sweep: rank ``r``
+    coordinates (and journals) its slice under ``root/shard-0r/``."""
+    return os.path.join(root, f"shard-{rank:02d}")
 
 
 class SearchJournal:
@@ -64,22 +87,35 @@ class SearchJournal:
     """
 
     def __init__(
-        self, directory: str, records: List[dict], readonly: bool = False
+        self, directory: str, records: List[dict], readonly: bool = False,
+        ckpt_root: Optional[str] = None,
     ):
         self.directory = directory
         self.records = records
         #: Read-only journals restore progress but never write: the
-        #: non-primary processes of a multi-host resume share the run
-        #: directory for restore, while writes stay rank-0-owned.
+        #: non-coordinator processes of a multi-host resume share the run
+        #: directory for restore, while writes stay coordinator-owned.
         self.readonly = readonly
+        #: Root the recorded checkpoint paths resolve against.  Defaults
+        #: to the journal's own directory; per-shard run journals
+        #: (``shard_dir``) set it to the run's top-level --output-dir,
+        #: where the per-box checkpoint subdirectories actually live.
+        self.ckpt_root = ckpt_root
+        #: True when this handle continued an existing journal (resume)
+        #: rather than starting a fresh one — per-job journals derive
+        #: their own fresh-vs-resume behavior from the run journal's.
+        self.resumed = False
         self._unsnapshotted = 0
 
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def start(cls, directory: str, config: Dict[str, Any]) -> "SearchJournal":
+    def start(
+        cls, directory: str, config: Dict[str, Any],
+        ckpt_root: Optional[str] = None,
+    ) -> "SearchJournal":
         os.makedirs(directory, exist_ok=True)
-        j = cls(directory, [])
+        j = cls(directory, [], ckpt_root=ckpt_root)
         # A new run in the directory owns it: drop the previous run's
         # snapshot FIRST (a crash between the truncate and the run_start
         # append must not leave an empty JSONL next to a stale snapshot
@@ -93,14 +129,18 @@ class SearchJournal:
         return j
 
     @classmethod
-    def resume(cls, directory: str, readonly: bool = False) -> "SearchJournal":
+    def resume(
+        cls, directory: str, readonly: bool = False,
+        ckpt_root: Optional[str] = None,
+    ) -> "SearchJournal":
         records = cls.load_records(directory)
         if not records or records[0].get("type") != "run_start":
             raise JournalError(
                 f"no resumable journal in {directory!r} "
                 f"(missing run_start record)"
             )
-        j = cls(directory, records, readonly=readonly)
+        j = cls(directory, records, readonly=readonly, ckpt_root=ckpt_root)
+        j.resumed = True
         if not readonly:
             # Re-materialize the JSONL as exactly the parsed records: a
             # crash mid-append can leave a torn, newline-less tail, and
@@ -127,6 +167,42 @@ class SearchJournal:
                     "with the parsed records", directory, e,
                 )
         return j
+
+    @classmethod
+    def for_job(
+        cls, root: str, job_id: str, config: Dict[str, Any], *,
+        resume: bool, readonly: bool = False,
+    ) -> "SearchJournal":
+        """One JOB's journal under ``root/job_id/`` — the per-job half of
+        the coordinator-owned layout.  Exactly one rank (the job's
+        coordinator) holds the writable handle; a rank that only needs to
+        replay the job's progress for lockstep (the non-primary view of a
+        pod-wide run) passes ``readonly=True``.
+
+        ``resume=False`` starts fresh (truncating any stale journal a
+        previous run left in the job directory); ``resume=True``
+        continues the existing journal, or starts fresh when the job
+        never journaled before the kill — re-running such a job from its
+        recorded PRNG position reproduces it exactly.  A readonly view of
+        a job with no journal yet is an empty no-op handle."""
+        d = os.path.join(root, job_id)
+        if readonly:
+            # A readonly view of a FRESH run must be empty even if a
+            # stale journal from a previous run still sits in the job
+            # directory — only the coordinator's start() truncates it,
+            # and racing that truncation would replay stale progress.
+            if resume:
+                try:
+                    return cls.resume(d, readonly=True)
+                except JournalError:
+                    pass
+            return cls(d, [], readonly=True)
+        if resume:
+            try:
+                return cls.resume(d)
+            except JournalError:
+                pass
+        return cls.start(d, config)
 
     @property
     def writable(self) -> bool:
@@ -225,7 +301,11 @@ class SearchJournal:
         return self.last("run_done") is not None
 
     def load_checkpoint(self, filename: str):
-        """Loads a beam-member checkpoint recorded by filename."""
+        """Loads a beam-member checkpoint recorded by filename (resolved
+        against ``ckpt_root`` when set — per-shard run journals record
+        paths relative to the run's top-level output directory)."""
         from ..graph.xmlio import load_state
 
-        return load_state(os.path.join(self.directory, filename))
+        return load_state(
+            os.path.join(self.ckpt_root or self.directory, filename)
+        )

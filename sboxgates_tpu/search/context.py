@@ -393,6 +393,15 @@ class SearchContext:
             # guard counters.
             "dispatch_retries": 0,
             "deadline_breaches": 0,
+            # Replicated degradation protocol (process-spanning meshes):
+            # verdict-barrier rounds joined, windows abandoned on an
+            # agreed breach, and retry schedules exhausted on this rank
+            # (the lockstep host-fallback degradations).  All zero on
+            # single-host / non-spanning runs — the protocol takes no
+            # barrier round trips there (tests/test_deadline.py).
+            "breach_barriers": 0,
+            "replicated_aborts": 0,
+            "degraded_ranks": 0,
             # Every device dispatch, whichever path issues it: direct
             # registry calls (kernel_call) and rendezvous/fleet groups.
             # The fleet bench's O(N)->O(1) dispatch-count claim reads
@@ -537,18 +546,51 @@ class SearchContext:
         deadline (resilience.deadline): breach -> retry with backoff ->
         :class:`DispatchTimeout` for the caller to degrade on.  Also the
         ``dispatch.sweep`` fault-injection site.  Disabled (inline call)
-        when no budget is configured, and on process-spanning meshes
-        unless explicitly forced — abort/retry decisions there must stay
-        replicated across processes, never derived from one process's
-        local clock."""
+        when no budget is configured.
+
+        On a process-spanning mesh the guard routes through the
+        replicated degradation protocol
+        (:func:`resilience.deadline.replicated_dispatch_with_retry`):
+        every window ends in one breach-verdict barrier
+        (``distributed.breach_verdict``), abort/retry happen by pod-wide
+        agreement, and the final :class:`DispatchTimeout` — and with it
+        the callers' ``device_degraded`` circuit-breaker flip — fires on
+        every rank in the same window.  On by default whenever a budget
+        is configured; ``SBG_DISPATCH_TIMEOUT_MULTIHOST=0`` opts the pod
+        out.  Non-spanning runs never touch the barrier (zero verdict
+        round trips; ``breach_barriers`` stays 0)."""
         cfg = self.deadline_cfg
         if (
             cfg.enabled
-            and not cfg.multihost
             and self.mesh_plan is not None
             and self.mesh_plan.spans_processes
         ):
-            cfg = None
+            if not cfg.multihost:
+                # Explicit opt-out: no guard at all on the spanning mesh
+                # (an unreplicated local abort would deadlock the peers).
+                cfg = None
+            else:
+                from ..parallel import distributed as dist
+
+                # The transport waits verdict_transport_timeout for
+                # peers (a healthy peer enters its verdict up to one
+                # full budget later than a host that resolved
+                # instantly); the protocol's abort watcher is bounded by
+                # the SAME formula plus margin, so it always outlasts
+                # the transport — the two deadlines splitting would
+                # split the agreement itself.
+                budget = cfg.budget_s
+
+                return _deadline.replicated_dispatch_with_retry(
+                    fn, cfg,
+                    verdict=lambda breached: dist.breach_verdict(
+                        breached,
+                        timeout_s=_deadline.verdict_transport_timeout(
+                            budget
+                        ),
+                    ),
+                    stats=self.stats, label=label, on_retry=on_retry,
+                )
         return _deadline.dispatch_with_retry(
             fn, cfg, stats=self.stats, label=label, on_retry=on_retry
         )
@@ -561,7 +603,11 @@ class SearchContext:
         own filter dispatches would otherwise block forever, turning the
         "survivable hang" into an eternal one.  Gets the whole retry
         schedule's budget in one window; a breach propagates
-        :class:`DispatchTimeout` so the search fails loudly."""
+        :class:`DispatchTimeout` so the search fails loudly.  Applies on
+        process-spanning meshes too (degradation there is lockstep by
+        the replicated protocol, and the fallback drivers make only
+        process-local dispatches), honoring the same
+        ``SBG_DISPATCH_TIMEOUT_MULTIHOST=0`` opt-out."""
         cfg = self.deadline_cfg
         if (
             not cfg.enabled
@@ -575,6 +621,30 @@ class SearchContext:
         return _deadline.run_with_deadline(
             fn, cfg.budget_s * (cfg.retries + 1), label
         )
+
+    def trip_device_breaker(self) -> None:
+        """Flips the device circuit breaker (sticky for the run): later
+        LUT sweeps route straight to the host-fallback drivers instead
+        of re-probing a known-dead device.
+
+        On a process-spanning mesh the trip also DEMOTES the context to
+        process-local execution — mesh plan dropped, placed-operand
+        caches invalidated so later placements land on the local device.
+        The pod's collectives are exactly what was written off; the
+        fallback drivers must not depend on them (a spanning-sharded
+        array is not even fully addressable for the host recount), and
+        each rank sweeping the space redundantly on its own devices is
+        deterministic, so results stay identical across the pod.  The
+        replicated protocol raises the final DispatchTimeout on every
+        rank in the same agreed window, so this demotion is itself
+        lockstep — no rank keeps dispatching to a pod the others have
+        written off."""
+        self.device_degraded = True
+        if self.mesh_plan is not None and self.mesh_plan.spans_processes:
+            self.mesh_plan = None
+            self._binom = None
+            self._pair_combo_cache.clear()
+            self.invalidate_device_tables()
 
     def next_seed(self) -> int:
         """Per-dispatch kernel seed.  Negative when not randomizing: the
@@ -978,27 +1048,58 @@ class SearchContext:
         full gather so no feasible row is ever dropped (completeness is
         identical to the single-host stream).  The collective is issued
         now; the verdict sync and (rare) overflow re-drive happen inside
-        the returned resolve()."""
+        the returned resolve(), each under :meth:`guarded_dispatch` —
+        which on this (process-spanning) mesh is the replicated abort
+        protocol, so a hung window is abandoned and re-issued by pod-wide
+        agreement (the ``on_retry`` hooks re-issue the collective on
+        every rank in lockstep, keeping launch order aligned)."""
         from ..parallel.mesh import GATHER_ROWS, sharded_feasible_stream
 
         per = chunk // n
         cap = min(GATHER_ROWS, per)
-        verdict, row_idx, feas_c, r1_c, r0_c = sharded_feasible_stream(
-            self.mesh_plan, *args, k=k, chunk=chunk, compact=True
-        )
+
+        def issue():
+            return sharded_feasible_stream(
+                self.mesh_plan, *args, k=k, chunk=chunk, compact=True
+            )
+
+        pending = {"out": issue()}
 
         def resolve():
-            vec = self.sync_verdict(phase, verdict)
+            ckey = threading.get_ident()
+            vec = self.guarded_dispatch(
+                lambda: self.sync_verdict(
+                    phase, pending["out"][0], consumer=ckey
+                ),
+                f"feasible_stream.gather k={k}",
+                on_retry=lambda: pending.update(out=issue()),
+            )
+            _, row_idx, feas_c, r1_c, r0_c = pending["out"]
             found, cstart, examined = (int(x) for x in vec[:3])
             counts = vec[3:]
             if not found:
                 return False, cstart, None, None, None, examined, chunk
             if counts.max() > cap:
-                # Overflow: fetch this exact chunk in full (start=cstart).
-                _, feas, r1, r0 = sharded_feasible_stream(
-                    self.mesh_plan, *args[:-2], cstart, args[-1], k=k,
-                    chunk=chunk, compact=False,
+                # Overflow: fetch this exact chunk in full (start=cstart)
+                # — a second pod-wide collective, guarded as its own
+                # window (the overflow decision is replicated: counts
+                # ride the fully-replicated verdict, so every rank takes
+                # this branch together).
+                def issue_full():
+                    return sharded_feasible_stream(
+                        self.mesh_plan, *args[:-2], cstart, args[-1], k=k,
+                        chunk=chunk, compact=False,
+                    )
+
+                full = {"out": issue_full()}
+                self.guarded_dispatch(
+                    lambda: self.sync_verdict(
+                        phase, full["out"][0], consumer=ckey
+                    ),
+                    f"feasible_stream.redrive k={k}",
+                    on_retry=lambda: full.update(out=issue_full()),
                 )
+                _, feas, r1, r0 = full["out"]
                 return True, cstart, feas, r1, r0, examined, chunk
             # Reconstruct the dense per-chunk arrays from the compacted
             # rows.

@@ -591,7 +591,12 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
     device sweep whose retries all breach the budget degrades to the
     host-chunked fallback driver, which sweeps the identical space in the
     identical chunk order — the returned first hit matches the device
-    stream's."""
+    stream's.  On a process-spanning mesh the breach/retry/degrade
+    decisions are replicated (``guarded_dispatch`` routes through the
+    verdict-barrier protocol), so the :class:`DispatchTimeout` caught
+    here — and the ``device_degraded`` circuit-breaker flip below — fire
+    on every rank in the same window and the whole pod degrades to the
+    host drivers in lockstep."""
     g = st.num_gates
     if g < 5:
         return None
@@ -612,7 +617,7 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         logger.warning(
             "%s; degrading the 5-LUT sweep to the host-fallback driver", e
         )
-        ctx.device_degraded = True
+        ctx.trip_device_breaker()
         return _lut5_search_host(ctx, st, target, mask, inbits)
 
 
@@ -799,7 +804,7 @@ def lut5_resume_overflow(
             "%s; degrading the overflow-resume 5-LUT sweep to the "
             "host-fallback driver", e,
         )
-        ctx.device_degraded = True
+        ctx.trip_device_breaker()
         res = _lut5_search_host(ctx, st, target, mask, inbits)
     return res
 
@@ -955,7 +960,7 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
                 "%s; degrading 7-LUT stage A to the host-chunked driver", e
             )
             ctx.stats["lut7_candidates"] = cand_before
-            ctx.device_degraded = True
+            ctx.trip_device_breaker()
             hit_combos, hit_req1, hit_req0, nhits = [], [], [], 0
             use_device_stream = False
     if not use_device_stream:

@@ -179,6 +179,37 @@ def _save_dir_for(save_dir: Optional[str], name: str) -> Optional[str]:
     return d
 
 
+def _job_journals(
+    ctx: SearchContext,
+    boxes: Sequence[BoxJob],
+    output: int,
+    save_dir: Optional[str],
+    journal,
+) -> Optional[dict]:
+    """Per-job (per-box) journals for the one-output driver, derived from
+    the run journal handle: same root as the checkpoints, fresh-vs-resume
+    and writable-vs-readonly inherited from the run journal (the job's
+    coordinator holds the writable handle; a non-primary pod rank holds
+    readonly views so its replay stays in lockstep)."""
+    if journal is None:
+        return None
+    from ..resilience.journal import SearchJournal
+
+    root = (
+        save_dir if save_dir is not None
+        else (journal.ckpt_root or journal.directory)
+    )
+    return {
+        box.name: SearchJournal.for_job(
+            root, box.name,
+            {"job": box.name, "output": output,
+             "iterations": ctx.opt.iterations},
+            resume=journal.resumed, readonly=journal.readonly,
+        )
+        for box in boxes
+    }
+
+
 def search_boxes_one_output(
     ctx: SearchContext,
     boxes: Sequence[BoxJob],
@@ -186,6 +217,7 @@ def search_boxes_one_output(
     save_dir: Optional[str] = ".",
     log: Callable[[str], None] = print,
     batched: Optional[bool] = None,
+    journal=None,
 ) -> dict:
     """Single-output search across every box: ``iterations`` attempts per
     box, all attempts of all boxes as one batch round.  Returns
@@ -194,45 +226,130 @@ def search_boxes_one_output(
     Unlike the serial single-box driver, attempts are independent (no
     budget ratchet between a box's iterations) — parallel-restart
     semantics, reference-equivalent to one process per attempt.
+
+    ``journal`` (the run journal handle) turns on per-job journaling
+    (:func:`_job_journals`): in the serial mode every (box, iteration)
+    attempt appends a ``job_done`` record — checkpoint name plus the host
+    PRNG position — to ITS BOX's journal, so a killed sweep resumes with
+    the completed attempts replayed from their checkpoints and the PRNG
+    continued exactly (bit-identical results, the one-output analog of
+    ``iter_done``).  In the batched/fleet modes the wave is the atomic
+    unit (all per-restart seeds are drawn in one up-front block): each
+    box records one ``jobs_done`` after the wave, a mid-wave kill re-runs
+    the whole wave deterministically, and a resume after completion
+    replays the recorded checkpoints.
     """
     batched = _auto_batched(ctx, batched, boxes)
     r = ctx.opt.iterations
-    jobs, meta = [], []
     for box in boxes:
         if output >= box.n_out:
             raise ValueError(
                 f"{box.name}: can't generate output bit {output}; "
                 f"box only has {box.n_out} outputs"
             )
-        for _ in range(r):
-            jobs.append(
-                (State.init_inputs(box.num_inputs), box.targets[output], box.mask)
-            )
-            meta.append(box)
+    jj = _job_journals(ctx, boxes, output, save_dir, journal)
     log(
         f"Searching output {output} of {len(boxes)} S-boxes, "
         f"{r} iteration{'s' if r != 1 else ''} each "
-        f"({len(jobs)} {_mode_name(batched)} jobs)..."
+        f"({len(boxes) * r} {_mode_name(batched)} jobs)..."
     )
     results: dict = {box.name: [] for box in boxes}
-    for box, (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):
+
+    def fold(box, nst, out) -> Optional[str]:
+        """Logs + saves one finished attempt; returns its checkpoint
+        name (relative to the box directory) or None."""
         if out == NO_GATE:
             log(f"{box.name}: not found.")
-            continue
+            return None
         nst.outputs[output] = out
         log(
             f"{box.name}: {nst.num_gates - nst.num_inputs} gates. "
             f"SAT metric: {nst.sat_metric}"
         )
-        d = _save_dir_for(save_dir, box.name)
-        if d is not None:
-            save_state(nst, d)
         results[box.name].append(nst)
+        d = _save_dir_for(save_dir, box.name)
+        if d is None:
+            return None
+        return os.path.basename(save_state(nst, d))
+
+    if jj is not None and not batched:
+        # Journaled serial loop: identical job order (box-major x
+        # iteration) and PRNG consumption as the unjournaled driver;
+        # completed attempts replay from their checkpoints.
+        for box in boxes:
+            jr = jj[box.name]
+            done_recs = {rec["it"]: rec for rec in jr.of_type("job_done")}
+            for it in range(r):
+                rec = done_recs.get(it)
+                if rec is not None:
+                    ctx.rng_restore(rec["rng"])
+                    if rec.get("ckpt"):
+                        results[box.name].append(
+                            jr.load_checkpoint(rec["ckpt"])
+                        )
+                    log(
+                        f"{box.name}: iteration {it + 1}/{r} resumed "
+                        "from the journal."
+                    )
+                    continue
+                nst = State.init_inputs(box.num_inputs)
+                out = create_circuit(
+                    ctx, nst, box.targets[output], box.mask, []
+                )
+                ckpt = fold(box, nst, out)
+                jr.append(
+                    "job_done", it=it, ckpt=ckpt, rng=ctx.rng_snapshot()
+                )
+                fault_point("search.round")
+    elif jj is not None and all(
+        jj[box.name].last("jobs_done") is not None for box in boxes
+    ):
+        # Batched resume with every box recorded: replay the wave.
+        for box in boxes:
+            rec = jj[box.name].last("jobs_done")
+            ctx.rng_restore(rec["rng"])
+            results[box.name] = [
+                jj[box.name].load_checkpoint(p) for p in rec["files"]
+            ]
+            log(f"{box.name}: resumed from the journal.")
+    else:
+        # Fresh (or mid-wave-killed) batched/fleet sweep: the whole wave
+        # re-runs from the run's recorded PRNG state — deterministic, so
+        # boxes that DID get their jobs_done record before a kill
+        # reproduce identical checkpoints and keep their records.
+        jobs, meta = [], []
+        for box in boxes:
+            for _ in range(r):
+                jobs.append(
+                    (
+                        State.init_inputs(box.num_inputs),
+                        box.targets[output],
+                        box.mask,
+                    )
+                )
+                meta.append(box)
+        files: dict = {box.name: [] for box in boxes}
+        for box, (nst, out) in zip(meta, _run_jobs(ctx, jobs, batched)):
+            ckpt = fold(box, nst, out)
+            if ckpt is not None:
+                files[box.name].append(ckpt)
+        if jj is not None:
+            for box in boxes:
+                if jj[box.name].last("jobs_done") is None:
+                    jj[box.name].append(
+                        "jobs_done", files=files[box.name],
+                        rng=ctx.rng_snapshot(),
+                    )
     for states in results.values():
         if ctx.opt.metric == GATES:
             states.sort(key=lambda s: -s.num_gates)
         else:
             states.sort(key=lambda s: -s.sat_metric)
+    if journal is not None and journal.writable and not journal.complete:
+        journal.append(
+            "run_done",
+            boxes={name: len(states) for name, states in results.items()},
+        )
     return results
 
 
@@ -355,16 +472,22 @@ def search_boxes_all_outputs(
                 rng=ctx.rng_snapshot(),
             )
             fault_point("search.round")
-        # Every process joins the round barrier (journal or not): a
-        # desynced multi-host resume — one peer restored from a stale
-        # directory — must fail loudly here, not deadlock the next
-        # collective with misaligned seed streams (same contract as
-        # generate_graph's _round_checkpoint).
-        from ..parallel import distributed as dist
+        # Every process of a POD-WIDE run joins the round barrier
+        # (journal or not): a desynced multi-host resume — one peer
+        # restored from a stale directory — must fail loudly here, not
+        # deadlock the next collective with misaligned seed streams
+        # (same contract as generate_graph's _round_checkpoint).
+        # Job-sharded sweeps (a non-spanning local mesh per process)
+        # skip it: slices progress through different round counts by
+        # design, each rank's shard journal validates locally, and the
+        # cross-rank config agreement was checked once at startup
+        # (distributed.run_config_check).
+        if ctx.mesh_plan is None or ctx.mesh_plan.spans_processes:
+            from ..parallel import distributed as dist
 
-        dist.journal_seq_check(
-            rnd, journal.seq if journal is not None else None
-        )
+            dist.journal_seq_check(
+                rnd, journal.seq if journal is not None else None
+            )
     if journal is not None:
         journal.append(
             "run_done",

@@ -302,9 +302,13 @@ def _round_checkpoint(ctx, journal, rnd: int, beam_states, save_dir) -> None:
             rng=ctx.rng_snapshot(),
         )
         fault_point("search.round")
-    # Non-primary processes carry journal=None; every process still joins
-    # the sequence-number broadcast so a desynced resume fails loudly
-    # instead of deadlocking the next collective.
+    # Non-primary processes carry journal=None; every process of a
+    # pod-wide run still joins the sequence-number broadcast so a
+    # desynced resume fails loudly instead of deadlocking the next
+    # collective.  A non-spanning (process-local) mesh skips it — its
+    # rounds are not cross-process lockstep units.
+    if ctx.mesh_plan is not None and not ctx.mesh_plan.spans_processes:
+        return
     from ..parallel import distributed as dist
 
     dist.journal_seq_check(rnd, journal.seq if journal is not None else None)

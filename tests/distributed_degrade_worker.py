@@ -1,0 +1,133 @@
+"""One process of the 2-process replicated-degradation tests.
+
+Spawned by test_distributed.py: connects into a 2-process CPU runtime,
+runs the planted 5-LUT search over the global (process-spanning) mesh
+once unfaulted (the bit-identity reference), then re-runs it with a
+rank-targeted ``dispatch.sweep@rank:1`` hang injected and the replicated
+deadline guard armed:
+
+- mode ``transient`` — the hang fires exactly once: both ranks must
+  agree on the breach at the verdict barrier, abandon + re-issue the
+  collective together, and RECOVER on the device path (no degradation).
+- mode ``exhaust`` — the hang fires every window: the retry schedule
+  exhausts, every rank raises the agreed DispatchTimeout in the same
+  window, trips the circuit breaker, and degrades to the host-fallback
+  driver in lockstep.
+
+Either way the faulted result must be bit-identical to the unfaulted
+reference, and both processes must print identical DEGRADE lines.
+
+Exits via os._exit(0) after flushing: the exercised failure modes leave
+abandoned daemon workers parked on wedged waits by design, and a normal
+interpreter exit would hand them to the distributed runtime's shutdown
+barrier.
+
+Usage: distributed_degrade_worker.py <process_id> <coordinator_port> <mode>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+mode = sys.argv[3]
+assert mode in ("transient", "exhaust"), mode
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("SBG_WARMUP", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from sboxgates_tpu.parallel import distributed as dist  # noqa: E402
+
+dist.initialize(f"127.0.0.1:{port}", 2, pid)
+assert jax.process_count() == 2, jax.process_count()
+
+from planted import build_planted_lut5_small  # noqa: E402
+
+from sboxgates_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+from sboxgates_tpu.resilience import faults  # noqa: E402
+from sboxgates_tpu.search import Options, SearchContext  # noqa: E402
+from sboxgates_tpu.search.lut import lut5_search  # noqa: E402
+
+
+def encode(res):
+    return "%d %d %s" % (
+        res["func_outer"],
+        res["func_inner"],
+        " ".join(str(g) for g in res["gates"]),
+    )
+
+
+st, target, mask = build_planted_lut5_small()
+
+ref_ctx = SearchContext(
+    Options(lut_graph=True, randomize=False),
+    mesh_plan=MeshPlan(make_mesh()),
+)
+ref = lut5_search(ref_ctx, st, target, mask, [])
+assert ref is not None, "unfaulted reference search found nothing"
+print("REF %d %s" % (pid, encode(ref)), flush=True)
+
+# Rank-targeted hang: only rank 1's guarded resolve ever blocks; rank 0
+# learns of the breach solely through the verdict barrier.  Budgets are
+# generous vs a healthy CPU resolve (the kernels are compiled by the
+# reference run above) yet keep the hang windows short.
+budget, retries = (30.0, 2) if mode == "transient" else (8.0, 2)
+faults.arm("dispatch.sweep@rank:1", "hang", "1" if mode == "transient" else "1+")
+ctx = SearchContext(
+    Options(lut_graph=True, randomize=False, dispatch_timeout_s=budget),
+    mesh_plan=MeshPlan(make_mesh()),
+)
+ctx.deadline_cfg.retries = retries
+ctx.deadline_cfg.backoff_s = 0.1
+res = lut5_search(ctx, st, target, mask, [])
+faults.disarm()
+
+assert res is not None, "faulted search found nothing"
+assert res == ref, (res, ref)  # bit-identical to the unfaulted run
+s = ctx.stats
+assert s["breach_barriers"] >= 1, s
+assert s["replicated_aborts"] >= 1, s
+if mode == "exhaust":
+    # Lockstep degradation: the schedule exhausted, this rank raised the
+    # agreed DispatchTimeout, tripped the breaker, and completed on the
+    # host-fallback driver (the mesh was demoted to local execution).
+    assert s["degraded_ranks"] == 1, s
+    assert ctx.device_degraded
+    assert ctx.mesh_plan is None
+else:
+    # Transient: one agreed abort + re-issue recovered the device path.
+    assert s["degraded_ranks"] == 0, s
+    assert not ctx.device_degraded
+    assert s["dispatch_retries"] >= 1, s
+
+print(
+    "DEGRADE %d mode=%s res=%s aborts>=1=%s degraded=%d"
+    % (
+        pid,
+        mode,
+        encode(res),
+        s["replicated_aborts"] >= 1,
+        s["degraded_ranks"],
+    ),
+    flush=True,
+)
+sys.stdout.flush()
+sys.stderr.flush()
+# Final rendezvous BEFORE the hard exit: rank 0 hosts the coordination
+# service, and exiting while the peer is still inside a barrier/KV wait
+# aborts the peer mid-assertion.
+client = dist._coordination_client()
+if client is not None:
+    client.wait_at_barrier("sbg-degrade-done", 120_000)
+os._exit(0)

@@ -76,16 +76,44 @@ def test_truncated_graph_file_is_one_line_error(tmp_path, capsys):
     assert bad in err and "Traceback" not in err
 
 
-def test_resume_run_with_shard_sweep_rejected_names_workaround(capsys):
-    """--resume-run + --shard-sweep is rejected (job-sharded sweeps are
-    journal-free and restart instead of resuming), and the one-line
-    error names the workaround: restart with --output-dir journaling."""
+def test_resume_run_shard_sweep_validation(tmp_path, capsys):
+    """--resume-run + --shard-sweep is no longer rejected outright
+    (job-sharded sweeps journal per shard and resume); validation now
+    covers the per-job layout: a missing journal is a one-line error,
+    an explicit --shard-sweep contradicting a non-sharded journal is a
+    one-line error, and a sharded journal records its process count and
+    rejects a resume with a different one."""
     rc = main(["--resume-run", "/tmp/does-not-exist", "--shard-sweep"])
     assert rc != 0
     err = capsys.readouterr().err
-    assert "--resume-run cannot be combined with --shard-sweep" in err
-    assert "--output-dir" in err  # the workaround, not just the refusal
-    assert err.strip().count("\n") == 0  # exactly one line
+    assert "no resumable journal" in err
+    assert err.strip().count("\n") == 0
+    assert "Traceback" not in err
+
+    # A sharded run records shard_processes; resuming under a different
+    # process count is rejected (slice assignment is round-robin by
+    # rank, so the shards would not line up).
+    import json
+
+    d = str(tmp_path)
+    rc = main([FA, "--permute-sweep", "--shard-sweep", "-o", "0", "-l",
+               "--seed", "3", "--output-dir", d])
+    assert rc == 0
+    jpath = os.path.join(d, "search.journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    assert recs[0]["config"]["shard_sweep"] is True
+    assert recs[0]["config"]["shard_processes"] == 1
+    assert os.path.isdir(os.path.join(d, "shard-00"))
+    recs[0]["config"]["shard_processes"] = 4
+    with open(jpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    os.unlink(os.path.join(d, "search.journal.json"))  # stale snapshot
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "4-process" in err and "process count" in err
+    assert err.strip().count("\n") == 0
     assert "Traceback" not in err
 
 

@@ -1,6 +1,9 @@
 """Hung-dispatch deadline tests: DispatchTimeout within the budget,
 retry with exponential backoff, and degradation to the host-fallback
-path with the same first hit (the acceptance property)."""
+path with the same first hit (the acceptance property).  Plus the
+replicated degradation protocol for process-spanning meshes: agreed
+abort/retry at the verdict barrier, lockstep degrade on exhaustion, and
+ZERO verdict round trips on single-host / non-spanning runs."""
 
 import time
 
@@ -13,6 +16,7 @@ from sboxgates_tpu.resilience.deadline import (
     DeadlineConfig,
     DispatchTimeout,
     dispatch_with_retry,
+    replicated_dispatch_with_retry,
     run_with_deadline,
 )
 from sboxgates_tpu.resilience.faults import InjectedFault
@@ -23,8 +27,10 @@ from sboxgates_tpu.search import lut as slut
 @pytest.fixture(autouse=True)
 def _clean_faults():
     faults.disarm()
+    faults.set_rank(None)
     yield
     faults.disarm()
+    faults.set_rank(None)
 
 
 def test_run_with_deadline_passthrough_and_timeout():
@@ -205,3 +211,223 @@ def test_lut7_device_timeout_degrades_to_host_chunks():
     assert (
         ctx.stats["lut7_candidates"] == ref_ctx.stats["lut7_candidates"]
     )
+
+
+# -- replicated degradation protocol (process-spanning meshes) -------------
+
+
+CFG = dict(budget_s=0.3, retries=2, backoff_s=0.01)
+
+
+def test_replicated_agreed_ok_returns_local_result():
+    """Happy path: one verdict barrier per window, local result returned
+    on an agreed OK."""
+    verdicts = []
+
+    def verdict(breached):
+        verdicts.append(breached)
+        return breached  # any(): nobody else breached
+
+    stats = {}
+    out = replicated_dispatch_with_retry(
+        lambda: "ok", DeadlineConfig(**CFG), verdict, stats=stats
+    )
+    assert out == "ok"
+    assert verdicts == [False]
+    assert stats["breach_barriers"] == 1
+    assert stats.get("replicated_aborts", 0) == 0
+    assert stats.get("deadline_breaches", 0) == 0
+
+
+def test_replicated_peer_breach_aborts_local_success():
+    """A PEER's breach aborts this rank's locally-successful window: the
+    result is discarded, the dispatch re-issued, and the retry's agreed
+    OK returns the fresh result — the lockstep-abort half of the
+    protocol."""
+    script = iter([True, False])  # window 1: peer breached; window 2: ok
+    reissues = []
+    stats = {}
+    out = replicated_dispatch_with_retry(
+        lambda: "ok",
+        DeadlineConfig(**CFG),
+        lambda breached: next(script),
+        stats=stats,
+        on_retry=lambda: reissues.append(1),
+    )
+    assert out == "ok"
+    assert reissues == [1]
+    assert stats["breach_barriers"] == 2
+    assert stats["replicated_aborts"] == 1
+    assert stats["dispatch_retries"] == 1
+    assert stats.get("deadline_breaches", 0) == 0  # local never breached
+
+
+def test_replicated_exhaustion_raises_in_lockstep():
+    """Agreed breaches through the whole schedule: every rank raises
+    DispatchTimeout in the SAME window (the callers' degrade + circuit
+    breaker then flip in lockstep), and degraded_ranks counts it."""
+    stats = {}
+    with pytest.raises(DispatchTimeout):
+        replicated_dispatch_with_retry(
+            lambda: "ok",
+            DeadlineConfig(budget_s=0.2, retries=1, backoff_s=0.01),
+            lambda breached: True,
+            stats=stats,
+        )
+    assert stats["replicated_aborts"] == 2
+    assert stats["dispatch_retries"] == 1
+    assert stats["degraded_ranks"] == 1
+
+
+def test_replicated_local_breach_and_hung_verdict_barrier():
+    """A local breach is counted AND agreed; an unreachable verdict
+    barrier (dist.verdict hang — a killed rank never answering) is
+    itself treated as an agreed breach, so survivors abort together
+    instead of waiting forever."""
+    faults.arm("dispatch.sweep", "hang")
+    stats = {}
+    with pytest.raises(DispatchTimeout):
+        replicated_dispatch_with_retry(
+            lambda: "x",
+            DeadlineConfig(budget_s=0.1, retries=0),
+            lambda breached: breached,
+            stats=stats,
+        )
+    assert stats["deadline_breaches"] == 1
+    assert stats["replicated_aborts"] == 1
+    faults.disarm()
+
+    faults.arm("dist.verdict", "hang")
+    stats = {}
+    t0 = time.monotonic()
+    with pytest.raises(DispatchTimeout):
+        replicated_dispatch_with_retry(
+            lambda: "x",
+            DeadlineConfig(budget_s=0.2, retries=0),
+            lambda breached: False,  # never reached: the watcher hangs
+            stats=stats,
+        )
+    # Bounded by the watcher's abandon bound (transport timeout 2b+1
+    # plus its fixed margin), not eternal.
+    assert time.monotonic() - t0 < 12.0
+    assert stats["replicated_aborts"] == 1
+    assert stats.get("deadline_breaches", 0) == 0
+
+
+def test_replicated_verdict_error_propagates():
+    """Verdict-transport errors are loud bugs, not breach signals."""
+    faults.arm("dist.verdict", "raise")
+    with pytest.raises(InjectedFault):
+        replicated_dispatch_with_retry(
+            lambda: "x", DeadlineConfig(**CFG), lambda breached: False
+        )
+
+
+def test_replicated_disabled_config_is_inline():
+    faults.arm("dispatch.sweep", "raise")
+    with pytest.raises(InjectedFault):
+        replicated_dispatch_with_retry(lambda: "x", None, lambda b: False)
+    with pytest.raises(InjectedFault):
+        replicated_dispatch_with_retry(
+            lambda: "x", DeadlineConfig(budget_s=0), lambda b: False
+        )
+
+
+def test_rank_targeted_fault_sites():
+    """SITE@rank:N fires only on the matching process rank — how the
+    multi-process harness hangs/kills exactly one rank of a pod."""
+    faults.arm("dispatch.sweep@rank:0", "raise")
+    faults.set_rank(1)
+    assert dispatch_with_retry(lambda: "x", None) == "x"  # wrong rank
+    assert faults.hit_count("dispatch.sweep@rank:0") == 0
+    faults.set_rank(0)
+    with pytest.raises(InjectedFault):
+        dispatch_with_retry(lambda: "x", None)
+    assert faults.hit_count("dispatch.sweep@rank:0") == 1
+    # Spec syntax: the site may carry the @rank:N suffix inside an
+    # SBG_FAULTS value; malformed colons still fail loudly.
+    spec = faults.parse_spec("dispatch.sweep@rank:1:hang@2")
+    assert "dispatch.sweep@rank:1" in spec
+    with pytest.raises(ValueError):
+        faults.parse_spec("dispatch:sweep:hang")
+    # Arming BOTH the plain site and a rank-qualified variant honors
+    # both schedules, each on its own hit counter.
+    faults.disarm()
+    faults.set_rank(1)
+    faults.arm("dispatch.sweep", "raise", "2")
+    faults.arm("dispatch.sweep@rank:1", "raise", "1")
+    with pytest.raises(InjectedFault):  # rank spec fires on its hit 1
+        dispatch_with_retry(lambda: "x", None)
+    with pytest.raises(InjectedFault):  # plain spec fires on its hit 2
+        dispatch_with_retry(lambda: "x", None)
+    assert faults.hit_count("dispatch.sweep") == 2
+    assert faults.hit_count("dispatch.sweep@rank:1") == 2
+
+
+def test_guarded_dispatch_routes_spanning_mesh_through_protocol(
+    monkeypatch,
+):
+    """SearchContext.guarded_dispatch on a process-spanning mesh runs the
+    replicated protocol (default ON now — SBG_DISPATCH_TIMEOUT_MULTIHOST
+    is an opt-out), and exhaustion raises the lockstep DispatchTimeout
+    the drivers degrade on."""
+    from sboxgates_tpu.parallel import MeshPlan, make_mesh
+    from sboxgates_tpu.parallel import distributed as dist
+
+    ctx = SearchContext(
+        Options(dispatch_timeout_s=0.2), mesh_plan=MeshPlan(make_mesh())
+    )
+    ctx.mesh_plan.spans_processes = True  # simulate a pod-wide mesh
+    ctx.deadline_cfg.retries = 1
+    ctx.deadline_cfg.backoff_s = 0.01
+    seen = []
+
+    def fake_verdict(breached, timeout_s=None):
+        seen.append(breached)
+        return bool(breached)
+
+    monkeypatch.setattr(dist, "breach_verdict", fake_verdict)
+    assert ctx.guarded_dispatch(lambda: 7, "t") == 7
+    assert seen == [False]
+    assert ctx.stats["breach_barriers"] == 1
+    faults.arm("dispatch.sweep", "hang")
+    with pytest.raises(DispatchTimeout):
+        ctx.guarded_dispatch(lambda: 7, "t")
+    faults.disarm()
+    assert ctx.stats["degraded_ranks"] == 1
+    assert ctx.stats["replicated_aborts"] == 2
+    # Opt-out: SBG_DISPATCH_TIMEOUT_MULTIHOST=0 drops the guard entirely
+    # on the spanning mesh (an unreplicated abort would deadlock peers).
+    ctx.deadline_cfg.multihost = False
+    seen.clear()
+    assert ctx.guarded_dispatch(lambda: 9, "t") == 9
+    assert seen == []
+
+
+def test_single_host_guarded_dispatch_zero_barriers(monkeypatch):
+    """Single-host behavior unchanged: guarded dispatch on a
+    NON-spanning mesh (and with no mesh) takes ZERO verdict-barrier
+    round trips, and first hits stay bit-identical with the protocol
+    compiled in."""
+    from sboxgates_tpu.parallel import MeshPlan, make_mesh
+    from sboxgates_tpu.parallel import distributed as dist
+
+    def boom(*a, **k):
+        raise AssertionError("verdict barrier on a non-spanning mesh")
+
+    monkeypatch.setattr(dist, "breach_verdict", boom)
+    st, target, mask = build_planted_lut5_small()
+    ref = slut.lut5_search(
+        SearchContext(Options(seed=1, lut_graph=True, randomize=False)),
+        st, target, mask, [],
+    )
+    assert ref is not None
+    for mesh_plan in (None, MeshPlan(make_mesh())):
+        ctx = SearchContext(
+            Options(seed=1, lut_graph=True, randomize=False,
+                    dispatch_timeout_s=30.0),
+            mesh_plan=mesh_plan,
+        )
+        assert slut.lut5_search(ctx, st, target, mask, []) == ref
+        assert ctx.stats["breach_barriers"] == 0
+        assert ctx.stats["replicated_aborts"] == 0

@@ -169,23 +169,136 @@ def test_resume_run_rejects_incompatible_journal(tmp_path, capsys):
     capsys.readouterr()
     assert main(["--resume-run", d]) != 0
     assert "version" in capsys.readouterr().err
-    recs[0]["version"] = 1
+    from sboxgates_tpu.resilience.journal import JOURNAL_VERSION
+
+    recs[0]["version"] = JOURNAL_VERSION
     del recs[0]["config"]["pipeline_depth"]  # an "older build's" journal
     with open(path, "w") as f:
         f.writelines(json.dumps(r) + "\n" for r in recs)
     assert main(["--resume-run", d]) != 0
     assert "incompatible" in capsys.readouterr().err
+    # A version-1 journal (the pre-per-job layout) is rejected by version,
+    # never half-read: the v2 layout added shard/per-job records the old
+    # reader semantics would silently misresume.
+    recs[0]["version"] = 1
+    recs[0]["config"]["pipeline_depth"] = 2
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    assert main(["--resume-run", d]) != 0
+    assert "version 1" in capsys.readouterr().err
 
 
-def test_resume_run_rejects_shard_sweep(tmp_path, capsys):
-    """Job-sharded sweeps restart instead of resuming; silently dropping
-    the journal would masquerade as a resume."""
+def test_resume_run_shard_sweep_mismatch_rejected(tmp_path, capsys):
+    """--resume-run restores the execution mode from the journal;
+    explicitly passing --shard-sweep against a NON-sharded journal is a
+    contradiction and fails with a one-line error (the journal decides),
+    while a sharded journal resumes without any extra flags."""
     d = str(tmp_path)
     assert main([FA, "--seed", "5", "--output-dir", d]) == 0
     capsys.readouterr()
     rc = main(["--resume-run", d, "--shard-sweep"])
     assert rc != 0
-    assert "--shard-sweep" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "non-sharded" in err
+    assert err.strip().count("\n") == 0
+    assert "Traceback" not in err
+
+
+def _shard_digests(root):
+    """{box: {filename: sha256}} for every per-box subdirectory."""
+    out = {}
+    for sub in sorted(os.listdir(root)):
+        p = os.path.join(root, sub)
+        if os.path.isdir(p) and not sub.startswith(("shard-", "xla_cache")):
+            out[sub] = xml_digests(p)
+    return out
+
+
+def test_shard_sweep_one_output_resumes_bit_identical(tmp_path, capsys):
+    """A killed --shard-sweep one-output sweep RESUMES (not restarts):
+    the per-job journals replay the completed boxes and continue the
+    PRNG exactly — final checkpoints bit-identical to the uninterrupted
+    sweep.  Single-process here (the process's slice is the whole
+    sweep); the 2-process version lives in test_distributed.py."""
+    argv = [FA, "--permute-sweep", "--shard-sweep", "-o", "0", "-l",
+            "--seed", SEED]
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    assert main(argv + ["--output-dir", ok]) == 0
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    # journal.append hits 1..18 are the run_start records (top-level +
+    # shard-00 + 16 per-job journals); job_done records start at 19.
+    # Kill after 6 of the 16 permutation jobs have journaled.
+    faults.arm("journal.append", "raise", "24")
+    try:
+        with pytest.raises(InjectedFault):
+            main(argv + ["--output-dir", killed])
+    finally:
+        faults.disarm()
+    interrupted = _shard_digests(killed)
+    assert interrupted != _shard_digests(ok)  # stopped short
+    capsys.readouterr()
+    assert main(["--resume-run", killed]) == 0
+    out = capsys.readouterr().out
+    # Resumed, not restarted: the journaled prefix replays from its
+    # checkpoints instead of re-searching.
+    assert "resumed from the journal" in out or "resumed" in out
+    assert _shard_digests(killed) == _shard_digests(ok)
+    # The shard run journal lives under shard-00/ (this process is the
+    # slice's coordinator).
+    assert os.path.exists(
+        os.path.join(killed, "shard-00", "search.journal.jsonl")
+    )
+    # Resuming the now-complete run is a cheap replay that exits 0.
+    assert main(["--resume-run", killed]) == 0
+    assert _shard_digests(killed) == _shard_digests(ok)
+
+
+def test_shard_sweep_all_outputs_resumes_bit_identical(tmp_path):
+    """The all-outputs (beam) driver under --shard-sweep journals its
+    lockstep rounds in the shard journal and resumes bit-identically
+    after a mid-round kill."""
+    argv = [FA, FA, "--shard-sweep", "--seed", SEED]
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    assert main(argv + ["--output-dir", ok]) == 0
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    faults.arm("search.round", "raise", "1")
+    try:
+        with pytest.raises(InjectedFault):
+            main(argv + ["--output-dir", killed])
+    finally:
+        faults.disarm()
+    assert main(["--resume-run", killed]) == 0
+    assert _shard_digests(killed) == _shard_digests(ok)
+
+
+def test_multibox_one_output_resumes_bit_identical(tmp_path, capsys):
+    """The (previously journal-free) multibox one-output driver now
+    journals per job: killed mid-sweep, it resumes with the completed
+    boxes replayed and bit-identical final checkpoints."""
+    argv = [DES, FA, "-o", "0", "-i", "2", "-l", "--serial-jobs",
+            "--seed", SEED]
+    ok = str(tmp_path / "ok")
+    os.makedirs(ok)
+    assert main(argv + ["--output-dir", ok]) == 0
+    killed = str(tmp_path / "killed")
+    os.makedirs(killed)
+    # Hits 1..3 are run_start records (top-level + 2 job journals);
+    # job_done records start at 4.  Kill inside the second box's
+    # attempts: the first box must replay, the tail re-run.
+    faults.arm("journal.append", "raise", "6")
+    try:
+        with pytest.raises(InjectedFault):
+            main(argv + ["--output-dir", killed])
+    finally:
+        faults.disarm()
+    capsys.readouterr()
+    assert main(["--resume-run", killed]) == 0
+    assert "resumed" in capsys.readouterr().out
+    assert _shard_digests(killed) == _shard_digests(ok)
 
 
 # -- full matrix: real crashes through the CLI subprocess (slow) ----------
