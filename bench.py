@@ -641,18 +641,109 @@ def bench_cold_start() -> list:
     return entries
 
 
-def bench_fleet() -> list:
-    """Fleet-batched search ladder (BENCH_FLEET.json): jobs/hour and
-    per-round device dispatch counts at 1 vs 8 vs 64 jobs.
+def _fleet_split_worker() -> list:
+    """(jobs, candidates) fleet-mesh device-split sweep — runs inside
+    the ``bench.py --fleet-split-worker`` subprocess (8 virtual CPU
+    devices).  For each split, times (a) one 64-lane stacked gate-step
+    sweep and (b) an 8-job device-routed toy fleet, asserting the
+    circuits are identical across splits (the split changes placement,
+    never results)."""
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.parallel import FleetPlan, make_fleet_mesh
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.fleet import fleet_gate_step, toy_fleet_boxes
+    from sboxgates_tpu.search.multibox import search_boxes_one_output
 
-    Two sections:
+    def grow(g, seed):
+        rng = np.random.default_rng(seed)
+        st = State.init_inputs(8)
+        while st.num_gates < g:
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(bf.XOR, int(a), int(b), GATES)
+        return st
+
+    dev = dict(
+        seed=7, lut_graph=True, randomize=False, host_small_steps=False,
+        native_engine=False,
+    )
+    mask = tt.mask_table(8)
+    sts = [grow(20, s) for s in range(64)]
+    rows = []
+    base_step = None
+    base_sig = None
+    for cands in (1, 2, 4):
+        plan = FleetPlan(make_fleet_mesh(candidates=cands))
+        gctx = SearchContext(
+            Options(seed=7, randomize=False, host_small_steps=False,
+                    native_engine=False),
+            fleet_plan=plan,
+        )
+        jobs = [(st, st.table(12).copy(), mask) for st in sts]
+        out = fleet_gate_step(gctx, jobs)  # warm the split's executable
+        t0 = time.perf_counter()
+        out = fleet_gate_step(gctx, jobs)
+        dt_step = time.perf_counter() - t0
+        if base_step is None:
+            base_step = out
+        else:
+            assert (out == base_step).all(), "split changed verdicts"
+        fctx = SearchContext(
+            Options(fleet=True, iterations=1, **dev), fleet_plan=plan
+        )
+        t0 = time.perf_counter()
+        res = search_boxes_one_output(
+            fctx, toy_fleet_boxes(8), 0, save_dir=None,
+            log=lambda s: None, batched="fleet",
+        )
+        dt_fleet = time.perf_counter() - t0
+        sig = {
+            name: [
+                [(g.type, g.in1, g.in2, g.in3, g.function)
+                 for g in s.gates]
+                for s in sts_
+            ]
+            for name, sts_ in res.items()
+        }
+        if base_sig is None:
+            base_sig = sig
+        else:
+            assert sig == base_sig, "split changed circuits"
+        rows.append({
+            "job_shards": plan.n_job_shards,
+            "candidate_shards": plan.n_candidate_shards,
+            "stacked_step_wall_s": round(dt_step, 4),
+            "fleet_8job_wall_s": round(dt_fleet, 3),
+        })
+    return rows
+
+
+def bench_fleet() -> list:
+    """Fleet-batched search ladder (BENCH_FLEET.json): jobs/hour,
+    per-round device dispatch counts, and the stacked jobs-bucket
+    ladder.
+
+    Four sections:
 
     - ``fleet_dispatch_ladder`` — device-routed toy fleets, where every
       node head dispatches: records total rendezvous device dispatches
       (groups) per fleet size and the ratio vs the 1-job baseline.  The
       O(N)->O(1) claim is ``dispatch_ratio_vs_1job`` staying O(1): a
       fleet of N merges its same-kind sweeps, so total dispatches track
-      the LONGEST job, not the sum (acceptance: <= 2x at 8 jobs).
+      the LONGEST job, not the sum (acceptance: <= 2x at 8 jobs; the
+      256-job rung additionally asserts bit-identical circuits vs the
+      serial loop — the stacked-wrapper acceptance gate).
+    - ``fleet_stacked_ladder`` — the stacked-operand single-kernel
+      sweep (``fleet_gate_step``) at 64/256/1024 lanes: ONE device
+      dispatch per rung (``dispatch_ratio_vs_flat_slices`` vs the
+      32-lane slicing a flat-capped fleet would need), per-lane verdict
+      parity vs the per-job kernel, and a t1-normalized jobs/hour
+      headline (t1 = the serial per-job dispatch loop, same window).
+      The stacked-vs-flat crossover is read from ``vs_flat_slices``.
+    - ``fleet_candidate_split`` — the 2-D (jobs, candidates) device
+      split measured at (8,1)/(4,2)/(2,4) over 8 virtual CPU devices
+      (subprocess), both for the stacked step and an 8-job toy fleet.
     - ``fleet_des_jobs_ladder`` — the production configuration (8 DES
       boxes, LUT mode, native-routed heads): jobs/hour at 1/8/64 jobs,
       fleet vs the serial per-job loop (the t1 baseline measured in the
@@ -688,7 +779,7 @@ def bench_fleet() -> list:
         assert all(sts for sts in res.values())
         return dt, ctx.stats
 
-    ladder = (1, 8, 16) if SMOKE else (1, 8, 64)
+    ladder = (1, 8, 16) if SMOKE else (1, 8, 64, 256)
     run_toys(ladder[1])  # warm the kernel shapes out of the timed arms
     run_toys(ladder[0])
     base_dispatches = None
@@ -697,7 +788,7 @@ def bench_fleet() -> list:
         dispatches = st.get("device_dispatches", 0)
         if base_dispatches is None:
             base_dispatches = max(dispatches, 1)
-        entries.append({
+        e = {
             "metric": f"fleet_dispatch_ladder_{n_jobs}job",
             "unit": "device dispatches (total for the fleet)",
             "value": dispatches,
@@ -709,9 +800,146 @@ def bench_fleet() -> list:
                 st.get("fleet_lanes", 0)
                 / max(st.get("fleet_dispatches", 0), 1), 2,
             ),
+            "stacked_dispatches": st.get("fleet_stacked_dispatches", 0),
             "dispatch_ratio_vs_1job": round(
                 dispatches / base_dispatches, 2
             ),
+        }
+        if n_jobs > 32:
+            # The stacked-wrapper acceptance gate: a >32-job wave's
+            # merged sweeps dispatch through the stacked jobs buckets
+            # (no 32-lane slicing), bit-identical to the serial loop.
+            from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+            boxes = toy_fleet_boxes(8)
+            iters = n_jobs // len(boxes)
+            ctx_s = SearchContext(Options(iterations=iters, **dev))
+            res_s = search_boxes_one_output(
+                ctx_s, boxes, 0, save_dir=None, log=lambda s: None,
+                batched=False,
+            )
+            ctx_f = SearchContext(
+                Options(fleet=True, iterations=iters, **dev)
+            )
+            res_f = search_boxes_one_output(
+                ctx_f, toy_fleet_boxes(8), 0, save_dir=None,
+                log=lambda s: None, batched="fleet",
+            )
+            sig = lambda res: {  # noqa: E731
+                name: [
+                    [(g.type, g.in1, g.in2, g.in3, g.function)
+                     for g in s.gates]
+                    for s in sts
+                ]
+                for name, sts in res.items()
+            }
+            assert sig(res_f) == sig(res_s)
+            e["gates_bitidentical_vs_serial"] = True
+            e["stacked_dispatches"] = ctx_f.stats.get(
+                "fleet_stacked_dispatches", 0
+            )
+        entries.append(e)
+
+    # -- section 1b: the stacked jobs-bucket ladder (single kernel) ------
+    from sboxgates_tpu.core import boolfunc as _bf
+    from sboxgates_tpu.core import ttable as _tt
+    from sboxgates_tpu.graph.state import GATES as _GATES, State as _State
+    from sboxgates_tpu.search.fleet import fleet_gate_step
+
+    def _grow_state(g, seed):
+        rng = np.random.default_rng(seed)
+        st = _State.init_inputs(8)
+        while st.num_gates < g:
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(_bf.XOR, int(a), int(b), _GATES)
+        return st
+
+    gmask = _tt.mask_table(8)
+    stacked_ladder = (64, 128) if SMOKE else (64, 256, 1024)
+    sts_all = [_grow_state(20, s) for s in range(max(stacked_ladder))]
+    gjobs_all = [
+        (st, st.table(12).copy(), gmask) for st in sts_all
+    ]
+    sctx = SearchContext(Options(
+        seed=7, randomize=False, host_small_steps=False,
+        native_engine=False,
+    ))
+    # Parity spot check: stacked verdicts == per-job kernel verdicts.
+    probe = fleet_gate_step(sctx, gjobs_all[:3])
+    for (st, t, m), row in zip(gjobs_all[:3], probe):
+        step, x0, _ = sctx.gate_step(st, t, m)
+        assert int(row[0]) == step and int(row[1]) == x0
+    for lanes in stacked_ladder:
+        jobs = gjobs_all[:lanes]
+        fleet_gate_step(sctx, jobs)  # warm the compiled shape
+        d0 = sctx.stats["device_dispatches"]
+        t0 = time.perf_counter()
+        fleet_gate_step(sctx, jobs)
+        dt = time.perf_counter() - t0
+        dispatches = sctx.stats["device_dispatches"] - d0
+        # Flat-capped arm: the same wave as ceil(n/32) 32-lane slices
+        # (the pre-PR-8 dispatch shape at this fleet size).
+        slices = [
+            jobs[lo : lo + 32] for lo in range(0, lanes, 32)
+        ]
+        for sl in slices[:1]:
+            fleet_gate_step(sctx, sl)  # warm the slice shape
+        t0 = time.perf_counter()
+        for sl in slices:
+            fleet_gate_step(sctx, sl)
+        dt_flat = time.perf_counter() - t0
+        # t1 arm: the serial per-job dispatch loop, same window.
+        t0 = time.perf_counter()
+        for st, t, m in jobs:
+            sctx.gate_step(st, t, m)
+        dt_serial = time.perf_counter() - t0
+        entries.append({
+            "metric": f"fleet_stacked_ladder_{lanes}lane",
+            "unit": "jobs/hour (one stacked node sweep per job, "
+                    "t1-normalized)",
+            "value": round(lanes / dt * 3600, 1),
+            "lanes": lanes,
+            "device_dispatches": dispatches,
+            "dispatch_ratio_vs_flat_slices": round(
+                dispatches / len(slices), 3
+            ),
+            "wall_s": round(dt, 4),
+            "flat_slices": len(slices),
+            "flat_slices_wall_s": round(dt_flat, 4),
+            "vs_flat_slices": round(dt_flat / dt, 3),
+            "t1_wall_s": round(dt_serial, 4),
+            "vs_t1": round(dt_serial / dt, 3),
+        })
+
+    # -- section 1c: (jobs, candidates) device-split sweep ---------------
+    # Spawned with 8 virtual CPU devices (this process may own only 1):
+    # the 2-D fleet mesh's candidate axis, exercised at every split.
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    r = subprocess.run(
+        [_sys.executable, os.path.abspath(__file__),
+         "--fleet-split-worker"],
+        capture_output=True, text=True, timeout=2400, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"fleet split worker failed: {r.stderr[-800:]}")
+    for row in json.loads(r.stdout.strip().splitlines()[-1]):
+        entries.append({
+            "metric": (
+                "fleet_candidate_split_"
+                f"{row['job_shards']}x{row['candidate_shards']}"
+            ),
+            "unit": "s (64-lane stacked step / 8-job toy fleet walls)",
+            "value": row["stacked_step_wall_s"],
+            **row,
         })
 
     # -- section 2: the DES fleet, production configuration --------------
@@ -758,6 +986,7 @@ def bench_fleet() -> list:
         entries.append(e)
         if n_jobs == 8:
             headline = e
+    top_jobs = ladder[-1]
     entries.append({
         "metric": "fleet_headline",
         "unit": "jobs/hour (8-job DES fleet, t1-normalized)",
@@ -767,6 +996,21 @@ def bench_fleet() -> list:
             e["dispatch_ratio_vs_1job"] for e in entries
             if e["metric"] == "fleet_dispatch_ladder_8job"
         ),
+        # The stacked-wrapper acceptance: the widest fleet's per-round
+        # node sweeps stay O(1) dispatches (no 32-lane slicing).
+        f"dispatch_ratio_{top_jobs}job_vs_1job": next(
+            e["dispatch_ratio_vs_1job"] for e in entries
+            if e["metric"] == f"fleet_dispatch_ladder_{top_jobs}job"
+        ),
+        # Stacked-vs-flat crossover (vs_flat_slices > 1 = stacked
+        # faster): on CPU the two are within noise at 64 lanes and flat
+        # slicing wins wall-clock at 1024 (no link latency to amortize
+        # — same caveat as the pipeline bench); the dispatch-count
+        # column is the hardware-independent half of the claim.
+        "stacked_vs_flat_slices_by_rung": {
+            str(e["lanes"]): e["vs_flat_slices"] for e in entries
+            if e["metric"].startswith("fleet_stacked_ladder_")
+        },
         "smoke": SMOKE,
     })
     return entries
@@ -2024,12 +2268,24 @@ def main() -> None:
     if "--cold-start-worker" in sys.argv:
         _cold_start_worker()
         return
+    if "--fleet-split-worker" in sys.argv:
+        # Subprocess mode (bench_fleet section 1c): env already pins CPU
+        # with 8 virtual devices; guard against the axon sitecustomize
+        # re-forcing the tunnel backend.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_fleet_split_worker()))
+        return
     if "--fleet" in sys.argv:
         # Standalone mode: the fleet-batched search ladder (jobs/hour +
-        # device dispatch counts at 1/8/64 jobs), written to
-        # BENCH_FLEET.json.  Honors JAX_PLATFORMS — on a CPU-only box
-        # run `JAX_PLATFORMS=cpu python bench.py --fleet` (optionally
-        # SBG_BENCH_SMOKE=1 for the short ladder).
+        # device dispatch counts at 1/8/64/256 jobs, the 64/256/1024-
+        # lane stacked jobs-bucket ladder, and the (jobs, candidates)
+        # device-split sweep), written to BENCH_FLEET.json.  Honors
+        # JAX_PLATFORMS — on a CPU-only box run `JAX_PLATFORMS=cpu
+        # python bench.py --fleet` (optionally SBG_BENCH_SMOKE=1 for
+        # the short ladder).
         if SMOKE:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax

@@ -82,11 +82,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "jobs buckets, pjit-sharded over a (jobs, "
                         "candidates) device mesh — per-round device round "
                         "trips drop from O(jobs) to O(1)")
+    p.add_argument("--fleet-candidates", type=int, default=1, metavar="C",
+                   help="candidate-axis shards inside each fleet lane: "
+                        "the (jobs, candidates) fleet mesh splits its "
+                        "devices (n/C, C), so candidate sweeps within a "
+                        "lane shard over the second axis while the job "
+                        "axis keeps P('jobs') (default 1 = every device "
+                        "on the job axis; must divide the local device "
+                        "count)")
+    p.add_argument("--fleet-max-wave", type=int, default=256, metavar="N",
+                   help="jobs per fleet wave (resident-thread cap, "
+                        "default 256).  The wave is the unit per-job "
+                        "seeds are drawn in, so this shapes the "
+                        "deterministic draw stream and is journaled for "
+                        "--resume-run")
     p.add_argument("--shard-sweep", action="store_true",
                    help="multi-host: partition the multi-box / permute "
                         "sweep across processes (each process searches its "
                         "own slice on a local-device mesh) instead of "
-                        "running every search as one pod-wide collective")
+                        "running every search as one pod-wide collective; "
+                        "with --fleet, each process runs its slice as a "
+                        "LOCAL fleet over its own devices (automatic "
+                        "multi-host fleet composition)")
     p.add_argument("--pipeline-depth", type=int, default=2, metavar="N",
                    help="in-flight dispatches / prefetched chunks for the "
                         "streaming sweep drivers (default 2; 1 = serial "
@@ -165,9 +182,25 @@ JOURNAL_CONFIG_KEYS = (
     "serial_mux",
     "mesh",
     "fleet",
+    # Fleet jobs-bucket shaping: the wave size blocks the per-job seed
+    # draws and the candidate split shapes the stacked dispatches —
+    # both must be restored for a --resume-run to replay the draw
+    # stream bit-identically.
+    "fleet_candidates",
+    "fleet_max_wave",
     "shard_sweep",
     "pipeline_depth",
 )
+
+#: Keys added to JOURNAL_CONFIG_KEYS after a journal version shipped:
+#: a journal written by an earlier build of the SAME version lacks
+#: them, and the value every such build effectively ran with is the
+#: flag default — restoring that default replays the old draw stream
+#: bit-identically, so the resume must not be rejected.
+JOURNAL_KEY_DEFAULTS = {
+    "fleet_candidates": 1,
+    "fleet_max_wave": 256,
+}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -211,6 +244,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.input = list(cfg["input"])
             args.graph = cfg["graph"]
             for key in JOURNAL_CONFIG_KEYS:
+                if key not in cfg and key in JOURNAL_KEY_DEFAULTS:
+                    setattr(args, key, JOURNAL_KEY_DEFAULTS[key])
+                    continue
                 setattr(args, key, cfg[key])
         except KeyError as e:
             return _err(
@@ -265,17 +301,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             "--shard-sweep requires a sweep to shard: multiple S-box "
             "files or --permute-sweep."
         )
-    if args.fleet and args.shard_sweep:
-        return _err(
-            "--fleet and --shard-sweep are incompatible: a fleet shards "
-            "the job axis over one device mesh, job sharding splits jobs "
-            "across processes — pick one."
-        )
     if args.fleet and args.serial_jobs:
         return _err(
             "--fleet and --serial-jobs are incompatible: the fleet's "
             "whole point is merging the jobs' dispatches."
         )
+    if args.fleet_candidates < 1:
+        return _err(
+            f"Bad fleet candidates value: {args.fleet_candidates}"
+        )
+    if args.fleet_max_wave < 1:
+        return _err(f"Bad fleet max wave value: {args.fleet_max_wave}")
     if args.output_dir is None:
         args.output_dir = "."
 
@@ -369,18 +405,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     # backend use; the mesh then spans every process's devices (the analog
     # of the reference's MPI_Init + worker topology, sboxgates.c:1045-1057).
     log = print
-    if args.fleet and (args.mesh or multiprocess):
+    if args.fleet and args.mesh:
         return _err(
             "--fleet builds its own (jobs, candidates) mesh over the "
-            "local devices and is single-process; drop --mesh (and the "
-            "multi-host flags — shard multi-host fleets with "
-            "--shard-sweep instead)."
+            "local devices; drop --mesh (use --fleet-candidates to "
+            "shard candidates inside the fleet lanes)."
         )
+    if args.fleet and multiprocess and not args.shard_sweep:
+        return _err(
+            "--fleet is process-local; a multi-host fleet needs "
+            "--shard-sweep, which composes one local fleet per process "
+            "over its slice of the sweep."
+        )
+    fleet_sharded = args.fleet and args.shard_sweep
     if multiprocess:
         from .parallel import distributed as dist
 
         dist.initialize(args.coordinator, args.num_processes, args.process_id)
-        args.mesh = True
+        # A fleet-sharded run keeps mesh=False: each process owns a
+        # LOCAL (jobs, candidates) fleet mesh, not a candidate mesh.
+        if not fleet_sharded:
+            args.mesh = True
         args.seed = dist.shared_seed(args.seed)
         if args.shard_sweep:
             # Job sharding: every process owns its slice's side effects;
@@ -409,6 +454,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"Error: Can't generate output bit {args.single_output}. "
             f"Target S-box only has {n_out} outputs."
         )
+
+    # Device plans build (and validate) BEFORE the journal: a rejected
+    # configuration — e.g. a --fleet-candidates split the local device
+    # count can't honor — must not leave journal files recording a run
+    # that never started.
+    mesh_plan = None
+    fleet_plan = None
+    if args.fleet:
+        import jax
+
+        # One device needs no sharding plan — the fleet kernels still
+        # batch the job axis as plain vmapped dispatches.  LOCAL devices
+        # both for the gate and the mesh: a fleet is process-local by
+        # contract (this also composes multi-host fleets automatically:
+        # under --shard-sweep each process builds its OWN local fleet
+        # over its slice of the sweep, no pod-wide collectives).
+        local = jax.local_devices()
+        if len(local) > 1 or args.fleet_candidates > 1:
+            from .parallel import FleetPlan, make_fleet_mesh
+
+            try:
+                fleet_plan = FleetPlan(
+                    make_fleet_mesh(local, candidates=args.fleet_candidates)
+                )
+            except ValueError as e:
+                return _err(f"Error: {e}")
+    elif args.shard_sweep or args.mesh:
+        import jax
+
+        from .parallel import MeshPlan, make_mesh
+
+        # Job-sharded sweeps run each process's slice on a mesh of its
+        # LOCAL devices (no pod-wide collectives); plain --mesh spans
+        # every visible device.
+        devices = jax.local_devices() if args.shard_sweep else None
+        mesh_plan = MeshPlan(make_mesh(devices))
 
     # Crash-safe journaling: on for every search with an output
     # directory.  Journals are coordinator-owned (resilience.journal):
@@ -442,6 +523,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         warmup=not args.no_warmup,
         compile_cache=cache_dir,
         fleet=args.fleet,
+        fleet_candidates=args.fleet_candidates,
+        fleet_max_wave=args.fleet_max_wave,
     )
 
     # ONE construction serves both the journal's recorded configuration
@@ -547,32 +630,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             dist.run_config_check(digest)
         except RuntimeError as e:
             return _err(f"Error: {e}")
-    mesh_plan = None
-    fleet_plan = None
-    if args.shard_sweep or args.mesh:
-        import jax
-
-        from .parallel import MeshPlan, make_mesh
-
-        # Job-sharded sweeps run each process's slice on a mesh of its
-        # LOCAL devices (no pod-wide collectives); plain --mesh spans
-        # every visible device.
-        devices = jax.local_devices() if args.shard_sweep else None
-        mesh_plan = MeshPlan(make_mesh(devices))
-    elif args.fleet:
-        import jax
-
-        # One device needs no sharding plan — the fleet kernels still
-        # batch the job axis as plain vmapped dispatches.  LOCAL devices
-        # both for the gate and the mesh: a fleet is process-local by
-        # contract (the multi-host flags were rejected above, but the
-        # mesh must agree with the gate even if a runtime initialized
-        # distributed behind the CLI's back).
-        local = jax.local_devices()
-        if len(local) > 1:
-            from .parallel import FleetPlan, make_fleet_mesh
-
-            fleet_plan = FleetPlan(make_fleet_mesh(local))
     ctx = SearchContext(opt, mesh_plan=mesh_plan, fleet_plan=fleet_plan)
 
     def _finish() -> int:

@@ -46,6 +46,37 @@ BLOCK_LOW = 64
 BLOCK_HIGH = 128
 
 
+#: Printed-once latch for :func:`job_axis_backend` (the stacked fleet
+#: pivot streams would otherwise emit one line per dispatch round).
+_JOB_AXIS_NOTED = False
+
+
+def job_axis_backend(backend: str) -> str:
+    """Backend to use when the pivot stream grows a leading JOBS axis
+    (``search.fleet`` stacked dispatches / rendezvous-merged pivot
+    streams): the pallas kernels are single-lane — their grid indexing
+    assumes no batch dimension and ``vmap`` of ``pallas_call`` lowers
+    through an unsupported path on the interpret/CPU backends — so a
+    pallas setting falls back to the XLA matmul half (bit-identical
+    verdicts, same rule as the mesh-sharded stream) with a one-line
+    note.  Non-pallas backends pass through unchanged."""
+    global _JOB_AXIS_NOTED
+    if not backend.startswith("pallas"):
+        return backend
+    if not _JOB_AXIS_NOTED:
+        _JOB_AXIS_NOTED = True
+        import sys
+
+        print(
+            f"sboxgates_tpu: SBG_PIVOT_BACKEND={backend!r} is "
+            "single-lane-only; stacked (job-axis) pivot dispatches fall "
+            "back to the XLA matmul half (bit-identical results)",
+            file=sys.stderr,
+            flush=True,
+        )
+    return "xla"
+
+
 def parse_block(v: str, source: str = "SBG_PALLAS_BLOCK") -> tuple:
     """Parse + validate a 'BLxBH' block spec (shared by the env lever
     and the ``backend="pallas:BLxBH"`` stream variant).  Validates here

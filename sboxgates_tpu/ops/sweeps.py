@@ -477,6 +477,14 @@ def feasible_stream(tables, binom, g, target, mask, excl, start, total, *, k, ch
     packed) — candidate ranks are chunk_start + arange(chunk); `examined`
     counts ranks swept including the returned chunk.  Fetch the verdict
     first; pull the big arrays only on found.
+
+    Jobs axis: the stacked fleet (search.fleet / warmup.fleet_kernel)
+    vmaps this stream over a leading jobs axis — every operand except
+    the binomial table grows ``[lanes, ...]``, and the batched
+    while_loop runs until the SLOWEST lane's cond clears (finished
+    lanes' carries freeze under select, so per-lane verdicts stay
+    bit-identical to the unbatched call; a retired lane rides with
+    total=0 and never leaves its init carry).
     """
     start = jnp.asarray(start, jnp.int32)
     total = jnp.asarray(total, jnp.int32)
@@ -1099,6 +1107,15 @@ def lut5_pivot_stream(
     count matrices — the traffic the path is roofline-bound on — as
     bfloat16 (exact for counts <= 256, so verdicts are bit-identical;
     see _pivot_tile_from_operands_bf16).  Composes with both levers.
+
+    Jobs axis: the stacked fleet vmaps this stream over a leading jobs
+    axis (``search.fleet.fleet_pivot_step`` and the rendezvous-merged
+    pivot rounds) — the tile shapes are keyed on the PIVOT g-bucket
+    (search.lut.PIVOT_G_BUCKETS via pivot_padded_shapes), so every job
+    in a bucket shares one ``[lanes, ...]`` compiled shape and the
+    stacked executable stays warmable on (jobs_bucket, pivot_g_bucket).
+    XLA backends only: the pallas kernels are single-lane
+    (ops.pallas_pivot.job_axis_backend gates the fallback).
     """
     start_t = jnp.asarray(start_t, jnp.int32)
     t_end = jnp.asarray(t_end, jnp.int32)

@@ -131,10 +131,18 @@ class FleetPlan:
     of per-job stacked tensors (``P("jobs")`` on the leading axis) and
     replicated operands on a :func:`make_fleet_mesh`.
 
+    ``n_candidate_shards`` exposes the 2-D mesh's second axis — devices
+    split ``(jobs, candidates)``, so each fleet lane's candidate sweep
+    partitions over ``"candidates"`` under GSPMD (the job-sharded
+    output sharding leaves the in-lane dimensions to the partitioner,
+    which splits the batched sweeps' candidate-major intermediates over
+    the remaining axis).  ``bench.py --fleet`` measures both splits.
+
     Process-spanning fleet meshes are rejected for now: the fleet
     dispatcher stacks host-produced per-job operands, which must stay
     fully addressable — multi-host fleets run job-sharded instead
-    (``--shard-sweep``, one local fleet per process)."""
+    (``--fleet --shard-sweep``, one local fleet per process, composed
+    automatically by the CLI)."""
 
     def __init__(self, mesh: Mesh):
         if mesh_spans_processes(mesh):
@@ -144,6 +152,7 @@ class FleetPlan:
             )
         self.mesh = mesh
         self.n_job_shards = mesh.shape[JOBS_AXIS]
+        self.n_candidate_shards = mesh.shape[CANDIDATES_AXIS]
         self._jobs = NamedSharding(mesh, P(JOBS_AXIS))
         self._replicated = NamedSharding(mesh, P())
 
@@ -155,6 +164,13 @@ class FleetPlan:
 
     def replicate(self, arr):
         return jax.device_put(arr, self._replicated)
+
+    def describe(self) -> str:
+        """Human-readable (jobs, candidates) split for logs/bench."""
+        return (
+            f"fleet mesh {self.n_job_shards}x{self.n_candidate_shards} "
+            f"(jobs x candidates)"
+        )
 
 
 class MeshPlan:

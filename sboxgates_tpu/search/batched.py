@@ -18,8 +18,12 @@ R times in parallel processes.  Kinds that rendezvous are the fixed-shape
 per-node kernels — gate mode's gate_step_stream, LUT mode's
 lut_step_stream, and the single-chunk lut7_step_stream — grouped by their
 full shape key (bucket, chunk sizes, has5), so only same-shaped nodes
-stack; the remaining variable-shape LUT paths (pivot sweeps, staged 7-LUT
-collection, overflow re-drives) execute per-thread without waiting.
+stack.  Since PR 8 the formerly per-thread streaming LUT paths (pivot
+sweeps, staged 7-LUT collection, overflow re-drives, decomposition
+solvers) rendezvous too (``SearchContext.stream_dispatch`` — their
+bucket-keyed shapes merge same-shaped streams across threads; a hung-
+dispatch deadline budget reverts them to per-thread direct dispatch,
+since an abandoned rendezvous entry would stall the whole pool).
 
 Cost model caveat: under ``jax.vmap`` the fused head kernels'
 ``lax.cond`` early-exit chains execute BOTH branches and select, so a
@@ -78,6 +82,16 @@ class Rendezvous:
     # They mostly block on device sweeps, so the count trades RTT overlap
     # against host-side GIL contention.
     MAX_SPAWNED = 16
+
+    # Whether SearchContext.stream_dispatch routes the streaming sweep
+    # paths through this rendezvous.  False here: the base rendezvous
+    # pads groups to the 16/32 node-head buckets by DUPLICATING entries
+    # — fine for the RTT-bound heads, but the big pivot/feasibility
+    # streams are compute-bound, so a 2-entry group padded to 16 would
+    # execute 8x redundant lanes of real work on an accelerator.  The
+    # fleet rendezvous (power-of-two jobs buckets bound the duplicated
+    # lanes at 2x) opts in.
+    merges_streams = False
 
     def __init__(self, n_threads: int, vmap_cache: Optional[dict] = None):
         self.cv = threading.Condition()
@@ -174,7 +188,13 @@ class Rendezvous:
         n = len(entries)
         if n == 1:
             e = entries[0]
-            e["result"] = np.asarray(e["kernel"](*e["args"]))
+            out = e["kernel"](*e["args"])
+            # Pytree outputs (the feasibility streams' (verdict, feas,
+            # r1, r0)) stay device-resident; the consumer syncs only its
+            # compact verdict element.
+            e["result"] = (
+                out if isinstance(out, tuple) else np.asarray(out)
+            )
             return
         if n > 32:
             # Larger than the biggest vmap bucket (possible via
@@ -207,9 +227,17 @@ class Rendezvous:
             else jnp.stack([jnp.asarray(e["args"][i]) for e in rows])
             for i in range(nargs)
         ]
-        out = np.asarray(fn(*stacked))
-        for r, e in enumerate(entries):
-            e["result"] = out[r]
+        out = fn(*stacked)
+        if isinstance(out, tuple):
+            # Per-lane device slices (lazy): big per-chunk arrays stay
+            # resident, pulled only on a hit — same contract as the
+            # direct dispatch path.
+            for r, e in enumerate(entries):
+                e["result"] = tuple(o[r] for o in out)
+        else:
+            out = np.asarray(out)
+            for r, e in enumerate(entries):
+                e["result"] = out[r]
         self.stats["batched_rows"] += n
 
 

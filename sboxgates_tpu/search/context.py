@@ -206,6 +206,18 @@ class Options:
     # the multibox/restart drivers through FleetRendezvous; per-round
     # device round trips for an N-job fleet drop from O(N) to O(1).
     fleet: bool = False
+    # Candidate-axis shards inside each fleet lane: the 2-D fleet mesh
+    # splits its devices (jobs, candidates) = (n/c, c), so candidate
+    # sweeps within a lane shard over the second axis (GSPMD) while the
+    # job axis keeps P("jobs").  1 = every device on the job axis.
+    # Must divide the local device count (make_fleet_mesh validates).
+    fleet_candidates: int = 1
+    # Jobs per fleet wave (resident-thread cap, search.fleet
+    # FLEET_MAX_WAVE's per-run override).  The wave is the unit the
+    # per-job seeds are drawn in (one up-front PRNG block per wave), so
+    # this SHAPES THE DRAW STREAM: it is journaled and restored by
+    # --resume-run, like the other execution-mode flags.
+    fleet_max_wave: int = 256
 
 
 @dataclass(frozen=True)
@@ -1009,12 +1021,20 @@ class SearchContext:
             gk = st.num_gates
 
             def issue():
-                return self.kernel_call(
-                    "feasible_stream", dict(k=k, chunk=chunk), args, g=gk
+                # Rendezvous-merged across concurrent jobs when safe
+                # (fleet streams fold into one stacked dispatch per
+                # round); a merged issue() blocks until the group
+                # flushes — the merge replaces the pipelining, which is
+                # why the deadline guard (whose retries re-issue) keeps
+                # the direct path.
+                return self.stream_dispatch(
+                    "feasible_stream", dict(k=k, chunk=chunk), args,
+                    shared=_warmup.FLEET_SHARED["feasible_stream"], g=gk,
                 )
 
-        # Issued asynchronously NOW; a deadline retry re-issues the whole
-        # dispatch (resolving a wedged RPC again would block on the same
+        # Issued asynchronously NOW (merged issues resolve at the group
+        # flush); a deadline retry re-issues the whole dispatch
+        # (resolving a wedged RPC again would block on the same
         # corpse).
         pending = {"out": issue()}
 
@@ -1144,6 +1164,47 @@ class SearchContext:
                 key, _warmup.kernel(name, statics), args, shared, g=g
             )
         return np.asarray(self.kernel_call(name, statics, args, g=g))
+
+    def _merge_streams(self) -> bool:
+        """True when the per-thread STREAMING dispatches (pivot sweeps,
+        staged 7-LUT collection, overflow re-drives, decomposition
+        solvers) should rendezvous with the other live threads instead
+        of dispatching directly — the fold that turns N concurrent
+        jobs' stream rounds into one stacked device dispatch per round.
+
+        Only the FLEET rendezvous merges streams
+        (``Rendezvous.merges_streams``): its jobs buckets bound the
+        duplicated padding lanes at 2x, while the base mux rendezvous'
+        16/32 node-head buckets would multiply these compute-bound
+        sweeps up to 8x on an accelerator.  Also gated off under a
+        hung-dispatch deadline (an abandoned deadline worker's
+        rendezvous entry would stall every other thread in the pool
+        forever) and once the device circuit breaker tripped (a
+        degraded job runs long host-fallback stretches that would hold
+        the merged streams' lockstep hostage)."""
+        return (
+            self.rdv is not None
+            and getattr(self.rdv, "merges_streams", False)
+            and self.rdv.live > 1
+            and not self.deadline_cfg.enabled
+            and not self.device_degraded
+        )
+
+    def stream_dispatch(self, name, statics, args, shared=(), g=None):
+        """Registry dispatch for the streaming sweep paths: merges with
+        the other live threads' same-signature stream rounds through
+        the rendezvous when :meth:`_merge_streams` allows (per-lane
+        results are bit-identical to the direct call — vmap changes the
+        batching, not the integer math), and falls back to the direct
+        :meth:`kernel_call` otherwise.  Returns the raw output pytree;
+        tuple outputs arrive as per-lane device slices, so callers keep
+        syncing only their compact verdicts."""
+        if self._merge_streams():
+            key = _warmup.warm_key(name, statics, args)
+            return self.rdv.submit(
+                key, _warmup.kernel(name, statics), args, shared, g=g
+            )
+        return self.kernel_call(name, statics, args, g=g)
 
     def _node_operands(self, st: State, target, mask):
         """Operand preamble shared by the fused per-node head dispatches

@@ -14,16 +14,25 @@ Execution model
 Each job (one S-box output, one restart, one submitted corpus entry)
 runs its ``create_circuit`` recursion on a host thread with its own
 :class:`~sboxgates_tpu.search.batched.RestartContext` (private PRNG and
-stats).  Their registry dispatches rendezvous in a
+stats).  Their registry dispatches — the fused node heads AND, since
+PR 8, the per-thread streaming paths (pivot sweeps, staged 7-LUT
+collection, overflow re-drives, decomposition solvers; see
+``SearchContext.stream_dispatch``) — rendezvous in a
 :class:`FleetRendezvous`; when every live job is blocked on a sweep,
-same-signature requests are padded to a fixed *jobs bucket*
-(:data:`FLEET_BUCKETS`) and dispatched through ONE jit(vmap(kernel))
-executable (:func:`sboxgates_tpu.search.warmup.fleet_kernel`) whose job
-axis is stacked INSIDE the jit — a warmed fleet dispatch performs zero
-eager ops, zero tracing, zero compiles.  With a
+same-signature requests are padded to a fixed *jobs bucket* and
+dispatched through ONE jit(vmap(kernel)) executable
+(:func:`sboxgates_tpu.search.warmup.fleet_kernel`).  Groups up to
+:data:`FLEET_BUCKETS`[-1] lanes use the flat-operand wrapper (job axis
+stacked INSIDE the jit — a warmed fleet dispatch performs zero eager
+ops, zero tracing, zero compiles); wider groups use the
+stacked-operand wrapper, whose argument count is lane-independent, so
+the jobs-bucket ladder (:data:`STACKED_BUCKETS`) reaches thousands of
+lanes in ONE dispatch instead of slicing at 32.  With a
 :class:`~sboxgates_tpu.parallel.mesh.FleetPlan` the job axis is sharded
 ``P("jobs")`` over a 2-D ``(jobs, candidates)`` mesh
-(:func:`~sboxgates_tpu.parallel.mesh.make_fleet_mesh`).
+(:func:`~sboxgates_tpu.parallel.mesh.make_fleet_mesh`), and the mesh's
+second axis shards candidates INSIDE each fleet lane
+(``FleetPlan.n_candidate_shards``).
 
 Done-masking / retirement: the jobs buckets make the batch shape
 independent of the live-job count — a finished job leaves the pool and
@@ -55,30 +64,48 @@ import numpy as np
 from . import warmup as _warmup
 from .batched import Rendezvous
 
-#: Job-axis shape buckets (vmap lanes per dispatch): a fleet dispatch
-#: pads its live jobs up to the next bucket, so job retirement never
-#: changes the compiled shape until a boundary is crossed.  Power-of-two
-#: spacing bounds padded lanes at 2x; 32 lanes cap the flat-operand
-#: count (the fused heads take ~14 args) and match the rendezvous'
-#: largest vmap bucket — bigger fleets dispatch in 32-lane slices, so
-#: per-round dispatches stay O(N/32), and O(1) for the 8-box DES fleet.
+#: FLAT-operand job-axis buckets (vmap lanes per dispatch): a fleet
+#: dispatch pads its live jobs up to the next bucket, so job retirement
+#: never changes the compiled shape until a boundary is crossed.
+#: Power-of-two spacing bounds padded lanes at 2x; 32 lanes cap the
+#: flat-operand count (the fused heads take ~14 args, flattened to one
+#: argument per lane per batched operand).
 FLEET_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+#: STACKED-operand jobs buckets: groups wider than the flat cap
+#: dispatch through the pre-stacked ``[lanes, ...]`` wrapper
+#: (``fleet_kernel(stacked=True)``), whose argument count is
+#: independent of the lane count — so the ladder reaches thousands of
+#: lanes per dispatch instead of slicing at 32.
+STACKED_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+#: The full jobs-bucket ladder (flat buckets then stacked buckets).
+FLEET_LADDER = FLEET_BUCKETS + STACKED_BUCKETS
 
 #: Concurrent job threads per fleet wave: each job is one OS thread
 #: blocked on the rendezvous; beyond this, drivers split the fleet into
 #: waves (thousands of submitted jobs must not mean thousands of
-#: resident stacks).
+#: resident stacks).  ``Options.fleet_max_wave`` overrides per run (the
+#: wave size shapes the per-wave seed-draw blocks, so it is journaled).
 FLEET_MAX_WAVE = 256
+
+
+def fleet_max_wave(ctx) -> int:
+    """The run's jobs-per-wave cap (Options.fleet_max_wave, defaulting
+    to :data:`FLEET_MAX_WAVE`)."""
+    return max(1, int(getattr(ctx.opt, "fleet_max_wave", FLEET_MAX_WAVE)
+                      or FLEET_MAX_WAVE))
 
 
 def fleet_bucket(n: int, shards: int = 1) -> int:
     """Jobs bucket covering ``n`` lanes, a multiple of the mesh's job
-    shards so ``P("jobs")`` divides evenly.  When ``shards`` divides no
-    bucket (awkward device counts), the result is the next shard
-    multiple — possibly a few lanes past FLEET_BUCKETS[-1]; the cap in
-    the dispatchers bounds the JOB count per dispatch, and the extra
-    lanes are ordinary padding."""
-    for b in FLEET_BUCKETS:
+    shards so ``P("jobs")`` divides evenly.  Walks the full ladder
+    (flat then stacked buckets); when ``shards`` divides no bucket
+    (awkward device counts), the result is the next shard multiple —
+    possibly a few lanes past FLEET_LADDER[-1]; the cap in the
+    dispatchers bounds the JOB count per dispatch, and the extra lanes
+    are ordinary padding."""
+    for b in FLEET_LADDER:
         if b >= n and b >= shards and b % shards == 0:
             return b
     return -(-n // shards) * shards
@@ -88,7 +115,7 @@ def prev_fleet_bucket(b: int) -> Optional[int]:
     """The next smaller jobs bucket (the shape a shrinking fleet crosses
     into), or None below the smallest."""
     prev = None
-    for fb in FLEET_BUCKETS:
+    for fb in FLEET_LADDER:
         if fb >= b:
             return prev
         prev = fb
@@ -137,9 +164,25 @@ class FleetStackCache:
 
 class FleetRendezvous(Rendezvous):
     """Rendezvous whose groups dispatch through the fleet kernels:
-    fixed jobs buckets (stable shapes under retirement), flat per-job
-    operands stacked inside the jit, warm-registry lookup keyed on
-    (jobs_bucket, bucket), and job-axis sharding under a FleetPlan."""
+    fixed jobs buckets (stable shapes under retirement), warm-registry
+    lookup keyed on (jobs_bucket, bucket), and job-axis sharding under
+    a FleetPlan.  Groups up to :data:`FLEET_BUCKETS`[-1] lanes take the
+    flat-operand wrapper (per-job operands stacked inside the jit);
+    wider groups take the stacked-operand wrapper — operands are
+    stacked ``[lanes, ...]`` on the way in, so the whole group is still
+    ONE device dispatch all the way up the :data:`STACKED_BUCKETS`
+    ladder (no 32-lane slicing).
+
+    Kernels whose outputs are pytrees (the feasibility streams and the
+    pivot tile re-drive) distribute per-lane DEVICE slices — the big
+    per-chunk arrays stay resident, and the consumer thread syncs only
+    its compact verdict, exactly like the direct dispatch path."""
+
+    # SearchContext.stream_dispatch folds the streaming sweep paths
+    # into THIS rendezvous (the fleet axis): jobs buckets bound the
+    # duplicated padding lanes at 2x, unlike the base rendezvous' 16/32
+    # node-head buckets.
+    merges_streams = True
 
     def __init__(self, n_threads: int, plan=None, warmer=None):
         super().__init__(n_threads)
@@ -148,6 +191,7 @@ class FleetRendezvous(Rendezvous):
         self.stats.update(
             fleet_dispatches=0,
             fleet_singletons=0,
+            fleet_stacked_dispatches=0,
             fleet_warm_hits=0,
             fleet_warm_misses=0,
             fleet_lanes=0,
@@ -157,48 +201,72 @@ class FleetRendezvous(Rendezvous):
         n = len(entries)
         if n == 1:
             e = entries[0]
-            e["result"] = np.asarray(e["kernel"](*e["args"]))
+            out = e["kernel"](*e["args"])
+            e["result"] = (
+                out if isinstance(out, tuple) else np.asarray(out)
+            )
             self.stats["fleet_singletons"] += 1
-            return
-        top = FLEET_BUCKETS[-1]
-        if n > top:
-            # Bigger than the widest fleet kernel: dispatch in slices
-            # (per-round dispatches O(N / top)).
-            for lo in range(0, n, top):
-                self._run_group(key, entries[lo : lo + top])
             return
         name, statics = key[0], dict(key[1])
         shared = entries[0]["shared"]
         nargs = len(entries[0]["args"])
         shards = 1 if self.plan is None else self.plan.n_job_shards
+        stacked = n > FLEET_BUCKETS[-1]
         lanes = fleet_bucket(n, shards)
         rows = [entries[i % n] for i in range(lanes)]
         gmax = max((e.get("g") or 0) for e in rows) or None
         if self.warmer is not None:
-            self.warmer.note_fleet(gmax, lanes)
-        # Flat per-job operands, argument-major: shared once, batched
-        # rows lane by lane.  Python scalars normalize to int32 so the
-        # in-jit stack sees one dtype per argument (and the warm avals
-        # can be enumerated ahead of time).
-        flat: List = []
-        for i in range(nargs):
-            if i in shared:
-                flat.append(rows[0]["args"][i])
-                continue
-            vals = [e["args"][i] for e in rows]
-            if not hasattr(vals[0], "shape"):
-                vals = [np.int32(v) for v in vals]
-            flat.extend(vals)
+            # ladder: the pre-warm's per-lane form follows the
+            # jobs-bucket ladder (a stacked group's retirement crossing
+            # into <=FLEET_BUCKETS[-1] lanes dispatches FLAT).
+            self.warmer.note_fleet(gmax, lanes, ladder=True)
         mesh = None if self.plan is None else self.plan.mesh
+        if stacked:
+            # Stacked operands: one [lanes, ...] tensor per batched
+            # argument (jnp.stack keeps device-resident operands on
+            # device; per-job Python scalars collect into one int32
+            # vector), job-sharded under a plan.  The wrapper's arg
+            # count no longer scales with lanes, so the whole group is
+            # one dispatch at any ladder rung.
+            import jax.numpy as jnp
+
+            ops: List = []
+            for i in range(nargs):
+                if i in shared:
+                    ops.append(rows[0]["args"][i])
+                    continue
+                vals = [e["args"][i] for e in rows]
+                if not hasattr(vals[0], "shape"):
+                    arr = np.asarray([int(v) for v in vals], np.int32)
+                else:
+                    arr = jnp.stack([jnp.asarray(v) for v in vals])
+                if self.plan is not None:
+                    arr = self.plan.shard_jobs(arr)
+                ops.append(arr)
+            flat = ops
+        else:
+            # Flat per-job operands, argument-major: shared once,
+            # batched rows lane by lane.  Python scalars normalize to
+            # int32 so the in-jit stack sees one dtype per argument
+            # (and the warm avals can be enumerated ahead of time).
+            flat = []
+            for i in range(nargs):
+                if i in shared:
+                    flat.append(rows[0]["args"][i])
+                    continue
+                vals = [e["args"][i] for e in rows]
+                if not hasattr(vals[0], "shape"):
+                    vals = [np.int32(v) for v in vals]
+                flat.extend(vals)
         compiled = None
         if self.warmer is not None:
             compiled = self.warmer.lookup_key(_warmup.fleet_warm_key(
-                name, statics, shared, lanes, flat, mesh
+                name, statics, shared, lanes, flat, mesh, stacked=stacked
             ))
         out = None
         if compiled is not None:
             try:
-                out = np.asarray(compiled(*flat))
+                out = compiled(*flat)
                 self.stats["fleet_warm_hits"] += 1
             except (TypeError, ValueError):
                 # Aval drift raises TypeError, a sharding mismatch from
@@ -208,13 +276,22 @@ class FleetRendezvous(Rendezvous):
                 self.warmer.count("warm_aval_mismatches")
         if out is None:
             fn = _warmup.fleet_kernel(
-                name, statics, shared, nargs, lanes, mesh
+                name, statics, shared, nargs, lanes, mesh, stacked=stacked
             )
-            out = np.asarray(fn(*flat))
+            out = fn(*flat)
             self.stats["fleet_warm_misses"] += 1
-        for r, e in enumerate(entries):
-            e["result"] = out[r]
+        if isinstance(out, tuple):
+            # Pytree output: per-lane device slices (lazy; callers sync
+            # their compact verdict element only).
+            for r, e in enumerate(entries):
+                e["result"] = tuple(o[r] for o in out)
+        else:
+            out = np.asarray(out)
+            for r, e in enumerate(entries):
+                e["result"] = out[r]
         self.stats["fleet_dispatches"] += 1
+        if stacked:
+            self.stats["fleet_stacked_dispatches"] += 1
         self.stats["fleet_lanes"] += lanes
         self.stats["batched_rows"] += n
 
@@ -222,8 +299,8 @@ class FleetRendezvous(Rendezvous):
 def fleet_stats_into(ctx, rdv: FleetRendezvous) -> None:
     """Folds one wave's fleet counters into the run's ctx.stats."""
     for k in (
-        "fleet_dispatches", "fleet_singletons", "fleet_warm_hits",
-        "fleet_warm_misses", "fleet_lanes",
+        "fleet_dispatches", "fleet_singletons", "fleet_stacked_dispatches",
+        "fleet_warm_hits", "fleet_warm_misses", "fleet_lanes",
     ):
         ctx.stats[k] = ctx.stats.get(k, 0) + rdv.stats[k]
     ctx.stats["fleet_submits"] = (
@@ -246,17 +323,30 @@ def run_fleet_circuits(ctx, jobs: List[tuple]) -> List[tuple]:
     :func:`sboxgates_tpu.search.batched.run_batched_circuits`: every job
     runs concurrently and their sweeps merge into fleet-kernel
     dispatches.  jobs: [(state, target, mask)], each state owned by its
-    job; returns [(state, out_gid)] in job order.  Waves larger than
-    :data:`FLEET_MAX_WAVE` must be split by the caller — use
-    :func:`run_fleet_waves`."""
+    job; returns [(state, out_gid)] in job order.
+
+    Arbitrarily large job lists are accepted: waves larger than the
+    run's :func:`fleet_max_wave` split automatically (the old behavior
+    — raising with "split into waves" — lives only on the internal
+    single-wave path, :func:`_run_fleet_wave`, so no public entry point
+    can trip it)."""
+    return run_fleet_waves(ctx, jobs)
+
+
+def _run_fleet_wave(ctx, jobs: List[tuple]) -> List[tuple]:
+    """One fleet wave (internal): every job gets a resident thread, so
+    the wave size is capped — oversized lists must come through
+    :func:`run_fleet_circuits` / :func:`run_fleet_waves`, which split
+    them."""
     from .kwan import create_circuit
     from .batched import RestartContext
 
     n = len(jobs)
-    if n > FLEET_MAX_WAVE:
+    cap = fleet_max_wave(ctx)
+    if n > cap:
         raise ValueError(
-            f"fleet wave of {n} jobs exceeds FLEET_MAX_WAVE="
-            f"{FLEET_MAX_WAVE}; split into waves"
+            f"fleet wave of {n} jobs exceeds the wave cap {cap}; "
+            "split into waves"
         )
     rdv = FleetRendezvous(
         n, plan=ctx.fleet_plan, warmer=ctx.warmer
@@ -314,17 +404,89 @@ def toy_fleet_boxes(n: int = 8) -> List:
 
 def run_fleet_waves(ctx, jobs: List[tuple]) -> List[tuple]:
     """Runs an arbitrarily large job list through
-    :func:`run_fleet_circuits` in waves of :data:`FLEET_MAX_WAVE` —
-    the single wave-splitting entry point for every fleet driver."""
+    :func:`_run_fleet_wave` in waves of :func:`fleet_max_wave` — the
+    single wave-splitting loop behind every fleet driver (and behind
+    :func:`run_fleet_circuits` itself)."""
+    cap = fleet_max_wave(ctx)
     out: List[tuple] = []
-    for lo in range(0, len(jobs), FLEET_MAX_WAVE):
-        out.extend(run_fleet_circuits(ctx, jobs[lo : lo + FLEET_MAX_WAVE]))
+    for lo in range(0, len(jobs), cap):
+        out.extend(_run_fleet_wave(ctx, jobs[lo : lo + cap]))
     return out
 
 
 # -------------------------------------------------------------------------
-# Lockstep fleet step: the stacked [jobs, bucket, 8] single-kernel sweep
+# Lockstep fleet steps: stacked [jobs, ...] single-kernel sweeps for
+# every registry head (the generalized fleet_gate_step shape)
 # -------------------------------------------------------------------------
+
+
+def _stacked_dispatch(ctx, name, statics, operands, lanes, g=None):
+    """ONE stacked-fleet dispatch of a registry head: pre-stacked
+    ``[lanes, ...]`` operands through ``fleet_kernel(stacked=True)``,
+    warm-served when the KernelWarmer has built the (jobs_bucket,
+    bucket) — or, for the pivot kernels, (jobs_bucket, pivot_g_bucket)
+    — executable.  Returns the kernel's raw (stacked) output pytree."""
+    shared = _warmup.FLEET_SHARED[name]
+    mesh = None if ctx.fleet_plan is None else ctx.fleet_plan.mesh
+    ctx.stats["device_dispatches"] = (
+        ctx.stats.get("device_dispatches", 0) + 1
+    )
+    warmer = ctx.warmer
+    if warmer is not None:
+        warmer.note_fleet(g, lanes, stacked=True)
+        compiled = warmer.lookup_key(_warmup.fleet_warm_key(
+            name, statics, shared, lanes, operands, mesh, stacked=True
+        ))
+        if compiled is not None:
+            try:
+                out = compiled(*operands)
+                ctx.stats["warm_hits"] = ctx.stats.get("warm_hits", 0) + 1
+                return out
+            except (TypeError, ValueError):
+                # Aval drift (TypeError) or an AOT sharding mismatch
+                # (ValueError): the lazy path below is always correct.
+                warmer.count("warm_aval_mismatches")
+        else:
+            ctx.stats["warm_misses"] = ctx.stats.get("warm_misses", 0) + 1
+    fn = _warmup.fleet_kernel(
+        name, statics, shared, len(operands), lanes, mesh, stacked=True
+    )
+    return fn(*operands)
+
+
+def _stacked_frame(ctx, jobs, done):
+    """Common preamble of the stacked steps: (states, n, done list,
+    table bucket, lanes).  The ladder bounds the JOB count per dispatch;
+    shard rounding may pad the lane count a few past a rung on awkward
+    device counts, which is ordinary (inert) padding."""
+    from . import context as C
+
+    sts = [st for st, _, _ in jobs]
+    n = len(jobs)
+    if n > FLEET_LADDER[-1]:
+        raise ValueError(f"fleet step of {n} jobs exceeds "
+                         f"{FLEET_LADDER[-1]}; slice the fleet")
+    done = [False] * n if done is None else list(done)
+    b = max(C.bucket_size(st.num_gates) for st in sts)
+    shards = 1 if ctx.fleet_plan is None else ctx.fleet_plan.n_job_shards
+    lanes = fleet_bucket(n, shards)
+    return sts, n, done, b, lanes
+
+
+def _pad_rows(rows, lanes, n, fill=0):
+    """Stacks per-job host rows into one [lanes, ...] array, fill-padding
+    the lanes past the job count."""
+    rows = list(rows)
+    rows += [np.full_like(np.asarray(rows[0]), fill)] * (lanes - n)
+    return np.stack([np.asarray(r) for r in rows])
+
+
+def _masked_words(jobs, done, col):
+    """Per-job 8-word rows with retired lanes zeroed (nothing to match)."""
+    return [
+        np.zeros(8, np.uint32) if done[i] else np.asarray(job[col])
+        for i, job in enumerate(jobs)
+    ]
 
 
 def fleet_gate_step(ctx, jobs: Sequence[tuple], done=None) -> np.ndarray:
@@ -337,33 +499,16 @@ def fleet_gate_step(ctx, jobs: Sequence[tuple], done=None) -> np.ndarray:
     zero mask — nothing to match) and their verdict rows are zeroed, so
     the batch shape survives retirement bit for bit.
 
-    jobs: [(state, target, mask)]; all states must share one table
-    bucket.  Returns int32 verdicts [len(jobs), 4] in job order.  This
-    is the single-kernel fleet sweep the bench's dispatch-count ladder
-    measures; the search drivers reach the same executables through the
-    rendezvous path above."""
+    jobs: [(state, target, mask)].  Returns int32 verdicts [len(jobs),
+    4] in job order.  The jobs-bucket ladder covers every
+    :data:`STACKED_BUCKETS` rung, so a thousands-lane fleet is still
+    ONE dispatch; the search drivers reach the same executables through
+    the rendezvous path above."""
     from ..ops import combinatorics as comb
     from . import context as C
 
-    sts = [st for st, _, _ in jobs]
-    n = len(jobs)
-    # The cap bounds the JOB count per dispatch; shard rounding may pad
-    # the lane count a few past it on awkward device counts, which is
-    # ordinary (inert) padding.
-    if n > FLEET_BUCKETS[-1]:
-        raise ValueError(f"fleet step of {n} jobs exceeds "
-                         f"{FLEET_BUCKETS[-1]}; slice the fleet")
-    done = [False] * n if done is None else list(done)
-    b = max(C.bucket_size(st.num_gates) for st in sts)
-    shards = 1 if ctx.fleet_plan is None else ctx.fleet_plan.n_job_shards
-    lanes = fleet_bucket(n, shards)
-
+    sts, n, done, b, lanes = _stacked_frame(ctx, jobs, done)
     tables = ctx.fleet_device_tables(sts, done=done, lanes=lanes, bucket=b)
-
-    def pad(rows, fill=0):
-        rows = list(rows)
-        rows += [np.full_like(np.asarray(rows[0]), fill)] * (lanes - n)
-        return np.stack([np.asarray(r) for r in rows])
 
     gs = np.asarray(
         [0 if done[i] else st.num_gates for i, st in enumerate(sts)]
@@ -376,14 +521,8 @@ def fleet_gate_step(ctx, jobs: Sequence[tuple], done=None) -> np.ndarray:
         :, None, None
     ]
     pair_valid = pair_valid.all(axis=2)
-    targets = pad(
-        [np.zeros(8, np.uint32) if done[i] else np.asarray(t)
-         for i, (_, t, _) in enumerate(jobs)]
-    )
-    masks = pad(
-        [np.zeros(8, np.uint32) if done[i] else np.asarray(m)
-         for i, (_, _, m) in enumerate(jobs)]
-    )
+    targets = _pad_rows(_masked_words(jobs, done, 1), lanes, n)
+    masks = _pad_rows(_masked_words(jobs, done, 2), lanes, n)
     lut_mode = ctx.opt.lut_graph
     has_not = bool(ctx.not_entries) and not lut_mode
     has_triple = not lut_mode
@@ -414,14 +553,273 @@ def fleet_gate_step(ctx, jobs: Sequence[tuple], done=None) -> np.ndarray:
         _put_jobs(ctx, seeds),
     )
     statics = dict(chunk3=chunk3, has_not=has_not, has_triple=has_triple)
-    shared = _warmup.FLEET_SHARED["gate_step_stream"]
-    mesh = None if ctx.fleet_plan is None else ctx.fleet_plan.mesh
-    fn = _warmup.fleet_kernel(
-        "gate_step_stream", statics, shared, len(stacked), lanes, mesh,
-        stacked=True,
-    )
-    out = np.array(fn(*stacked))[:n]
+    g_note = int(gs.max()) or None
+    out = np.array(_stacked_dispatch(
+        ctx, "gate_step_stream", statics, stacked, lanes, g=g_note
+    ))[:n]
     out[np.asarray(done, bool)] = 0  # retired lanes: masked no-ops
+    return out
+
+
+def fleet_lut_step(ctx, jobs: Sequence[tuple], done=None,
+                   inbits=None) -> np.ndarray:
+    """Stacked-fleet form of the fused LUT node head
+    (``SearchContext.lut_step``): one ``lut_step_stream`` dispatch
+    sweeping every job's steps 1-3 + 3-LUT + (small-space) 5-LUT in
+    lockstep.  Same done-lane masking contract as
+    :func:`fleet_gate_step`.  All live jobs must share the head's
+    static shape class (chunk3/chunk5/has5 — guaranteed when their gate
+    counts are equal, the lockstep drivers' case).  Returns int32
+    verdicts [len(jobs), 8] in job order."""
+    from ..ops import combinatorics as comb
+    from ..ops import sweeps
+    from . import context as C
+
+    sts, n, done, b, lanes = _stacked_frame(ctx, jobs, done)
+    inbits = [[] for _ in range(n)] if inbits is None else list(inbits)
+    live_g = [st.num_gates for i, st in enumerate(sts) if not done[i]]
+    if not live_g:
+        return np.zeros((n, 8), dtype=np.int32)
+    statics_set = {
+        (
+            C.pick_chunk(max(comb.n_choose_k(g, 3), 1), C.STREAM_CHUNK[3]),
+            C.pick_chunk(max(comb.n_choose_k(g, 5), 1), C.STREAM_CHUNK[5])
+            if C.lut_head_has5(g) else 1024,
+            C.lut_head_has5(g),
+        )
+        for g in live_g
+    }
+    if len(statics_set) != 1:
+        raise ValueError(
+            "fleet_lut_step needs one static shape class; live jobs "
+            f"span {sorted(statics_set)}"
+        )
+    chunk3, chunk5, has5 = next(iter(statics_set))
+    tables = ctx.fleet_device_tables(sts, done=done, lanes=lanes, bucket=b)
+    gs = np.asarray(
+        [0 if done[i] else st.num_gates for i, st in enumerate(sts)]
+        + [0] * (lanes - n),
+        dtype=np.int32,
+    )
+    valid_g = np.arange(b)[None, :] < gs[:, None]
+    combos = ctx._pair_combos(b)
+    pair_valid = (
+        np.asarray(ctx._pair_combos_np(b))[None, :, :] < gs[:, None, None]
+    ).all(axis=2)
+    targets = _pad_rows(_masked_words(jobs, done, 1), lanes, n)
+    masks = _pad_rows(_masked_words(jobs, done, 2), lanes, n)
+    excls = _pad_rows(
+        [ctx.excl_array(ib) for ib in inbits], lanes, n, fill=-1
+    )
+    g64 = gs.astype(np.int64)
+    total3 = np.maximum(g64 * (g64 - 1) * (g64 - 2) // 6, 0).astype(
+        np.int32
+    )
+    total5 = np.asarray(
+        [comb.n_choose_k(int(g), 5) for g in gs], dtype=np.int32
+    )
+    seeds = np.asarray(
+        [ctx.next_seed() for _ in range(lanes)], dtype=np.int32
+    )
+    if ctx._lut5_tabs is None:
+        _, w_tab, m_tab = sweeps.lut5_split_tables()
+        ctx._lut5_tabs = (
+            ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
+        )
+    jw, jm = ctx._lut5_tabs
+    stacked = (
+        tables,
+        _put_jobs(ctx, valid_g),
+        combos,
+        _put_jobs(ctx, pair_valid),
+        ctx.binom,
+        _put_jobs(ctx, gs),
+        _put_jobs(ctx, targets),
+        _put_jobs(ctx, masks),
+        _put_jobs(ctx, excls),
+        _put_jobs(ctx, total3),
+        _put_jobs(ctx, total5),
+        ctx.pair_table,
+        jw,
+        jm,
+        _put_jobs(ctx, seeds),
+    )
+    statics = dict(chunk3=chunk3, chunk5=chunk5, has5=has5,
+                   solve_rows=C.LUT5_HEAD_SOLVE_ROWS)
+    g_note = int(gs.max()) or None
+    out = np.array(_stacked_dispatch(
+        ctx, "lut_step_stream", statics, stacked, lanes, g=g_note
+    ))[:n]
+    out[np.asarray(done, bool)] = 0
+    return out
+
+
+def fleet_lut7_step(ctx, jobs: Sequence[tuple], done=None,
+                    inbits=None) -> np.ndarray:
+    """Stacked-fleet form of the single-chunk 7-LUT step
+    (``SearchContext.lut7_step``): one ``lut7_step_stream`` dispatch —
+    stage A feasibility AND stage B solve — over every job in lockstep.
+    Same done-lane masking contract as :func:`fleet_gate_step`; all
+    live jobs must satisfy ``lut_head_has7`` with one chunk class.
+    Returns int32 verdicts [len(jobs), 14] in job order."""
+    from ..ops import combinatorics as comb
+    from ..ops import sweeps
+    from . import context as C
+
+    sts, n, done, b, lanes = _stacked_frame(ctx, jobs, done)
+    inbits = [[] for _ in range(n)] if inbits is None else list(inbits)
+    live_g = [st.num_gates for i, st in enumerate(sts) if not done[i]]
+    if not live_g:
+        return np.zeros((n, 14), dtype=np.int32)
+    chunk_set = {
+        C.pick_chunk(max(comb.n_choose_k(g, 7), 1), C.STREAM_CHUNK[7])
+        for g in live_g
+    }
+    if len(chunk_set) != 1:
+        raise ValueError(
+            "fleet_lut7_step needs one chunk class; live jobs span "
+            f"{sorted(chunk_set)}"
+        )
+    chunk7 = next(iter(chunk_set))
+    tables = ctx.fleet_device_tables(sts, done=done, lanes=lanes, bucket=b)
+    gs = np.asarray(
+        [0 if done[i] else st.num_gates for i, st in enumerate(sts)]
+        + [0] * (lanes - n),
+        dtype=np.int32,
+    )
+    targets = _pad_rows(_masked_words(jobs, done, 1), lanes, n)
+    masks = _pad_rows(_masked_words(jobs, done, 2), lanes, n)
+    excls = _pad_rows(
+        [ctx.excl_array(ib) for ib in inbits], lanes, n, fill=-1
+    )
+    total7 = np.asarray(
+        [comb.n_choose_k(int(g), 7) for g in gs], dtype=np.int32
+    )
+    seeds = np.asarray(
+        [ctx.next_seed() for _ in range(lanes)], dtype=np.int32
+    )
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    jidx = ctx.place_replicated(idx_tab)
+    jpp = ctx.place_replicated(pp_tab)
+    stacked = (
+        tables,
+        ctx.binom,
+        _put_jobs(ctx, gs),
+        _put_jobs(ctx, targets),
+        _put_jobs(ctx, masks),
+        _put_jobs(ctx, excls),
+        _put_jobs(ctx, total7),
+        jidx,
+        jpp,
+        _put_jobs(ctx, seeds),
+    )
+    statics = dict(chunk7=chunk7, solve7=C.LUT7_HEAD_SOLVE_ROWS)
+    g_note = int(gs.max()) or None
+    out = np.array(_stacked_dispatch(
+        ctx, "lut7_step_stream", statics, stacked, lanes, g=g_note
+    ))[:n]
+    out[np.asarray(done, bool)] = 0
+    return out
+
+
+def fleet_pivot_step(
+    ctx, jobs: Sequence[tuple], done=None, inbits=None,
+    start_t=0, t_limit: Optional[int] = None,
+) -> np.ndarray:
+    """Stacked pivot stream: many jobs' pivot-tile 5-LUT sweeps in
+    lockstep — TWO dispatches total (one stacked ``pivot_pair_cells``
+    preamble, one stacked ``lut5_pivot_stream``), replacing a per-job
+    dispatch pair per tile round.  Operand shapes key on
+    ``(jobs_bucket, pivot_g_bucket)``: all live jobs must share a pivot
+    g-bucket (``search.lut.PIVOT_G_BUCKETS``), so the stacked
+    executables stay warmable; the pads never execute (per-lane
+    ``t_end`` stops each lane at its real tile count).
+
+    ``start_t`` is a scalar or per-job sequence of starting tiles;
+    ``t_limit`` caps tiles swept per lane this call (resume with
+    ``start_t`` — the stacked analog of the per-job stream's round
+    loop).  Done lanes ride as zeroed no-op rows with ``t_end = 0`` and
+    their verdict rows are zeroed.  Returns int32 verdict rows
+    [len(jobs), 9] in job order (the ``lut5_pivot_stream`` packing)."""
+    from . import lut as L
+
+    sts, n, done, b, lanes = _stacked_frame(ctx, jobs, done)
+    inbits = [[] for _ in range(n)] if inbits is None else list(inbits)
+    if np.isscalar(start_t):
+        start_t = [int(start_t)] * n
+    live = [i for i in range(n) if not done[i]]
+    if not live:
+        return np.zeros((n, 9), dtype=np.int32)
+    pb_set = {L.pivot_g_bucket(sts[i].num_gates) for i in live}
+    if len(pb_set) != 1:
+        raise ValueError(
+            "fleet_pivot_step needs one pivot g-bucket; live jobs span "
+            f"{sorted(pb_set)}"
+        )
+    gmax = max(sts[i].num_gates for i in live)
+    tl, th = L.pivot_tile_shape(gmax)
+    p2pad, tpad = L.pivot_padded_shapes(gmax, tl, th)
+    tables = ctx.fleet_device_tables(sts, done=done, lanes=lanes, bucket=b)
+
+    lows_s = np.zeros((lanes, p2pad, 2), np.int32)
+    highs_s = np.zeros((lanes, p2pad, 2), np.int32)
+    lv_s = np.zeros((lanes, p2pad), bool)
+    hv_s = np.zeros((lanes, p2pad), bool)
+    descs_s = np.zeros((lanes, tpad, 5), np.int32)
+    starts = np.zeros(lanes, np.int32)
+    t_ends = np.zeros(lanes, np.int32)
+    for i in live:
+        excl = [bb for bb in inbits[i] if bb >= 0]
+        (_, _, _, lows_p, highs_p, lowvalid, highvalid, descs_p,
+         t_real) = L.pivot_host_operands(sts[i].num_gates, tl, th, excl)
+        lows_s[i], highs_s[i] = lows_p, highs_p
+        lv_s[i], hv_s[i] = lowvalid, highvalid
+        descs_s[i] = descs_p
+        starts[i] = start_t[i]
+        t_ends[i] = (
+            t_real if t_limit is None
+            else min(t_real, start_t[i] + t_limit)
+        )
+    targets = _pad_rows(_masked_words(jobs, done, 1), lanes, n)
+    masks = _pad_rows(_masked_words(jobs, done, 2), lanes, n)
+    seeds = np.asarray(
+        [ctx.next_seed() for _ in range(lanes)], dtype=np.int32
+    )
+    cells = _stacked_dispatch(
+        ctx, "pivot_pair_cells", {},
+        (tables, _put_jobs(ctx, lows_s), _put_jobs(ctx, highs_s),
+         _put_jobs(ctx, targets), _put_jobs(ctx, masks)),
+        lanes, g=gmax,
+    )
+    lc1, lc0, hc = cells
+    from ..ops import sweeps
+
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw = ctx.place_replicated(w_tab)
+    jm = ctx.place_replicated(m_tab)
+    backend = L.pivot_backend()
+    if backend.startswith("pallas"):
+        from ..ops.pallas_pivot import job_axis_backend
+
+        # Always lands on "xla": the pallas tile kernels are
+        # single-lane, so the stacked (job-axis) stream takes the XLA
+        # matmul half (bit-identical verdicts).
+        backend = job_axis_backend(backend)
+    statics = dict(
+        tl=tl, th=th, tile_batch=L.pivot_tile_batch(),
+        pipeline=L.pivot_pipeline(), backend=backend,
+    )
+    stacked = (
+        tables, lc1, lc0, hc,
+        _put_jobs(ctx, lv_s), _put_jobs(ctx, hv_s),
+        _put_jobs(ctx, descs_s),
+        _put_jobs(ctx, starts), _put_jobs(ctx, t_ends),
+        jw, jm, _put_jobs(ctx, seeds),
+    )
+    out = np.array(_stacked_dispatch(
+        ctx, "lut5_pivot_stream", statics, stacked, lanes, g=gmax
+    ))[:n]
+    out[np.asarray(done, bool)] = 0
     return out
 
 

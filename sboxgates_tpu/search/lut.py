@@ -32,6 +32,7 @@ from ..graph.state import NO_GATE, State, check_num_gates_possible
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience.deadline import DispatchTimeout
+from . import warmup as _warmup
 from .context import (
     LUT5_CHUNK,
     LUT5_SOLVE_CHUNK,
@@ -105,9 +106,12 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
         args, total, chunk = ctx.stream_args(st, target, mask, [], 3)
         seed = ctx.next_seed()
         v = ctx.guarded_dispatch(
-            lambda: np.asarray(ctx.kernel_call(
+            # Rendezvous-merged across concurrent fleet jobs:
+            # same-shaped 3-LUT streams fold into one dispatch.
+            lambda: np.asarray(ctx.stream_dispatch(
                 "lut3_stream", dict(chunk=chunk),
-                (*args, 0, total, seed), g=g,
+                (*args, 0, total, seed),
+                shared=_warmup.FLEET_SHARED["lut3_stream"], g=g,
             )),
             "lut3.stream",
         )
@@ -196,7 +200,7 @@ def _solve_lut5_rows(
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
-            lambda a=p1, b=p0: np.asarray(ctx.kernel_call(
+            lambda a=p1, b=p0: np.asarray(ctx.stream_dispatch(
                 "lut5_solve", {},
                 (
                     ctx.place_chunk(a, fill=0xFFFFFFFF),
@@ -205,6 +209,7 @@ def _solve_lut5_rows(
                     jm,
                     seed,
                 ),
+                shared=_warmup.FLEET_SHARED["lut5_solve"],
                 g=st.num_gates,
             )),
             "lut5.solve",
@@ -339,6 +344,42 @@ def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
 
+def pivot_host_operands(g: int, tl: int, th: int, excl):
+    """Host-side pivot operands for ONE job, padded to the pivot
+    g-bucket shapes: (lows, highs, descs, lows_p, highs_p, lowvalid,
+    highvalid, descs_p, t_real).  The unpadded grids/descriptors decode
+    hits; the padded forms are the device operands.
+
+    ONE builder shared by :class:`PivotOperands` (the per-job stream)
+    and ``search.fleet.fleet_pivot_step`` (the stacked jobs-axis
+    stream), so the two dispatch paths can never drift in shape or
+    content — the bucket-keyed pads (PIVOT_G_BUCKETS) are what keeps a
+    ``(jobs_bucket, pivot_g_bucket)`` stacked executable warmable."""
+    lows, highs, _ = sweeps.pivot_pair_grids(g)
+    descs = sweeps.pivot_tile_descs(g, tl, th, excl)
+    t_real = descs.shape[0]
+    p2 = lows.shape[0]
+    # Bucket-keyed pads: stable for every g in the bucket — and for
+    # every exclusion list, which only shrinks t_real — so the compiled
+    # pivot executables are warmable.
+    p2pad, tpad = pivot_padded_shapes(g, tl, th)
+    assert p2pad >= p2 + max(tl, th) and tpad >= t_real
+    descs_p = np.zeros((tpad, 5), np.int32)
+    descs_p[:t_real] = descs
+    lowvalid = np.zeros(p2pad, bool)
+    highvalid = np.zeros(p2pad, bool)
+    lowvalid[:p2] = ~np.isin(lows, excl).any(1) if excl else True
+    highvalid[:p2] = ~np.isin(highs, excl).any(1) if excl else True
+    lows_p = np.zeros((p2pad, 2), np.int32)
+    lows_p[:p2] = lows
+    highs_p = np.zeros((p2pad, 2), np.int32)
+    highs_p[:p2] = highs
+    return (
+        lows, highs, descs, lows_p, highs_p, lowvalid, highvalid,
+        descs_p, t_real,
+    )
+
+
 class PivotOperands:
     """Host + device operands for the pivot 5-LUT sweep: padded pair
     grids, tile descriptors, validity masks, and per-pair cell masks.
@@ -352,11 +393,11 @@ class PivotOperands:
     def __init__(self, g, tl, th, excl, tables, target, mask, put,
                  kernel_call=None):
         self.g, self.tl, self.th = g, tl, th
-        lows, highs, _ = sweeps.pivot_pair_grids(g)
+        (lows, highs, descs, lows_p, highs_p, lowvalid, highvalid,
+         descs_p, t_real) = pivot_host_operands(g, tl, th, excl)
         self.lows, self.highs = lows, highs
-        descs = sweeps.pivot_tile_descs(g, tl, th, excl)
         self.descs = descs
-        self.t_real = descs.shape[0]
+        self.t_real = t_real
         if self.t_real == 0:
             return
         tile_sizes = (
@@ -364,23 +405,6 @@ class PivotOperands:
             * (descs[:, 4] - descs[:, 3]).astype(np.int64)
         )
         self.size_cum = np.concatenate([[0], np.cumsum(tile_sizes)])
-
-        p2 = lows.shape[0]
-        # Bucket-keyed pads (see PIVOT_G_BUCKETS): stable for every g in
-        # the bucket — and for every exclusion list, which only shrinks
-        # t_real — so the compiled pivot executables are warmable.
-        p2pad, tpad = pivot_padded_shapes(g, tl, th)
-        assert p2pad >= p2 + max(tl, th) and tpad >= self.t_real
-        descs_p = np.zeros((tpad, 5), np.int32)
-        descs_p[: self.t_real] = descs
-        lowvalid = np.zeros(p2pad, bool)
-        highvalid = np.zeros(p2pad, bool)
-        lowvalid[:p2] = ~np.isin(lows, excl).any(1) if excl else True
-        highvalid[:p2] = ~np.isin(highs, excl).any(1) if excl else True
-        lows_p = np.zeros((p2pad, 2), np.int32)
-        lows_p[:p2] = lows
-        highs_p = np.zeros((p2pad, 2), np.int32)
-        highs_p[:p2] = highs
 
         self.tables = tables
         jt = put(np.asarray(target))
@@ -446,10 +470,13 @@ def _lut5_search_pivot(
 
     def redrive_tile(t_over: int) -> Optional[dict]:
         """Overflow fallback: fetch one tile's full feasibility data and
-        solve every feasible tuple (no in-kernel row cap)."""
-        feas, r1, r0 = ctx.kernel_call(
+        solve every feasible tuple (no in-kernel row cap).  Rendezvous-
+        merged like the stream itself, so concurrent jobs' re-drives
+        fold into one stacked dispatch (per-lane device slices)."""
+        feas, r1, r0 = ctx.stream_dispatch(
             "lut5_pivot_tile", dict(tl=tl, th=th),
-            (tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over), g=g,
+            (tables, lc1, lc0, hc, jlv, jhv, jdescs, t_over),
+            shared=_warmup.FLEET_SHARED["lut5_pivot_tile"], g=g,
         )
         # jaxlint: ignore[R2x] deliberate compact-verdict sync: the pivot tile's feasibility bitmap must reach the host to drive redrive/solve
         rows = np.nonzero(np.asarray(feas))[0]
@@ -538,9 +565,20 @@ def _lut5_search_pivot(
 
         backend = pivot_backend()
         seed = ctx.next_seed()
+        # The pallas tile kernels are single-lane (no job axis); their
+        # dispatches stay per-thread while the XLA backends merge
+        # through the rendezvous into one stacked pivot stream per
+        # round (ops.pallas_pivot.job_axis_backend documents the gate).
+        dispatch = (
+            ctx.kernel_call if backend.startswith("pallas")
+            else lambda nm, stat, a, g=None: ctx.stream_dispatch(
+                nm, stat, a,
+                shared=_warmup.FLEET_SHARED["lut5_pivot_stream"], g=g,
+            )
+        )
         v = ctx.guarded_dispatch(
             # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
-            lambda s=start_t: np.asarray(ctx.kernel_call(
+            lambda s=start_t: np.asarray(dispatch(
                 "lut5_pivot_stream",
                 dict(
                     tl=tl, th=th,
@@ -696,9 +734,10 @@ def _lut5_stream_loop(
         seed = ctx.next_seed()
         v = ctx.guarded_dispatch(
             # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
-            lambda s=start: np.asarray(ctx.kernel_call(
+            lambda s=start: np.asarray(ctx.stream_dispatch(
                 "lut5_stream", dict(chunk=chunk),
-                (*args, s, total, jw, jm, seed), g=g,
+                (*args, s, total, jw, jm, seed),
+                shared=_warmup.FLEET_SHARED["lut5_stream"], g=g,
             )),
             "lut5.stream",
         )
@@ -1104,7 +1143,7 @@ def _lut7_solve_hits(
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
-            lambda a=r1, b=r0: np.asarray(ctx.kernel_call(
+            lambda a=r1, b=r0: np.asarray(ctx.stream_dispatch(
                 "lut7_solve", {},
                 (
                     ctx.place_chunk(a, fill=0xFFFFFFFF),
@@ -1113,6 +1152,7 @@ def _lut7_solve_hits(
                     jpp,
                     seed,
                 ),
+                shared=_warmup.FLEET_SHARED["lut7_solve"],
                 g=g,
             )),
             "lut7.solve",

@@ -177,14 +177,28 @@ KERNELS: Dict[str, KernelDef] = {
 #: Rendezvous/fleet shared-argument indices per kernel (operands
 #: identical across restarts/jobs, mapped ``in_axes=None`` instead of
 #: gaining a job axis).  MUST mirror the ``shared=`` tuples at the
-#: ``SearchContext._dispatch`` call sites — the fleet warm specs are
-#: enumerated from this table, and the registry parity test
-#: (tests/test_fleet.py) asserts live submissions agree with it.
+#: ``SearchContext._dispatch`` / ``SearchContext.stream_dispatch`` call
+#: sites — the fleet warm specs are enumerated from this table, and the
+#: registry parity test (tests/test_fleet.py) asserts live submissions
+#: agree with it.  Since PR 8 this covers EVERY kernel head the fleet
+#: merges: the fused per-node heads AND the formerly per-thread
+#: streaming paths (pivot sweeps, staged 7-LUT collection, overflow
+#: re-drives, decomposition solvers).
 FLEET_SHARED: Dict[str, Tuple[int, ...]] = {
     "gate_step_stream": (2, 4, 8, 10, 11, 12),
     "lut_step_stream": (2, 4, 11, 12, 13),
     "lut7_step_stream": (1, 7, 8),
     "lut7_solve": (2, 3),
+    # Streaming paths folded into the fleet axis: binomial table, split
+    # tables, and (for the whole-space 3-LUT stream, whose exclusion
+    # list is always empty) the exclusion array are job-invariant.
+    "lut3_stream": (1, 5),
+    "lut5_stream": (1, 8, 9),
+    "feasible_stream": (1,),
+    "lut5_solve": (2, 3),
+    "lut5_pivot_stream": (9, 10),
+    "lut5_pivot_tile": (),
+    "pivot_pair_cells": (),
 }
 
 
@@ -518,13 +532,18 @@ def fleet_kernel(
 
 def fleet_warm_key(
     name: str, statics: dict, shared: Tuple[int, ...], lanes: int,
-    flat_args: Sequence, mesh=None,
+    flat_args: Sequence, mesh=None, stacked: bool = False,
 ) -> tuple:
     """Warm-cache key for one fleet dispatch — the (jobs_bucket, bucket)
-    keying the ISSUE names: ``lanes`` is the jobs bucket, the flat-arg
-    signature carries the padded table bucket."""
+    keying the ISSUE names: ``lanes`` is the jobs bucket, the arg
+    signature carries the padded table bucket (and, for the pivot
+    kernels, the pivot g-bucket — making these the ``(jobs_bucket,
+    pivot_g_bucket)`` keys).  ``stacked`` distinguishes the pre-stacked
+    ``[lanes, ...]`` operand form from the flat per-job form — the two
+    lower different wrappers, so their executables must never alias."""
     return (
-        "fleet", name, tuple(sorted(statics.items())), tuple(shared),
+        "fleet-stacked" if stacked else "fleet", name,
+        tuple(sorted(statics.items())), tuple(shared),
         lanes, arg_signature(flat_args), mesh,
     )
 
@@ -546,23 +565,53 @@ def fleet_flat_avals(spec: WarmSpec, shared: Tuple[int, ...], lanes: int):
     return tuple(flat)
 
 
-def fleet_warm_specs(plan: WarmPlan, g: int, lanes: int) -> List[tuple]:
+def fleet_stacked_avals(spec: WarmSpec, shared: Tuple[int, ...], lanes: int):
+    """Lifts one per-job WarmSpec to the stacked wrapper's operand list:
+    shared avals unchanged, batched avals with a leading ``lanes`` jobs
+    axis (Python-scalar avals become int32[lanes] vectors — the stacked
+    dispatchers collect per-job scalars into one int32 array)."""
+    out = []
+    for i, a in enumerate(spec.avals):
+        if i in shared:
+            out.append(a)
+            continue
+        if not hasattr(a, "shape"):
+            out.append(_sds((lanes,), np.int32))
+        else:
+            out.append(_sds((lanes,) + tuple(a.shape), a.dtype))
+    return tuple(out)
+
+
+def fleet_warm_specs(
+    plan: WarmPlan, g: int, lanes: int, stacked: Optional[bool] = None,
+) -> List[tuple]:
     """AOT-compile targets for the fleet dispatch path at gate count
     ``g`` and jobs bucket ``lanes``: every rendezvous-merged kernel of
-    ``warm_specs(plan, g)``, lifted to its flat fleet form.  Returns
-    (warm_key, name, statics, shared, nargs, flat_avals) tuples."""
+    ``warm_specs(plan, g)``, lifted to its fleet form.  ``stacked=None``
+    resolves by the jobs bucket: lanes past the flat-operand cap
+    (``search.fleet.FLEET_BUCKETS[-1]``) can only dispatch stacked.
+    Returns (warm_key, name, statics, shared, nargs, avals, stacked)
+    tuples."""
+    from .fleet import FLEET_BUCKETS
+
+    if stacked is None:
+        stacked = lanes > FLEET_BUCKETS[-1]
     out = []
     for spec in warm_specs(plan, g):
         shared = FLEET_SHARED.get(spec.name)
         if shared is None:
             continue
         statics = dict(spec.statics)
-        flat = fleet_flat_avals(spec, shared, lanes)
+        avals = (
+            fleet_stacked_avals(spec, shared, lanes) if stacked
+            else fleet_flat_avals(spec, shared, lanes)
+        )
         out.append((
             fleet_warm_key(
-                spec.name, statics, shared, lanes, flat, plan.fleet_mesh
+                spec.name, statics, shared, lanes, avals,
+                plan.fleet_mesh, stacked=stacked,
             ),
-            spec.name, statics, shared, len(spec.avals), flat,
+            spec.name, statics, shared, len(spec.avals), avals, stacked,
         ))
     return out
 
@@ -771,33 +820,82 @@ class KernelWarmer:
         if self.enabled and g is not None:
             self._schedule(("exact", g), ("specs", g))
 
-    def note_fleet(self, g: Optional[int], lanes: int) -> None:
-        """Fleet-dispatch hook (search.fleet.FleetRendezvous): warm specs
-        are keyed on (jobs_bucket, bucket), and both axes cross mid-run —
-        the fleet shrinks as jobs retire, the tables grow through gate
-        buckets — so entry to (lanes, bucket) schedules the set itself
-        plus its two successors: the next gate bucket at these lanes and
-        the next SMALLER jobs bucket at this gate count."""
+    def note_fleet(
+        self, g: Optional[int], lanes: int, stacked: bool = False,
+        ladder: bool = False,
+    ) -> None:
+        """Fleet-dispatch hook (search.fleet): warm specs are keyed on
+        (jobs_bucket, bucket), and both axes cross mid-run — the fleet
+        shrinks as jobs retire, the tables grow through gate buckets —
+        so entry to (lanes, bucket) schedules the set itself plus its
+        two successors: the next gate bucket at these lanes and the
+        next SMALLER jobs bucket at this gate count.  ``stacked`` warms
+        the pre-stacked-operand wrapper (the form every stacked step
+        dispatches at ANY lane count) instead of the flat one.
+
+        ``ladder`` is the FleetRendezvous semantics: each lane count's
+        form follows the jobs-bucket ladder — stacked past the flat cap
+        (``FLEET_BUCKETS[-1]``), flat at or below it — so the
+        retirement pre-warm of a stacked group's next SMALLER bucket
+        builds the FLAT wrapper the rendezvous will actually dispatch
+        when the fleet shrinks across the stacked-to-flat boundary.
+
+        LUT plans with pivot-sized spaces additionally warm the next
+        PIVOT g-bucket at each lane set — the ``(jobs_bucket,
+        pivot_g_bucket)`` keys of the stacked pivot stream, so a warmed
+        crossing of EITHER stacked bucket axis is compile-free."""
         if not self.enabled or g is None:
             return
         from . import context as C
-        from .fleet import prev_fleet_bucket
+        from .fleet import FLEET_BUCKETS, prev_fleet_bucket
 
         b = C.bucket_size(g)
         gates = [g] + ([b + 1] if next_bucket(b) is not None else [])
         pl = prev_fleet_bucket(lanes)
         # A 1-lane group bypasses the fleet wrapper entirely (the
         # rendezvous runs singletons through the registry kernel), so
-        # lanes<2 sets would warm executables nothing dispatches.
-        lane_set = [lanes] + ([pl] if pl is not None and pl >= 2 else [])
+        # lanes<2 sets would warm executables nothing dispatches —
+        # except in stacked form, where a 1-lane step is a real
+        # dispatch of the stacked wrapper.
+        lane_set = [lanes] + (
+            [pl]
+            if pl is not None and (pl >= 2 or (stacked and not ladder))
+            else []
+        )
         # Full cross product: the fleet can cross both axes at once (a
         # job retires in the same round the survivors' tables grow past
         # the bucket), so the diagonal set must be warm too.
         targets = [(gg, ll) for gg in gates for ll in lane_set]
+        if self.plan.lut_graph and self.plan.pivot is not None:
+            from . import lut as L
+
+            pb = L.pivot_g_bucket(g)
+            if pb < L.PIVOT_G_BUCKETS[-1]:
+                # First gate count of the next pivot bucket: its fleet
+                # warm set carries the next bucket's pivot-stream avals
+                # (the other kernels' shapes are table-bucket-keyed and
+                # mostly coincide with the sets above).
+                targets += [(pb + 1, ll) for ll in lane_set]
         for gg, ll in targets:
+            form = (ll > FLEET_BUCKETS[-1]) if ladder else stacked
             self._schedule(
-                ("fleet", C.bucket_size(gg), ll), ("fleet", gg, ll)
+                ("fleet", self._fleet_shape_key(gg), ll, form),
+                ("fleet", gg, ll, form),
             )
+
+    def _fleet_shape_key(self, g: int) -> tuple:
+        """Dedup key for one fleet warm set's shapes at gate count g:
+        the table bucket, plus the pivot g-bucket when the plan has
+        pivot-shaped kernels (two gate counts in one table bucket can
+        still differ in pivot operand pads)."""
+        from . import context as C
+
+        key = (C.bucket_size(g),)
+        if self.plan.lut_graph and self.plan.pivot is not None:
+            from . import lut as L
+
+            key += (L.pivot_g_bucket(g),)
+        return key
 
     def _schedule(self, key, item: tuple) -> None:
         with self._cv:
@@ -888,7 +986,7 @@ class KernelWarmer:
                 item = self._queue.popleft()
             try:
                 if item[0] == "fleet":
-                    self._warm_fleet(item[1], item[2])
+                    self._warm_fleet(item[1], item[2], item[3])
                 else:
                     self._warm_bucket(item[1])
             finally:
@@ -926,19 +1024,20 @@ class KernelWarmer:
             return
         self._compile_jobs(jobs)
 
-    def _warm_fleet(self, g: int, lanes: int) -> None:
+    def _warm_fleet(self, g: int, lanes: int, stacked: bool = False) -> None:
         try:
             jobs = [
                 (
                     key,
-                    (lambda n=name, s=statics, sh=shared, na=nargs:
+                    (lambda n=name, s=statics, sh=shared, na=nargs, st=stk:
                         fleet_kernel(
-                            n, s, sh, na, lanes, self.plan.fleet_mesh
+                            n, s, sh, na, lanes, self.plan.fleet_mesh,
+                            stacked=st,
                         ).lower),
-                    flat, {},
+                    avals, {},
                 )
-                for key, name, statics, shared, nargs, flat
-                in fleet_warm_specs(self.plan, g, lanes)
+                for key, name, statics, shared, nargs, avals, stk
+                in fleet_warm_specs(self.plan, g, lanes, stacked=stacked)
             ]
         except Exception as e:
             logger.warning(

@@ -117,21 +117,58 @@ def test_resume_run_shard_sweep_validation(tmp_path, capsys):
     assert "Traceback" not in err
 
 
-def test_fleet_incoherent_flag_combos_rejected(capsys):
-    """--fleet contradicts --shard-sweep (one mesh vs per-process
-    slices), --serial-jobs (nothing to merge), and --mesh (the fleet
-    builds its own 2-D mesh): each is a one-line error, no traceback."""
+def test_resume_journal_without_new_fleet_keys(tmp_path, capsys):
+    """A version-2 journal written before the fleet shaping keys
+    existed resumes with their defaults (1 candidate shard, 256-job
+    waves — the values every earlier build effectively ran with, so the
+    draw stream replays bit-identically) instead of being rejected as
+    an incompatible build."""
+    import json
+
+    d = str(tmp_path)
+    rc = main([FA, "-i", "1", "-o", "0", "-l", "--seed", "3",
+               "--output-dir", d])
+    assert rc == 0
+    jpath = os.path.join(d, "search.journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    for key in ("fleet_candidates", "fleet_max_wave"):
+        assert key in recs[0]["config"]
+        del recs[0]["config"][key]
+    with open(jpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    os.unlink(os.path.join(d, "search.journal.json"))  # stale snapshot
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "incompatible build" not in out.err
+    assert "nothing to resume" in out.out
+
+
+def test_fleet_incoherent_flag_combos_rejected(tmp_path, monkeypatch,
+                                               capsys):
+    """--fleet contradicts --serial-jobs (nothing to merge) and --mesh
+    (the fleet builds its own 2-D mesh); the fleet shaping values are
+    validated: each is a one-line error, no traceback, and NO journal
+    files (the device plans validate before the journal is created — a
+    run that never started must not leave a journal recording it).
+    (--fleet --shard-sweep, rejected before PR 8, now COMPOSES: one
+    local fleet per process — covered by
+    test_cli_fleet_shard_sweep_composes.)"""
+    monkeypatch.chdir(tmp_path)
     for argv in (
-        ["--fleet", "--shard-sweep", DES, FA],
         ["--fleet", "--serial-jobs", DES, FA],
         ["--fleet", "--mesh", DES, FA],
+        ["--fleet", "--fleet-candidates", "0", DES, FA],
+        ["--fleet", "--fleet-max-wave", "0", DES, FA],
+        ["--fleet", "--fleet-candidates", "3", DES, FA],
     ):
         rc = main(argv)
         assert rc != 0, argv
         err = capsys.readouterr().err
-        assert "--fleet" in err, argv
         assert err.strip().count("\n") == 0, argv  # exactly one line
         assert "Traceback" not in err
+        assert not list(tmp_path.glob("search.journal.*")), argv
 
 
 def test_cli_fleet_end_to_end(tmp_path, monkeypatch):
@@ -143,6 +180,30 @@ def test_cli_fleet_end_to_end(tmp_path, monkeypatch):
     assert rc == 0
     assert list((tmp_path / "des_s1").glob("*.xml"))
     assert list((tmp_path / "crypto1_fa").glob("*.xml"))
+
+
+def test_cli_fleet_shard_sweep_composes(tmp_path, monkeypatch, capsys):
+    """--fleet --shard-sweep (single process) runs the slice as a local
+    fleet: the sweep completes, the journal records both flags plus the
+    fleet shaping keys (wave size / candidate split are draw-stream
+    shaping, so --resume-run must restore them)."""
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--fleet", "--shard-sweep", "-o", "0", "-i", "1", "-l",
+               "--seed", "2", "--fleet-max-wave", "8",
+               "--output-dir", str(tmp_path), DES, FA])
+    import json
+
+    assert rc == 0, capsys.readouterr().err
+    assert list((tmp_path / "des_s1").glob("*.xml"))
+    assert list((tmp_path / "crypto1_fa").glob("*.xml"))
+    recs = [
+        json.loads(line)
+        for line in open(tmp_path / "search.journal.jsonl")
+    ]
+    cfg = recs[0]["config"]
+    assert cfg["fleet"] is True and cfg["shard_sweep"] is True
+    assert cfg["fleet_max_wave"] == 8
+    assert cfg["fleet_candidates"] == 1
 
 
 def test_help_exits_zero():

@@ -13,19 +13,27 @@ Headline properties:
 """
 
 import os
+import threading
 
 import numpy as np
 import pytest
 
-from planted import build_planted_lut5_small
+from planted import build_planted_lut5, build_planted_lut5_small, \
+    build_planted_lut7
 from sboxgates_tpu.core import boolfunc as bf
 from sboxgates_tpu.core import ttable as tt
 from sboxgates_tpu.graph.state import GATES, NO_GATE, State
 from sboxgates_tpu.search import Options, SearchContext, warmup
 from sboxgates_tpu.search.fleet import (
     FLEET_BUCKETS,
+    FLEET_LADDER,
+    STACKED_BUCKETS,
     FleetRendezvous,
+    _run_fleet_wave,
     fleet_bucket,
+    fleet_gate_step,
+    fleet_lut7_step,
+    fleet_pivot_step,
     prev_fleet_bucket,
     run_fleet_circuits,
 )
@@ -395,6 +403,33 @@ def test_fleet_gate_step_done_masking():
     assert ctx.fleet_stack.misses == m1 + 2
 
 
+def test_fleet_lut_step_done_masking():
+    """Stacked LUT node head (lut_step_stream): per-job verdict rows
+    match the per-job fused head, retired lanes ride as zeroed no-op
+    rows, and mixed static shape classes are rejected — same contract
+    as fleet_gate_step."""
+    from sboxgates_tpu.search.fleet import fleet_lut_step
+
+    ctx = SearchContext(Options(**DEV))
+    sts = [_grow(20, seed=s) for s in range(3)]
+    jobs = [(st, st.table(12).copy(), tt.mask_table(8)) for st in sts]
+    out = fleet_lut_step(ctx, jobs)
+    assert out.shape == (3, 8)
+    for (st, t, m), row in zip(jobs, out):
+        np.testing.assert_array_equal(row, ctx.lut_step(st, t, m, []))
+    out2 = fleet_lut_step(ctx, jobs, done=[True, False, True])
+    assert (out2[0] == 0).all() and (out2[2] == 0).all()
+    np.testing.assert_array_equal(out2[1], out[1])
+    # Live jobs must share one (chunk3, chunk5, has5) class.
+    mixed = jobs[:1] + [(_grow(60, seed=9), jobs[0][1], jobs[0][2])]
+    with pytest.raises(ValueError, match="static shape class"):
+        fleet_lut_step(ctx, mixed)
+    # ...but a done lane's gate count doesn't constrain the class.
+    out3 = fleet_lut_step(ctx, mixed, done=[False, True])
+    np.testing.assert_array_equal(out3[0], out[0])
+    assert (out3[1] == 0).all()
+
+
 def test_fleet_gate_step_sharded_matches():
     from sboxgates_tpu.parallel import FleetPlan, make_fleet_mesh
     from sboxgates_tpu.search.fleet import fleet_gate_step
@@ -409,3 +444,281 @@ def test_fleet_gate_step_sharded_matches():
     a = fleet_gate_step(ctx, jobs)
     b = fleet_gate_step(ctx_p, jobs)
     np.testing.assert_array_equal(a, b)
+
+
+def test_fleet_candidate_split_matches():
+    """(jobs, candidates) device split inside the fleet mesh: the same
+    stacked step under a (4, 2) split is bit-identical to the
+    all-jobs (8, 1) split and to the unsharded dispatch."""
+    from sboxgates_tpu.parallel import FleetPlan, make_fleet_mesh
+
+    plan = FleetPlan(make_fleet_mesh(candidates=2))
+    assert plan.n_candidate_shards == 2 and plan.n_job_shards >= 1
+    assert "x2" in plan.describe()
+    ctx = SearchContext(Options(**dict(DEV, lut_graph=False)))
+    ctx_c = SearchContext(
+        Options(**dict(DEV, lut_graph=False)), fleet_plan=plan
+    )
+    sts = [_grow(20, seed=s) for s in range(4)]
+    jobs = [(st, st.table(12).copy(), tt.mask_table(8)) for st in sts]
+    np.testing.assert_array_equal(
+        fleet_gate_step(ctx, jobs), fleet_gate_step(ctx_c, jobs)
+    )
+
+
+# -------------------------------------------------------------------------
+# Jobs-bucket ladder: stacked dispatch past the flat 32-lane cap
+# -------------------------------------------------------------------------
+
+
+def test_fleet_ladder_and_wave_routing():
+    """The jobs-bucket ladder reaches the stacked rungs, and every
+    public entry point routes oversized waves through the wave splitter
+    — the old 'split into waves' ValueError fires only on the internal
+    single-wave path (regression for the public-entry raise)."""
+    assert FLEET_LADDER[: len(FLEET_BUCKETS)] == FLEET_BUCKETS
+    assert fleet_bucket(33) == 64
+    assert fleet_bucket(1000) == 1024
+    assert prev_fleet_bucket(64) == 32
+    assert STACKED_BUCKETS[0] > FLEET_BUCKETS[-1]
+
+    ctx = SearchContext(Options(fleet=True, fleet_max_wave=2, **DEV))
+    st, target, mask = build_planted_lut5_small()
+    jobs = [(st.copy(), target, mask) for _ in range(5)]
+    # Internal single-wave path: still raises past the cap.
+    with pytest.raises(ValueError, match="split into waves"):
+        _run_fleet_wave(ctx, jobs)
+    # Public entry: splits into ceil(5/2)=3 waves and completes.
+    res = run_fleet_circuits(ctx, [(s.copy(), t, m) for s, t, m in jobs])
+    assert all(out != NO_GATE for _, out in res)
+
+    # Driver-level entry (multibox) routes through the same splitter.
+    ctx2 = SearchContext(Options(fleet=True, fleet_max_wave=2, **DEV))
+    res2 = search_boxes_one_output(
+        ctx2, _toy_boxes(3), 0, save_dir=None, log=lambda s: None,
+    )
+    assert all(sts for sts in res2.values())
+
+
+def test_fleet_stacked_rendezvous_group():
+    """A >32-job fleet wave dispatches its merged node sweeps through
+    the STACKED wrapper — one device dispatch for the whole group, no
+    32-lane slicing — with circuits identical to the serial loop."""
+    ctx = SearchContext(Options(fleet=True, **dict(DEV, lut_graph=False)))
+    st40 = [_grow(20, seed=s) for s in range(40)]
+    jobs = [(st, st.table(12).copy(), tt.mask_table(8)) for st in st40]
+    res = run_fleet_circuits(ctx, jobs)
+    st = ctx.stats
+    assert st["fleet_stacked_dispatches"] >= 1
+    # The 40-lane group was ONE stacked dispatch (64-lane bucket), not
+    # two 32-lane slices: every fleet dispatch is one compiled call.
+    assert st["fleet_dispatches"] + st["fleet_singletons"] <= 2
+    assert st["fleet_lanes"] >= 64 and st["fleet_submits"] == 40
+    # Serial comparison: bit-identical per-job outcomes and circuits.
+    ctx_s = SearchContext(Options(**dict(DEV, lut_graph=False)))
+    from sboxgates_tpu.search.kwan import create_circuit
+
+    for i, (nst, out) in enumerate(res):
+        sst = _grow(20, seed=i)
+        sout = create_circuit(
+            ctx_s, sst, sst.table(12).copy(), tt.mask_table(8), []
+        )
+        assert sout == out
+        assert [
+            (g.type, g.in1, g.in2, g.in3, g.function) for g in nst.gates
+        ] == [
+            (g.type, g.in1, g.in2, g.in3, g.function) for g in sst.gates
+        ]
+
+
+# -------------------------------------------------------------------------
+# Stacked streams: ragged-retirement property tests (pivot + 7-LUT)
+# -------------------------------------------------------------------------
+
+
+def _grow_lut7_job(seed):
+    """16-gate mixed state with a planted LUT(LUT,LUT,·) target — small
+    enough that lut_head_has7 holds (single-chunk 7-LUT space)."""
+    rng = np.random.default_rng(seed)
+    st = State.init_inputs(8)
+    funs = [bf.AND, bf.OR, bf.XOR]
+    while st.num_gates < 16:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(funs[rng.integers(3)], int(a), int(b), GATES)
+    outer = tt.eval_lut(0x96, st.table(3), st.table(5), st.table(9))
+    middle = tt.eval_lut(0xE8, st.table(2), st.table(8), st.table(12))
+    target = tt.eval_lut(0xCA, outer, middle, st.table(14))
+    return st, target, tt.mask_table(8)
+
+
+def test_fleet_lut7_stacked_ragged_parity():
+    """Random done-mask patterns across jobs buckets: the stacked
+    7-LUT step's per-lane verdicts are bit-identical to the per-job
+    kernel's, retired lanes zeroed — the ragged-retirement property for
+    the 7-LUT stacked stream."""
+    ctx = SearchContext(Options(**DEV))
+    jobs = [_grow_lut7_job(s) for s in range(5)]
+    serial = [
+        np.asarray(ctx.lut7_step(st, t, m, [])) for st, t, m in jobs
+    ]
+    assert any(int(v[0]) == 1 for v in serial)  # planted hits fire
+    rng = np.random.default_rng(0)
+    masks = [np.zeros(5, bool)] + [
+        rng.random(5) < 0.5 for _ in range(3)
+    ]
+    for done in masks:
+        for take in (5, 3):  # crosses the jobs bucket 8 -> 4
+            d = list(done[:take])
+            out = fleet_lut7_step(ctx, jobs[:take], done=d)
+            for i in range(take):
+                if d[i]:
+                    assert (out[i] == 0).all()
+                else:
+                    np.testing.assert_array_equal(out[i], serial[i])
+
+
+def test_fleet_pivot_stacked_ragged_parity():
+    """Random done-mask patterns for the stacked pivot stream: per-lane
+    verdict rows (including the planted HIT and its decode payload)
+    bit-identical to the per-job pivot stream over the same tile
+    window; retired lanes ride as zeroed no-ops."""
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search import lut as L
+
+    ctx = SearchContext(Options(**DEV))
+    st, target, mask = build_planted_lut5()
+    g = st.num_gates
+    tl, th = L.pivot_tile_shape(g)
+    ops = L.PivotOperands(
+        g, tl, th, [], ctx.device_tables(st), target, mask,
+        ctx.place_replicated, kernel_call=ctx.kernel_call,
+    )
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    jw = ctx.place_replicated(w_tab)
+    jm = ctx.place_replicated(m_tab)
+
+    def serial_window(start, end):
+        return np.asarray(ctx.kernel_call(
+            "lut5_pivot_stream",
+            dict(tl=tl, th=th, tile_batch=L.pivot_tile_batch(),
+                 pipeline=L.pivot_pipeline(), backend="xla"),
+            (*ops.stream_args(), start, end, jw, jm, -1), g=g,
+        ))
+
+    # Window [16, 19) holds the planted hit (tile 18); [0, 3) does not.
+    hit_v = serial_window(16, 19)
+    miss_v = serial_window(0, 3)
+    assert int(hit_v[0]) == 1 and int(miss_v[0]) == 0
+    jobs = [(st.copy(), target.copy(), mask) for _ in range(3)]
+    rng = np.random.default_rng(1)
+    for done in [np.zeros(3, bool)] + [rng.random(3) < 0.5 for _ in range(2)]:
+        d = list(done)
+        out = fleet_pivot_step(ctx, jobs, done=d, start_t=16, t_limit=3)
+        for i in range(3):
+            expect = np.zeros(9, np.int32) if d[i] else hit_v
+            np.testing.assert_array_equal(out[i], expect)
+    out0 = fleet_pivot_step(ctx, jobs, done=[False, True, False], t_limit=3)
+    np.testing.assert_array_equal(out0[0], miss_v)
+    assert (out0[1] == 0).all()
+
+
+def test_fleet_pivot_warm_crossing_zero_compiles(monkeypatch):
+    """The (jobs_bucket, pivot_g_bucket) warm keys: a warmed stacked
+    pivot fleet crossing EITHER axis — the pivot g-bucket (64 -> 96,
+    tables 64 -> 512) or the jobs bucket (2 -> 1, jobs retiring) —
+    performs zero steady-state compiles under a strict
+    ``recompile_guard``: the stacked pivot executables are AOT-built by
+    the warmer from ``fleet_warm_specs``."""
+    # Narrow the warm enumeration to the pivot kernels so the
+    # background sets compile within test time; the other heads' warm
+    # coverage has its own gates above.
+    monkeypatch.setattr(warmup, "FLEET_SHARED", {
+        k: warmup.FLEET_SHARED[k]
+        for k in ("pivot_pair_cells", "lut5_pivot_stream")
+    })
+    monkeypatch.setenv("SBG_WARMUP", "1")
+    ctx = SearchContext(Options(fleet=True, **DEV))
+    assert ctx.warmer is not None and ctx.warmer.enabled
+    st50, t50, mask = build_planted_lut5()
+    st70 = st50.copy()
+    rng = np.random.default_rng(9)
+    while st70.num_gates < 70:
+        a, b = rng.choice(st70.num_gates, size=2, replace=False)
+        st70.add_gate(bf.XOR, int(a), int(b), GATES)
+    t70 = st70.table(60).copy()
+    from sboxgates_tpu.search.lut import pivot_g_bucket
+
+    assert pivot_g_bucket(st50.num_gates) == 64
+    assert pivot_g_bucket(st70.num_gates) == 96
+    jobs50 = lambda: [(st50.copy(), t50, mask) for _ in range(2)]  # noqa: E731
+    jobs70 = lambda: [(st70.copy(), t70, mask) for _ in range(2)]  # noqa: E731
+    try:
+        # Entry: 2 lanes at pivot bucket 64 — schedules the stacked
+        # warm cross product {g, next bucket entry} x {2, 1 lanes},
+        # including the next PIVOT bucket's stream avals.
+        base = fleet_pivot_step(ctx, jobs50(), t_limit=1)
+        assert ctx.warmer.wait_idle(600), "warmer never went idle"
+        ws = ctx.warmup_stats()
+        assert ws["warm_failed"] == 0, ws
+        assert ws["warm_compiled"] >= 4, ws
+        # Run each crossing shape once (warm-served; first entries
+        # schedule THEIR successors, which must drain before a
+        # process-wide zero-compile guard).
+        fleet_pivot_step(ctx, jobs70(), t_limit=1)
+        fleet_pivot_step(ctx, jobs50()[:1], t_limit=1)
+        assert ctx.warmer.wait_idle(600)
+        h0 = ctx.stats["warm_hits"]
+        with recompile_guard(allowed=0, label="stacked pivot crossing") as rep:
+            # Pivot-g-bucket crossing at held lanes (64 -> 96).
+            out70 = fleet_pivot_step(ctx, jobs70(), t_limit=1)
+            # Jobs-bucket crossing (2 -> 1) at the old pivot bucket.
+            out50 = fleet_pivot_step(ctx, jobs50()[:1], t_limit=1)
+        assert rep.compiles == 0
+        assert out70.shape == (2, 9) and out50.shape == (1, 9)
+        np.testing.assert_array_equal(out50[0], base[0])
+        assert ctx.stats["warm_hits"] >= h0 + 4
+        assert ctx.warmup_stats().get("warm_aval_mismatches", 0) == 0
+    finally:
+        ctx.warmer.shutdown()
+
+
+def test_fleet_staged_lut7_stream_merge():
+    """The staged 7-LUT collection path (feasible_stream — a pytree-
+    output kernel) folds into the fleet axis: two concurrent jobs'
+    stage-A streams merge through the rendezvous and the found
+    decompositions are identical to the serial search."""
+    from sboxgates_tpu.search.batched import RestartContext
+    from sboxgates_tpu.search.lut import lut7_search
+
+    st, target, mask = build_planted_lut7()
+    ctx_s = SearchContext(Options(**DEV))
+    expect = lut7_search(ctx_s, st.copy(), target, mask, [])
+    assert expect is not None
+
+    ctx = SearchContext(Options(fleet=True, **DEV))
+    rdv = FleetRendezvous(2, warmer=None)
+    results = [None, None]
+    errors = []
+
+    def worker(i):
+        try:
+            rctx = RestartContext(ctx, 100 + i, rdv)
+            results[i] = lut7_search(rctx, st.copy(), target, mask, [])
+        except BaseException as e:  # surfaced after join
+            errors.append(e)
+        finally:
+            rdv.finish()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert results[0] == expect and results[1] == expect
+    # The stage-A feasibility streams (and the stage-B solves) actually
+    # merged: at least one multi-lane fleet dispatch happened.
+    assert rdv.stats["fleet_dispatches"] >= 1
+    assert rdv.stats["batched_rows"] >= 2
