@@ -64,6 +64,41 @@ SOCKET_CORES = 64
 # entry; entries that legitimately run longer pass budget= explicitly.
 ENTRY_BUDGET_S = 900.0
 
+# --- shared BENCH_*.json schema -------------------------------------------
+# Every writer prepends ONE meta entry (entries[0], metric "meta"): the
+# schema version, the t1-normalization convention every promotion
+# decision uses, and where counters come from (the telemetry metrics
+# registry — ctx.stats IS a registry snapshot source, not bespoke
+# per-script accounting).  tests/test_telemetry.py rejects schema drift.
+BENCH_SCHEMA = 1
+BENCH_META_KEYS = (
+    "metric", "schema", "t1_normalization", "counters_source", "smoke",
+)
+
+
+def bench_meta(**extra) -> dict:
+    meta = {
+        "metric": "meta",
+        "schema": BENCH_SCHEMA,
+        "t1_normalization": (
+            "promotion decisions compare each entry's best/t1 ratio "
+            "measured in its own window, never raw cand/s across windows"
+        ),
+        "counters_source": "telemetry.metrics registry (ctx.stats)",
+        "smoke": SMOKE,
+    }
+    meta.update(extra)
+    return meta
+
+
+def with_meta(entries) -> list:
+    """The shared meta block as ``entries[0]`` (idempotent; copies so
+    callers' lists — and their ``detail[-1]`` reads — stay untouched)."""
+    out = list(entries)
+    if not out or out[0].get("metric") != "meta":
+        out.insert(0, bench_meta())
+    return out
+
 
 def _spread(fn, n=REPEATS):
     """n timed reps -> {value: median, min, max} (throttle diagnostics:
@@ -1156,6 +1191,50 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
 
     s1, s2 = spread(rates[1]), spread(rates[2])
     space = math.comb(g, 5)
+
+    # Telemetry overhead A/B (the acceptance gate for the telemetry
+    # subsystem): one pipelined sweep per arm under its own sync_guard —
+    # tracing OFF (the production default; registry + flight ring only)
+    # vs the process tracer ON.  Spans time host-side events only, so
+    # the sync counts MUST be identical (asserted: zero extra host
+    # syncs); the wall-time delta is the <=1% budget, reported as a
+    # fraction of the trace-off rate.
+    from sboxgates_tpu.telemetry import trace as ttrace
+
+    tr = ttrace.tracer()
+    assert not tr.enabled, "tracer unexpectedly on in the bench process"
+    with sync_guard(allowed=1 << 30, action="count",
+                    label="telemetry-off") as s_off:
+        r_off, _ = sweep(2)
+    tr.reset()
+    tr.enabled = True
+    try:
+        with sync_guard(allowed=1 << 30, action="count",
+                        label="telemetry-on") as s_on:
+            r_on, c_on = sweep(2)
+    finally:
+        tr.enabled = False
+    extra_syncs = s_on.syncs - s_off.syncs
+    assert extra_syncs == 0, (
+        f"tracing added {extra_syncs} host syncs — spans must never "
+        "touch the device"
+    )
+    dispatch_spans = sum(
+        1 for e in tr.events() if e[1] == "dispatch"
+    )
+    telemetry_entry = {
+        "metric": "telemetry_overhead",
+        "trace_off_cand_s": r_off,
+        "trace_on_cand_s": r_on,
+        # Positive = tracing cost; single-rep arms, so noise dominates
+        # on CPU — the acceptance read is "within 1% or below noise".
+        "overhead_frac": round(1.0 - r_on / r_off, 4),
+        "extra_syncs_trace_on": extra_syncs,
+        "trace_dispatch_spans": dispatch_spans,
+        "dispatch_counter": c_on.stats.get("device_dispatches", 0),
+        "unit": "fraction of trace-off cand/s",
+    }
+
     return [
         {"metric": "lut5_host_stream_serial", **s1, "unit": "cand/s",
          "space": space, "pipeline_depth": 1},
@@ -1185,6 +1264,7 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
          "replicated_aborts": c2.stats.get("replicated_aborts", 0),
          "degraded_ranks": c2.stats.get("degraded_ranks", 0),
          "guard_mode": "strict" if strict_guards else "count"},
+        telemetry_entry,
     ]
 
 
@@ -2293,7 +2373,7 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
         detail = bench_fleet()
         with open(os.path.join(HERE, "BENCH_FLEET.json"), "w") as f:
-            json.dump(detail, f, indent=1)
+            json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[-1]))
         return
     if "--cold-start" in sys.argv:
@@ -2302,7 +2382,7 @@ def main() -> None:
         # written to BENCH_COLDSTART.json.  Needs no accelerator.
         detail = bench_cold_start()
         with open(os.path.join(HERE, "BENCH_COLDSTART.json"), "w") as f:
-            json.dump(detail, f, indent=1)
+            json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[-1]))
         return
     if "--host-stream" in sys.argv:
@@ -2324,13 +2404,20 @@ def main() -> None:
             strict_guards="--sync-guard" in sys.argv
         )
         with open(os.path.join(HERE, "BENCH_PIPELINE.json"), "w") as f:
-            json.dump(detail, f, indent=1)
+            json.dump(with_meta(detail), f, indent=1)
         # Replicated-degradation protocol overhead + counters ride the
         # same mode (the deadline-guard counters already report here).
         degrade = bench_degrade_protocol()
         with open(os.path.join(HERE, "BENCH_DEGRADE.json"), "w") as f:
-            json.dump(degrade, f, indent=1)
-        pipelined = detail[-1]
+            json.dump(with_meta(degrade), f, indent=1)
+        pipelined = next(
+            e for e in detail
+            if e.get("metric") == "lut5_host_stream_pipelined"
+        )
+        telem = next(
+            (e for e in detail if e.get("metric") == "telemetry_overhead"),
+            {},
+        )
         print(json.dumps({
             "metric": "lut5_host_stream_speedup",
             "value": pipelined.get("speedup_vs_serial"),
@@ -2344,6 +2431,8 @@ def main() -> None:
             "verdict_barrier_overhead_s": degrade[2].get(
                 "overhead_vs_guard_s"
             ),
+            "telemetry_overhead_frac": telem.get("overhead_frac"),
+            "telemetry_extra_syncs": telem.get("extra_syncs_trace_on"),
         }))
         return
 
@@ -2447,7 +2536,7 @@ def main() -> None:
             with open(
                 os.path.join(HERE, "BENCH_UNREACHABLE.partial.json"), "w"
             ) as f:
-                json.dump(detail, f, indent=1)
+                json.dump(with_meta(detail), f, indent=1)
         os.replace(
             os.path.join(HERE, "BENCH_UNREACHABLE.partial.json"),
             os.path.join(HERE, "BENCH_UNREACHABLE.json"),
@@ -2479,7 +2568,7 @@ def main() -> None:
         name = "BENCH_SMOKE" if SMOKE else "BENCH_DETAIL"
         partial = os.path.join(HERE, f"{name}.partial.json")
         with open(partial, "w") as f:
-            json.dump(detail, f, indent=1)
+            json.dump(with_meta(detail), f, indent=1)
         if final:
             os.replace(partial, os.path.join(HERE, f"{name}.json"))
 
@@ -2564,7 +2653,7 @@ def main() -> None:
                     with open(
                         os.path.join(HERE, "BENCH_ABORTED.json"), "w"
                     ) as f:
-                        json.dump(detail, f, indent=1)
+                        json.dump(with_meta(detail), f, indent=1)
                     line = _headline_line()
                     line["error"] = (
                         f"aborted: {watchdog['entry']} hung past its "

@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 #: Per-file rules.
-FILE_RULES = ("R1", "R2", "R3", "R4", "R5")
+FILE_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 #: Cross-module rules (whole-program pass only).
 CROSS_RULES = ("R1x", "R2x", "R4x")
 ALL_RULES = FILE_RULES + CROSS_RULES

@@ -52,6 +52,11 @@ RULE_DOCS = {
     "R3": "tracer escape (self/global store or thread hand-off under jit trace)",
     "R4": "module state mutated in a thread target without its module lock",
     "R5": "except Exception/bare except that neither re-raises nor logs",
+    "R6": (
+        "direct stats-dict mutation outside telemetry/ (an unlocked "
+        "read-modify-write loses updates under thread races; use the "
+        "metrics facade: stats.inc/put/observe/ensure/merge)"
+    ),
     "R1x": (
         "cross-module recompilation hazard (unhashable or loop-varying "
         "static arg at a call site of a jitted function defined elsewhere)"
@@ -771,6 +776,92 @@ class _R5(ast.NodeVisitor):
 
 
 # --------------------------------------------------------------------------
+# R6 — stats mutation discipline (telemetry metrics facade)
+
+#: ``X.stats.<method>(...)`` calls that mutate the mapping in place;
+#: facade methods (inc/put/observe/ensure/merge/restore/fork) are the
+#: sanctioned mutation surface and are not listed.
+_R6_MUTATING_METHODS = {"update", "clear", "setdefault", "pop", "popitem"}
+
+
+def _is_stats_base(node: ast.AST) -> bool:
+    """True for the expressions R6 guards: an attribute named ``stats``
+    (``ctx.stats``, ``self.stats``, ``rdv.stats``) or the bare parameter
+    name ``stats`` the resilience/mesh helpers receive."""
+    if isinstance(node, ast.Attribute) and node.attr == "stats":
+        return True
+    return isinstance(node, ast.Name) and node.id == "stats"
+
+
+class _R6(ast.NodeVisitor):
+    """Direct mutation of a stats mapping: subscript assignment /
+    augmented assignment, or an in-place-mutating dict method call.
+    Reads are fine; the telemetry facade methods are fine.  The rule is
+    skipped inside ``telemetry/`` itself (the facade's own home)."""
+
+    def __init__(self) -> None:
+        self.findings: List[Tuple[int, int, str]] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(
+            (
+                node.lineno,
+                node.col_offset,
+                f"{what} mutates a stats dict directly — an unlocked "
+                "read-modify-write loses updates when threads race; "
+                "route it through the telemetry metrics facade "
+                "(stats.inc/put/observe/ensure/merge)",
+            )
+        )
+
+    def _check_target(self, target: ast.AST, node: ast.AST, what: str):
+        # Only the assigned-to expression itself counts: recurse through
+        # tuple/list/starred unpacking structure, then test whether the
+        # leaf subscript's VALUE chain bottoms out at a stats base
+        # (``ctx.stats["a"] = v``, ``ctx.stats["a"]["b"] = v``).  A
+        # stats READ in the slice of an unrelated target
+        # (``cache[ctx.stats["x"]] = v``) mutates ``cache``, not stats.
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_target(elt, node, what)
+            return
+        if isinstance(target, ast.Starred):
+            self._check_target(target.value, node, what)
+            return
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if _is_stats_base(base):
+                self._flag(node, what)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_target(target, node, "subscript assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node, "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _R6_MUTATING_METHODS
+            and _is_stats_base(f.value)
+        ):
+            self._flag(node, f".stats.{f.attr}() call")
+        self.generic_visit(node)
+
+
+def _r6_exempt(relpath: str) -> bool:
+    """telemetry/ owns the facade; its internals mutate the underlying
+    dict under the registry lock by design."""
+    return "telemetry" in relpath.replace("\\", "/").split("/")
+
+
+# --------------------------------------------------------------------------
 # suppression comments
 
 _SUPPRESS_RE = re.compile(
@@ -930,6 +1021,10 @@ def analyze_file(
         r5 = _R5()
         r5.visit(fa.tree)
         fa.raw += [("R5", *f) for f in r5.findings]
+    if "R6" in config.rules and not _r6_exempt(relpath):
+        r6 = _R6()
+        r6.visit(fa.tree)
+        fa.raw += [("R6", *f) for f in r6.findings]
 
     fa.sups, fa.bad_sups = scan_suppressions(source)
     # Unused-suppression eligibility: only rules this scan actually
@@ -939,6 +1034,8 @@ def analyze_file(
     fa.checked = {r for r in config.rules if r in ("R1", "R3", "R4", "R5")}
     if "R2" in config.rules and is_hot:
         fa.checked.add("R2")
+    if "R6" in config.rules and not _r6_exempt(relpath):
+        fa.checked.add("R6")
     return fa
 
 

@@ -135,6 +135,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "the original search configuration is restored "
                         "from the journal and the final circuits are "
                         "bit-identical to an uninterrupted run")
+    p.add_argument("--trace", nargs="?", const="", default=None,
+                   metavar="FILE",
+                   help="record structured spans (every dispatch, "
+                        "compile, warmup build, rendezvous merge, "
+                        "deadline window, journal write) and export a "
+                        "Chrome/Perfetto trace.json at exit (to FILE, "
+                        "default trace.json in --output-dir); purely "
+                        "observational — results are bit-identical with "
+                        "or without it")
+    p.add_argument("--metrics-interval", type=float, default=60.0,
+                   metavar="S",
+                   help="telemetry heartbeat period in seconds (default "
+                        "60): with an explicit --output-dir, a "
+                        "background thread appends one fsync'd counter "
+                        "line per period to telemetry.jsonl (rank-scoped "
+                        "under shard-NN/ for multi-process runs) and an "
+                        "atomic metrics.json snapshot is written at "
+                        "exit; 0 disables the periodic line (the final "
+                        "snapshot is still written)")
     p.add_argument("--dispatch-timeout", type=float, default=None,
                    metavar="S",
                    help="hung-dispatch deadline for device sweeps in "
@@ -312,8 +331,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     if args.fleet_max_wave < 1:
         return _err(f"Bad fleet max wave value: {args.fleet_max_wave}")
+    if args.metrics_interval < 0:
+        return _err(f"Bad metrics interval value: {args.metrics_interval}")
     if args.output_dir is None:
         args.output_dir = "."
+    # Telemetry artifacts (heartbeat JSONL, metrics.json, flight-recorder
+    # dumps) live with the journal in an EXPLICIT --output-dir; the cwd
+    # default must not sprout telemetry files wherever the tool runs.
+    # Captured here, before the non-primary ranks null their output_dir:
+    # flight dumps and heartbeats are per-rank artifacts (scoped under
+    # shard-NN/ below), unlike the primary-owned checkpoints.
+    tele_root = args.output_dir if outdir_explicit else None
 
     # Conversion mode: deserialize -> emit, no search (sboxgates.c:1097-1114).
     if args.convert_c or args.convert_dot:
@@ -525,6 +553,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet=args.fleet,
         fleet_candidates=args.fleet_candidates,
         fleet_max_wave=args.fleet_max_wave,
+        trace=args.trace is not None,
     )
 
     # ONE construction serves both the journal's recorded configuration
@@ -632,11 +661,90 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _err(f"Error: {e}")
     ctx = SearchContext(opt, mesh_plan=mesh_plan, fleet_plan=fleet_plan)
 
-    def _finish() -> int:
+    # Telemetry wiring: rank-scoped directory (heartbeat JSONL + flight
+    # dumps live under shard-NN/ for every non-primary or job-sharded
+    # rank, alongside that rank's journal), resume-aware heartbeat
+    # (appends after a crash tail instead of truncating the evidence),
+    # and the flight recorder armed for every incident trigger.
+    from .telemetry import flight as _flight
+    from .telemetry.heartbeat import Heartbeat
+
+    rank = jax.process_index() if multiprocess else 0
+    tele_dir = None
+    if tele_root is not None:
+        if multiprocess and (args.shard_sweep or rank != 0):
+            from .resilience.journal import shard_dir as _shard_dir
+
+            tele_dir = _shard_dir(tele_root, rank)
+        else:
+            tele_dir = tele_root
+    heartbeat = None
+    if tele_dir is not None:
+        _flight.configure(tele_dir, rank=rank)
+        heartbeat = Heartbeat(
+            ctx.stats, tele_dir, interval_s=args.metrics_interval,
+            rank=rank, resume=resume, run_config=run_config,
+        ).start()
+
+    torn_down = False
+
+    def _teardown() -> None:
+        # Runs on EVERY exit path (success, error return, fatal raise)
+        # via the finally below: an error exit must not leak the
+        # heartbeat daemon + its incident hook into the process (an
+        # in-process caller's NEXT run would get stale incident lines),
+        # and the promised final heartbeat line / metrics.json snapshot
+        # / trace export are exactly the artifacts a failed run needs.
+        # Run-once: _finish() tears down before its report (the report
+        # reads post-shutdown warmer stats), and a second pass would
+        # re-export the just-reset tracer as an empty trace.
+        nonlocal torn_down
+        if torn_down:
+            return
+        torn_down = True
         if ctx.warmer is not None:
             # Bounded join; a worker parked in a hung backend compile is
             # a daemon and never blocks exit.
             ctx.warmer.shutdown()
+        if heartbeat is not None:
+            # Final heartbeat line + the atomic end-of-run metrics.json
+            # snapshot (counters + histograms) bench.py consumes.
+            # Idempotent — the fatal-exception path below may already
+            # have stopped it.
+            heartbeat.stop()
+        if args.trace is not None:
+            from .telemetry import trace as _trace
+
+            if args.trace:
+                # An explicit FILE is identical on every rank of a
+                # multiprocess run; rank-qualify it so ranks don't
+                # clobber each other's export (the default path is
+                # already rank-safe via the shard-NN/ telemetry dir).
+                out_path = args.trace
+                if multiprocess:
+                    stem, ext = os.path.splitext(out_path)
+                    out_path = f"{stem}-rank{rank:02d}{ext or '.json'}"
+            else:
+                out_path = os.path.join(
+                    tele_dir if tele_dir is not None else ".", "trace.json"
+                )
+            log(f"Trace written to {_trace.tracer().export(out_path)}.")
+            # Undo what Options.trace enabled: the tracer is process-
+            # global, so leaving it on (with this run's buffers) would
+            # bleed an ever-growing cross-run timeline into the next
+            # in-process main() call.
+            _trace.tracer().enabled = False
+            _trace.tracer().reset()
+        # The flight recorder is process-global too: drop this run's
+        # dump directory and ring, or a later in-process run that never
+        # calls configure (no --output-dir) would dump ITS incidents —
+        # interleaved with this run's stale events — into this run's
+        # directory.
+        _flight.configure(None)
+        _flight.flight_recorder().reset()
+
+    def _finish() -> int:
+        _teardown()
         if args.verbose >= 2:
             # Per-phase wall-clock + candidate-throughput summary (a
             # TPU-build addition; the reference has no tracing, SURVEY §5).
@@ -659,80 +767,97 @@ def main(argv: Optional[List[str]] = None) -> int:
         log("Generated 3-input gates: " + "".join(
             "%02x " % f.fun for f in ctx.avail_3))
 
-    if multibox or args.permute_sweep:
-        # BASELINE configs 4-5: the sweep is the batch axis (multibox.py).
-        from .search.multibox import (
-            load_box_jobs,
-            permute_sweep_jobs,
-            process_slice,
-            search_boxes_all_outputs,
-            search_boxes_one_output,
-        )
+    # Fatal-exception flight dump: an unhandled error anywhere in the
+    # search leaves the post-mortem ring + counter snapshot on disk
+    # before the traceback kills the process — the crash itself becomes
+    # an artifact, like the deadline/breaker/fault triggers.
+    try:
+        if multibox or args.permute_sweep:
+            # BASELINE configs 4-5: the sweep is the batch axis (multibox.py).
+            from .search.multibox import (
+                load_box_jobs,
+                permute_sweep_jobs,
+                process_slice,
+                search_boxes_all_outputs,
+                search_boxes_one_output,
+            )
 
-        try:
-            if multibox:
-                boxes = load_box_jobs(args.input, args.permute)
-            else:
-                boxes = permute_sweep_jobs(sbox, num_inputs)
-        except OSError:
-            return _err("Error when opening target S-box file.")
-        except SboxError as e:
-            return _err(str(e))
-        if args.shard_sweep:
-            # Pod-scale mode: this process searches only its slice (the
-            # ctx already holds the local-device mesh).
             try:
-                boxes = process_slice(boxes)
+                if multibox:
+                    boxes = load_box_jobs(args.input, args.permute)
+                else:
+                    boxes = permute_sweep_jobs(sbox, num_inputs)
+            except OSError:
+                return _err("Error when opening target S-box file.")
+            except SboxError as e:
+                return _err(str(e))
+            if args.shard_sweep:
+                # Pod-scale mode: this process searches only its slice (the
+                # ctx already holds the local-device mesh).
+                try:
+                    boxes = process_slice(boxes)
+                except ValueError as e:
+                    return _err(f"Error: {e}")
+            batched = (
+                "fleet" if args.fleet
+                else False if (args.serial_jobs or args.mesh) else None
+            )
+            try:
+                if args.single_output != -1:
+                    search_boxes_one_output(
+                        ctx, boxes, args.single_output,
+                        save_dir=args.output_dir, log=log, batched=batched,
+                        journal=journal,
+                    )
+                else:
+                    search_boxes_all_outputs(
+                        ctx, boxes, save_dir=args.output_dir, log=log,
+                        batched=batched, journal=journal,
+                    )
             except ValueError as e:
                 return _err(f"Error: {e}")
-        batched = (
-            "fleet" if args.fleet
-            else False if (args.serial_jobs or args.mesh) else None
-        )
-        try:
-            if args.single_output != -1:
-                search_boxes_one_output(
-                    ctx, boxes, args.single_output,
-                    save_dir=args.output_dir, log=log, batched=batched,
-                    journal=journal,
-                )
-            else:
-                search_boxes_all_outputs(
-                    ctx, boxes, save_dir=args.output_dir, log=log,
-                    batched=batched, journal=journal,
-                )
-        except ValueError as e:
-            return _err(f"Error: {e}")
+            return _finish()
+
+        if args.graph is None:
+            st = State.init_inputs(num_inputs)
+        else:
+            try:
+                st = load_state(args.graph)
+            except (OSError, StateLoadError) as e:
+                return _err(f"Error when reading state file {args.graph}: {e}")
+            log(f"Loaded {args.graph}.")
+
+        if ctx.warmer is not None:
+            # Restarts and --resume-run: rebuild the starting bucket's
+            # executables in the background (persistent-cache deserializes)
+            # before the first dispatch needs them; note_gates then covers
+            # the next bucket as the search grows.
+            ctx.warmer.prewarm(st.num_gates)
+
+        if args.single_output != -1:
+            generate_graph_one_output(
+                ctx, st, targets, args.single_output, save_dir=args.output_dir,
+                log=log, journal=journal,
+            )
+        else:
+            generate_graph(
+                ctx, st, targets, save_dir=args.output_dir, log=log,
+                journal=journal,
+            )
+
         return _finish()
-
-    if args.graph is None:
-        st = State.init_inputs(num_inputs)
-    else:
-        try:
-            st = load_state(args.graph)
-        except (OSError, StateLoadError) as e:
-            return _err(f"Error when reading state file {args.graph}: {e}")
-        log(f"Loaded {args.graph}.")
-
-    if ctx.warmer is not None:
-        # Restarts and --resume-run: rebuild the starting bucket's
-        # executables in the background (persistent-cache deserializes)
-        # before the first dispatch needs them; note_gates then covers
-        # the next bucket as the search grows.
-        ctx.warmer.prewarm(st.num_gates)
-
-    if args.single_output != -1:
-        generate_graph_one_output(
-            ctx, st, targets, args.single_output, save_dir=args.output_dir,
-            log=log, journal=journal,
-        )
-    else:
-        generate_graph(
-            ctx, st, targets, save_dir=args.output_dir, log=log,
-            journal=journal,
-        )
-
-    return _finish()
+    except BaseException as e:
+        if not isinstance(e, SystemExit):
+            # Dump BEFORE _teardown(): the heartbeat's incident hook is
+            # still registered, so the dump forces the out-of-band
+            # incident line into this run's telemetry.jsonl.
+            _flight.flight_dump(
+                "fatal_exception", registry=ctx.stats,
+                extra={"error": repr(e)},
+            )
+        raise
+    finally:
+        _teardown()
 
 
 if __name__ == "__main__":

@@ -56,6 +56,17 @@ def _note_service_failure(exc: BaseException) -> None:
     with _SERVICE_FAIL_LOCK:
         _SERVICE_FAILURES += 1
         n = _SERVICE_FAILURES
+    # Structured telemetry alongside the rate-limited stderr line: a
+    # trace/flight instant plus a process-global counter surfaced by
+    # heartbeat lines and metrics.json (the stderr line only helps if
+    # someone was watching the terminal).
+    from ..telemetry import metrics as _tmetrics
+    from ..telemetry import trace as _ttrace
+
+    _tmetrics.GLOBAL.inc("native_service_failures")
+    _ttrace.instant(
+        "native.service_failure", "fallback", error=repr(exc)[:200], n=n
+    )
     if n <= _SERVICE_FAIL_PRINT_FIRST or n % _SERVICE_FAIL_PRINT_EVERY == 0:
         print(
             f"sboxgates_tpu: device-work service failed inside the native "

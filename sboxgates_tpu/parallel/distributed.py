@@ -62,10 +62,14 @@ def initialize(
         process_id=process_id,
     )
     # Rank-targeted fault sites (``SITE@rank:N`` in SBG_FAULTS) resolve
-    # against this process's rank from here on.
+    # against this process's rank from here on; telemetry (trace pid
+    # tagging, flight-recorder dump names) follows the same rank so
+    # per-rank artifacts from one incident correlate.
     from ..resilience import faults
+    from ..telemetry import trace as _ttrace
 
     faults.set_rank(jax.process_index())
+    _ttrace.set_rank(jax.process_index())
 
 
 def is_primary() -> bool:
@@ -292,6 +296,11 @@ def breach_verdict(local_breach: bool, timeout_s: Optional[float] = None) -> boo
     with _VERDICT_LOCK:
         _VERDICT_SEQ += 1
         seq = _VERDICT_SEQ
+    from ..telemetry import trace as _ttrace
+
+    _ttrace.instant(
+        "verdict.exchange", "deadline", seq=seq, local_breach=local_breach
+    )
     client = _coordination_client()
     if client is not None:
         try:

@@ -65,6 +65,8 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..ops import sweeps
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 
 CANDIDATES_AXIS = "candidates"
 RESTARTS_AXIS = "restarts"
@@ -505,10 +507,12 @@ def _note_pallas_fallback(backend: str, stats) -> None:
     with _PALLAS_LOCK:
         _PALLAS_FALLBACKS += 1
         n = _PALLAS_FALLBACKS
-        if stats is not None:
-            stats["pivot_pallas_fallbacks"] = (
-                stats.get("pivot_pallas_fallbacks", 0) + 1
-            )
+    _tmetrics.bump(stats, "pivot_pallas_fallbacks")
+    # Structured telemetry too, not just a terminal someone watched: an
+    # instant in the trace/flight ring plus a process-global counter
+    # that heartbeat lines and metrics.json surface under "process".
+    _tmetrics.GLOBAL.inc("pivot_pallas_fallbacks")
+    _ttrace.instant("pallas_fallback", "fallback", backend=backend, n=n)
     if n <= _PALLAS_PRINT_FIRST or n % _PALLAS_PRINT_EVERY == 0:
         print(
             f"sboxgates_tpu: SBG_PIVOT_BACKEND={backend!r} is "

@@ -40,6 +40,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..telemetry import flight as _tflight
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 from .faults import fault_point
 
 logger = logging.getLogger(__name__)
@@ -49,11 +52,31 @@ class DispatchTimeout(RuntimeError):
     """A device dispatch exceeded its deadline budget (retries included)."""
 
 
-# Parallel mux-branch threads call dispatch_with_retry with ONE shared
-# ctx.stats dict; an unlocked read-modify-write on the breach/retry
-# counters loses increments exactly when breaches coincide (the case the
-# counters exist to expose).  Same pattern as mesh._PALLAS_LOCK.
-_stats_lock = threading.Lock()
+def _bump(stats, key: str, by: int = 1) -> None:
+    """Atomic counter increment through the telemetry facade: the ctx
+    registry increments under its own lock; plain dicts (tests,
+    per-attempt scratch) share the facade's module lock.  Parallel mux
+    threads hit these counters with ONE shared stats object, and an
+    unlocked read-modify-write would lose increments exactly when
+    breaches coincide — the case the counters exist to expose."""
+    _tmetrics.bump(stats, key, by)
+
+
+def _flight_exhausted(reason: str, stats, label: str, windows: int) -> None:
+    """Retry-schedule exhaustion is a flight-recorder incident: the dump
+    carries the recent dispatch/deadline spans plus the breaching
+    window's label, so a dead run leaves a post-mortem naming the span
+    that killed it."""
+    _ttrace.instant("deadline.exhausted", "deadline",
+                    label=label, windows=windows)
+    path = _tflight.flight_dump(
+        reason,
+        registry=stats if isinstance(stats, _tmetrics.MetricsRegistry)
+        else None,
+        extra={"label": label, "windows": windows},
+    )
+    if path is not None:
+        _bump(stats, "flight_dumps")
 
 
 @dataclass
@@ -156,22 +179,19 @@ def dispatch_with_retry(
         try:
             return run_with_deadline(attempt, cfg.budget_s, label)
         except DispatchTimeout as e:
-            if stats is not None:
-                with _stats_lock:
-                    stats["deadline_breaches"] = (
-                        stats.get("deadline_breaches", 0) + 1
-                    )
+            _bump(stats, "deadline_breaches")
+            _ttrace.instant("deadline.breach", "deadline",
+                            label=label, attempt=k)
             if k == cfg.retries:
                 logger.warning(
                     "%s; %d retr%s exhausted", e, cfg.retries,
                     "y" if cfg.retries == 1 else "ies",
                 )
+                _flight_exhausted(
+                    "deadline_exhausted", stats, label, cfg.retries + 1
+                )
                 raise
-            if stats is not None:
-                with _stats_lock:
-                    stats["dispatch_retries"] = (
-                        stats.get("dispatch_retries", 0) + 1
-                    )
+            _bump(stats, "dispatch_retries")
             logger.warning("%s; retry %d/%d in %.2fs", e, k + 1,
                            cfg.retries, delay)
             time.sleep(delay)
@@ -179,12 +199,6 @@ def dispatch_with_retry(
             if on_retry is not None:
                 on_retry()
     raise AssertionError("unreachable")
-
-
-def _bump(stats: Optional[dict], key: str, by: int = 1) -> None:
-    if stats is not None:
-        with _stats_lock:
-            stats[key] = stats.get(key, 0) + by
 
 
 def verdict_transport_timeout(budget_s: float) -> float:
@@ -302,13 +316,18 @@ def replicated_dispatch_with_retry(
     for k in range(cfg.retries + 1):
         breached = False
         value = None
-        try:
-            value = run_with_deadline(attempt, cfg.budget_s, label)
-        except DispatchTimeout:
-            breached = True
-            _bump(stats, "deadline_breaches")
-        agreed = _verdict_barrier(verdict, breached, cfg.budget_s, label)
-        _bump(stats, "breach_barriers")
+        with _ttrace.span("deadline.window", "deadline",
+                          label=label, attempt=k) as sp:
+            try:
+                value = run_with_deadline(attempt, cfg.budget_s, label)
+            except DispatchTimeout:
+                breached = True
+                _bump(stats, "deadline_breaches")
+            agreed = _verdict_barrier(
+                verdict, breached, cfg.budget_s, label
+            )
+            _bump(stats, "breach_barriers")
+            sp.set(local_breach=breached, agreed_breach=agreed)
         if not agreed:
             return value
         _bump(stats, "replicated_aborts")
@@ -318,6 +337,9 @@ def replicated_dispatch_with_retry(
                 "replicated abort%s: agreed breach window %d/%d — retry "
                 "schedule exhausted, every rank degrades together",
                 f" [{label}]" if label else "", k + 1, cfg.retries + 1,
+            )
+            _flight_exhausted(
+                "replicated_degradation", stats, label, cfg.retries + 1
             )
             raise DispatchTimeout(
                 f"device dispatch{f' [{label}]' if label else ''} "

@@ -249,7 +249,16 @@ def fault_point(site: str) -> None:
     if spec.action == "crash":
         # The uncatchable death: no atexit, no finally, no flush beyond
         # this marker — exactly what preemption looks like to the files
-        # on disk.
+        # on disk.  The flight recorder still dumps FIRST (deliberately:
+        # real preemption on managed pods delivers SIGTERM before
+        # SIGKILL, and the dump is the post-mortem that grace window
+        # exists for); its incident hooks also force a final heartbeat
+        # line, so the injected-crash tests can assert both artifacts.
+        from ..telemetry import flight as _tflight
+
+        _tflight.flight_dump(
+            "injected_crash", extra={"site": site, "hit": hit}
+        )
         print(
             f"[sbg-fault] crash at {site} (hit {hit})",
             flush=True,

@@ -47,6 +47,8 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 from .checkpoint import clean_stale_tmp, durable_write_text
 from .faults import fault_point
 
@@ -259,10 +261,19 @@ class SearchJournal:
         if self.readonly:
             return rec
         line = json.dumps(rec, sort_keys=True)
-        with open(self._path, "a", encoding="utf-8") as f:
-            f.write(line + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        # Journal appends are trace spans (cat "journal"): the fsync is
+        # real wall time on the driver's critical path, and the append
+        # sequence is the backbone a flight-recorder dump correlates
+        # dispatch activity against.
+        with _ttrace.span(f"journal[{rtype}]", "journal",
+                          seq=rec["seq"], dir=self.directory):
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        # Process-global tally (the journal has no ctx): heartbeat lines
+        # and metrics.json surface it under "process".
+        _tmetrics.GLOBAL.inc("journal_appends")
         self.records.append(rec)
         self._unsnapshotted += 1
         if (

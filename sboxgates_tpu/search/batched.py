@@ -38,11 +38,15 @@ serial loop's early exits can be cheaper.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 
 from ..core import ttable as tt
 from ..graph.state import NO_GATE, State
@@ -99,7 +103,12 @@ class Rendezvous:
         self.spawned = 0
         self.waiting: List[dict] = []
         self._vmapped = vmap_cache if vmap_cache is not None else _VMAP_CACHE
-        self.stats = {"submits": 0, "dispatches": 0, "batched_rows": 0}
+        # Private rendezvous counters (atomic facade, not the declared
+        # ctx schema): folded into ctx.stats by the drivers.
+        self.stats = _tmetrics.MetricsRegistry(
+            {"submits": 0, "dispatches": 0, "batched_rows": 0},
+            declared=None,
+        )
 
     def submit(self, key, kernel: Callable, args, shared=(), g=None) -> np.ndarray:
         """``shared``: indices of args that are identical across restarts
@@ -112,7 +121,7 @@ class Rendezvous:
             "shared": tuple(shared), "done": False, "g": g,
         }
         with self.cv:
-            self.stats["submits"] += 1
+            self.stats.inc("submits")
             self.waiting.append(entry)
             if len(self.waiting) == self.live:
                 self._flush()
@@ -179,7 +188,7 @@ class Rendezvous:
             except BaseException as exc:
                 for e in entries:
                     e["error"] = exc
-            self.stats["dispatches"] += 1
+            self.stats.inc("dispatches")
             for e in entries:
                 e["done"] = True
         self.cv.notify_all()
@@ -188,7 +197,13 @@ class Rendezvous:
         n = len(entries)
         if n == 1:
             e = entries[0]
-            out = e["kernel"](*e["args"])
+            # "rendezvous" span, NOT "dispatch": base-rendezvous groups
+            # are not tallied in device_dispatches (the fleet rendezvous
+            # groups are), and the dispatch-span/counter reconciliation
+            # is exact by construction.
+            with _ttrace.span(f"rendezvous[{key[0]}]", "rendezvous",
+                              lanes=1):
+                out = e["kernel"](*e["args"])
             # Pytree outputs (the feasibility streams' (verdict, feas,
             # r1, r0)) stay device-resident; the consumer syncs only its
             # compact verdict element.
@@ -227,7 +242,9 @@ class Rendezvous:
             else jnp.stack([jnp.asarray(e["args"][i]) for e in rows])
             for i in range(nargs)
         ]
-        out = fn(*stacked)
+        with _ttrace.span(f"rendezvous[{key[0]}]", "rendezvous",
+                          lanes=bucket, merged=n):
+            out = fn(*stacked)
         if isinstance(out, tuple):
             # Per-lane device slices (lazy): big per-chunk arrays stay
             # resident, pulled only on a hit — same contract as the
@@ -238,7 +255,7 @@ class Rendezvous:
             out = np.asarray(out)
             for r, e in enumerate(entries):
                 e["result"] = out[r]
-        self.stats["batched_rows"] += n
+        self.stats.inc("batched_rows", n)
 
 
 class RestartContext(SearchContext):
@@ -254,13 +271,17 @@ class RestartContext(SearchContext):
         self.__dict__.update(base.__dict__)
         self.rng = np.random.default_rng(seed)
         self._seed_buf = (np.empty(0, dtype=np.int64), 0)
-        self.stats = dict.fromkeys(base.stats, 0)
+        # Per-view registry with the base's key set, zeroed (fork);
+        # folded back atomically by merge_stats_into.
+        self.stats = base.stats.fork()
         self.rdv = rdv
 
     def merge_stats_into(self, base: SearchContext, lock) -> None:
-        with lock:
-            for k, v in self.stats.items():
-                base.stats[k] = base.stats.get(k, 0) + v
+        # The registry merge is atomic on the base's internal lock;
+        # ``lock`` (the rendezvous cv) is no longer needed for counter
+        # integrity and is kept only for call-site compatibility.
+        del lock
+        base.stats.merge(self.stats)
 
 
 def run_mux_jobs(ctx: SearchContext, jobs: List[Callable]) -> List:
@@ -366,14 +387,15 @@ def run_batched_circuits(
         results = []
         for i, (nst, target, mask) in enumerate(jobs):
             rctx = RestartContext(ctx, seeds[i], Rendezvous(1))
+            t0 = time.perf_counter()
             out = create_circuit(rctx, nst, target, mask, [])
+            rctx.observe_job(
+                f"restart-{i}", t0, time.perf_counter(), out != NO_GATE
+            )
             rctx.merge_stats_into(ctx, rdv.cv)
             results.append((nst, out))
-        ctx.stats["restart_batch_dispatches"] = (
-            ctx.stats.get("restart_batch_dispatches", 0) + 0
-        )
-        ctx.stats["restart_batch_submits"] = (
-            ctx.stats.get("restart_batch_submits", 0) + 0
+        ctx.stats.ensure(
+            "restart_batch_dispatches", "restart_batch_submits"
         )
         return results
     results: List[Optional[tuple]] = [None] * n
@@ -383,7 +405,11 @@ def run_batched_circuits(
         try:
             rctx = RestartContext(ctx, seeds[i], rdv)
             nst, target, mask = jobs[i]
+            t0 = time.perf_counter()
             out = create_circuit(rctx, nst, target, mask, [])
+            rctx.observe_job(
+                f"restart-{i}", t0, time.perf_counter(), out != NO_GATE
+            )
             results[i] = (nst, out)
             rctx.merge_stats_into(ctx, rdv.cv)
         except BaseException as e:  # surfaced after join
@@ -401,12 +427,8 @@ def run_batched_circuits(
         t.join()
     if errors:
         raise errors[0]
-    ctx.stats["restart_batch_dispatches"] = (
-        ctx.stats.get("restart_batch_dispatches", 0) + rdv.stats["dispatches"]
-    )
-    ctx.stats["restart_batch_submits"] = (
-        ctx.stats.get("restart_batch_submits", 0) + rdv.stats["submits"]
-    )
+    ctx.stats.inc("restart_batch_dispatches", rdv.stats["dispatches"])
+    ctx.stats.inc("restart_batch_submits", rdv.stats["submits"])
     return results
 
 

@@ -23,6 +23,9 @@ from ..graph.state import GATES, State
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience import deadline as _deadline
+from ..telemetry import flight as _tflight
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 from ..utils import guards as _guards
 from ..utils.profile import PhaseProfiler
 from . import warmup as _warmup
@@ -218,6 +221,13 @@ class Options:
     # this SHAPES THE DRAW STREAM: it is journaled and restored by
     # --resume-run, like the other execution-mode flags.
     fleet_max_wave: int = 256
+    # Structured tracing (--trace, telemetry.trace): every dispatch,
+    # compile, warmup build, rendezvous merge, deadline window, and
+    # journal write becomes a span in the process tracer, exportable as
+    # a Perfetto trace.json.  Purely observational — spans time
+    # host-side events only (zero extra device syncs) and results are
+    # identical on or off.
+    trace: bool = False
 
 
 @dataclass(frozen=True)
@@ -387,51 +397,23 @@ class SearchContext:
             from .batched import Rendezvous  # deferred: import cycle
 
             self.rdv = Rendezvous(1)
-        # Sweep statistics (candidates examined), for benchmarking.
-        self.stats = {
-            "pair_candidates": 0,
-            "triple_candidates": 0,
-            "lut3_candidates": 0,
-            "lut5_candidates": 0,
-            "lut5_solved": 0,
-            "lut7_candidates": 0,
-            "lut7_solved": 0,
-            # pallas->xla fallbacks taken by the sharded pivot stream
-            # (mesh.py routes the once-per-call stderr signal here too
-            # so long runs can report it in the -vv summary).
-            "pivot_pallas_fallbacks": 0,
-            # Hung-dispatch deadline guard activity (resilience.deadline):
-            # reported by bench.py --host-stream next to the sync/compile
-            # guard counters.
-            "dispatch_retries": 0,
-            "deadline_breaches": 0,
-            # Replicated degradation protocol (process-spanning meshes):
-            # verdict-barrier rounds joined, windows abandoned on an
-            # agreed breach, and retry schedules exhausted on this rank
-            # (the lockstep host-fallback degradations).  All zero on
-            # single-host / non-spanning runs — the protocol takes no
-            # barrier round trips there (tests/test_deadline.py).
-            "breach_barriers": 0,
-            "replicated_aborts": 0,
-            "degraded_ranks": 0,
-            # Every device dispatch, whichever path issues it: direct
-            # registry calls (kernel_call) and rendezvous/fleet groups.
-            # The fleet bench's O(N)->O(1) dispatch-count claim reads
-            # this.
-            "device_dispatches": 0,
-            # Compile-latency subsystem (search/warmup.py): lazy jit
-            # compiles taken on the dispatch path (with their stall time)
-            # and warm-cache consults; per-kernel compile stalls land as
-            # ``compile[<kernel>]`` profiler rows.
-            "kernel_compiles": 0,
-            "compile_stall_s": 0.0,
-            "warm_hits": 0,
-            "warm_misses": 0,
-            # Device-resident table cache: uploads actually performed vs
-            # dispatches served from the memoized placed buffer.
-            "table_uploads": 0,
-            "table_cache_hits": 0,
-        }
+        # Sweep statistics and engine telemetry: a thread-safe metrics
+        # REGISTRY (telemetry.metrics.MetricsRegistry), not a raw dict.
+        # It reads like the dict it replaced (Mapping protocol, so bench
+        # / tests / the -vv report are untouched), but every mutation
+        # rides an atomic facade call (inc/put/observe/merge) — no
+        # unlocked read-modify-write can lose an update when mux threads
+        # race, and jaxlint R6 flags any direct dict poke that would
+        # reintroduce one.  The declared counter/histogram schema lives
+        # in telemetry.metrics.METRICS (per-counter docs there); the
+        # seed keys — zero-initialized so reports list them before first
+        # increment — are CONTEXT_COUNTERS.
+        self.stats = _tmetrics.context_registry()
+        # --trace: flip the process tracer on for this run (spans from
+        # every engine layer land in one buffer set; the CLI exports
+        # them at exit).
+        if opt.trace:
+            _ttrace.tracer().enabled = True
         # Device-resident live-table cache (device_tables): placed
         # [bucket, 8] buffers memoized on content digest.  Shared BY
         # REFERENCE (dict + lock) with every RestartContext view, so
@@ -650,13 +632,29 @@ class SearchContext:
         replicated protocol raises the final DispatchTimeout on every
         rank in the same agreed window, so this demotion is itself
         lockstep — no rank keeps dispatching to a pod the others have
-        written off."""
+        written off.
+
+        The trip is a flight-recorder incident: a run that wrote off its
+        device mid-flight leaves a post-mortem dump (recent dispatch /
+        deadline spans + counter snapshot) next to its journal, instead
+        of only a log line nobody was watching."""
+        demoted = self.mesh_plan is not None and self.mesh_plan.spans_processes
         self.device_degraded = True
-        if self.mesh_plan is not None and self.mesh_plan.spans_processes:
+        self.stats.inc("circuit_breaker_trips")
+        _ttrace.instant(
+            "circuit_breaker.trip", "deadline", demoted_mesh=demoted
+        )
+        if demoted:
             self.mesh_plan = None
             self._binom = None
             self._pair_combo_cache.clear()
             self.invalidate_device_tables()
+        path = _tflight.flight_dump(
+            "circuit_breaker", registry=self.stats,
+            extra={"demoted_mesh": demoted},
+        )
+        if path is not None:
+            self.stats.inc("flight_dumps")
 
     def next_seed(self) -> int:
         """Per-dispatch kernel seed.  Negative when not randomizing: the
@@ -701,7 +699,7 @@ class SearchContext:
             hit = self._table_cache.get(key)
             if hit is not None:
                 self._table_cache.move_to_end(key)
-                self.stats["table_cache_hits"] += 1
+                self.stats.inc("table_cache_hits")
                 return hit
         padded = np.zeros((b, 8), dtype=np.uint32)
         padded[:g] = live
@@ -710,7 +708,7 @@ class SearchContext:
             # A concurrent mux branch may have uploaded the same key while
             # we placed; last write wins — both buffers hold identical
             # bytes, so either is correct.
-            self.stats["table_uploads"] += 1
+            self.stats.inc("table_uploads")
             self._table_cache[key] = placed
             while len(self._table_cache) > self.TABLE_CACHE_SLOTS:
                 self._table_cache.popitem(last=False)
@@ -771,7 +769,7 @@ class SearchContext:
             for i, live in enumerate(rows):
                 if live is not None:
                     stacked[i, : live.shape[0]] = live
-            self.stats["table_uploads"] += 1
+            self.stats.inc("table_uploads")
             if self.fleet_plan is not None:
                 return self.fleet_plan.shard_jobs(stacked)
             return jnp.asarray(stacked)
@@ -779,7 +777,7 @@ class SearchContext:
         before = self.fleet_stack.hits
         out = self.fleet_stack.get_or_put(key, build)
         if self.fleet_stack.hits > before:
-            self.stats["table_cache_hits"] += 1
+            self.stats.inc("table_cache_hits")
         return out
 
     def kernel_call(self, name: str, statics: dict, args: tuple, g=None):
@@ -794,19 +792,38 @@ class SearchContext:
         ``Compiled`` executable directly — zero tracing, zero compiles; a
         miss takes the ordinary lazy jit path, with the compile stall (if
         one happened) recorded in ``ctx.stats`` and as a
-        ``compile[<kernel>]`` profiler row."""
-        self.stats["device_dispatches"] += 1
+        ``compile[<kernel>]`` profiler row.
+
+        Every call is one ``dispatch`` span (kernel name, gate count,
+        warm hit vs compile) — the span count reconciles exactly with
+        the ``device_dispatches`` counter, which is bumped here and
+        nowhere else on the per-thread path."""
+        self.stats.inc("device_dispatches")
+        with _ttrace.span(f"dispatch[{name}]", "dispatch",
+                          kernel=name, g=g) as sp:
+            out = self._kernel_call_traced(name, statics, args, g, sp)
+        return out
+
+    def _kernel_call_traced(self, name, statics, args, g, sp):
         warmer = self.warmer
+        t_issue = time.perf_counter()
         if warmer is not None:
             warmer.note_gates(g)
             compiled = warmer.lookup(name, statics, args)
             if _warmup.KERNELS[name].warmable:
-                self.stats[
+                warm = "hit" if compiled is not None else "miss"
+                self.stats.inc(
                     "warm_hits" if compiled is not None else "warm_misses"
-                ] += 1
+                )
+                sp.set(warm=warm)
             if compiled is not None:
                 try:
-                    return compiled(*args)
+                    out = compiled(*args)
+                    self.stats.observe(
+                        f"dispatch_latency_s[{name}]",
+                        time.perf_counter() - t_issue,
+                    )
+                    return out
                 except (TypeError, ValueError) as e:
                     # Aval drift between the warm spec and the live call
                     # site raises TypeError; a sharding mismatch from
@@ -826,16 +843,44 @@ class SearchContext:
         before = _guards.jit_cache_size(_warmup.KERNELS[name].fn)
         t0 = time.perf_counter()
         out = fn(*args)
+        t1 = time.perf_counter()
         if before is not None and (
             _guards.jit_cache_size(_warmup.KERNELS[name].fn) or 0
         ) > before:
             # The call traced + compiled a new executable: the elapsed
             # wall time is compile stall (execution is async-dispatched).
-            dt = time.perf_counter() - t0
-            self.stats["kernel_compiles"] += 1
-            self.stats["compile_stall_s"] += dt
+            dt = t1 - t0
+            self.stats.inc("kernel_compiles")
+            self.stats.inc("compile_stall_s", dt)
             self.prof.add(f"compile[{name}]", dt)
+            sp.set(compiled_lazily=True)
+            _ttrace.tracer().record(
+                f"compile[{name}]", "compile", t0, t1, {"kernel": name}
+            )
+        # Host-side issue latency (async dispatch: this is queue/trace
+        # cost, not device time — device time shows up in device_wait_s).
+        self.stats.observe(f"dispatch_latency_s[{name}]", t1 - t_issue)
         return out
+
+    def observe_job(
+        self, name: str, t0: float, t1: float, found: bool
+    ) -> None:
+        """Per-job telemetry: one ``job`` span plus the
+        ``job_seconds`` / ``job_time_to_first_hit_s`` histograms — the
+        latency distribution the serve-mode roadmap item measures
+        (jobs/hour and p99 time-to-first-hit under concurrent load).
+        ``found`` gates the ttfh observation: a job that found no
+        circuit had no first hit.  Called by every job driver (serial
+        loop, batched restarts, fleet waves) on the job's own context
+        view, so concurrent jobs never contend beyond the registry
+        lock."""
+        dt = t1 - t0
+        self.stats.observe("job_seconds", dt)
+        if found:
+            self.stats.observe("job_time_to_first_hit_s", dt)
+        _ttrace.tracer().record(
+            f"job[{name}]", "job", t0, t1, {"found": found}
+        )
 
     def warmup_stats(self) -> dict:
         """Warmer-side telemetry (compiled/failed/in-flight counts) for
@@ -910,7 +955,13 @@ class SearchContext:
             return np.asarray(value)
         t0 = time.perf_counter()
         out = np.asarray(value)
-        self.prof.add_wait(phase, t0, time.perf_counter(), consumer=consumer)
+        t1 = time.perf_counter()
+        self.prof.add_wait(phase, t0, t1, consumer=consumer)
+        # Dispatch-latency histogram: the blocked span IS the measured
+        # device+link latency of the resolve — recorded per phase family
+        # (telemetry.metrics), no extra sync beyond the one being timed.
+        self.stats.observe("device_wait_s", t1 - t0)
+        self.stats.observe(f"device_wait_s[{phase}]", t1 - t0)
         return out
 
     def _pair_combos_np(self, bucket: int) -> np.ndarray:
@@ -1432,9 +1483,9 @@ class SearchContext:
             )
         step = int(v[0])
         if step == 0 or step >= 3:
-            self.stats["pair_candidates"] += g * (g - 1) // 2
+            self.stats.inc("pair_candidates", g * (g - 1) // 2)
         if has_triple and step in (0, 5):
-            self.stats["triple_candidates"] += int(v[3])
+            self.stats.inc("triple_candidates", int(v[3]))
         return step, int(v[1]), int(v[2])
 
     def gate_step(self, st: State, target, mask):
@@ -1484,9 +1535,9 @@ class SearchContext:
             )
         step = int(v[0])
         if step == 0 or step >= 3:
-            self.stats["pair_candidates"] += g * (g - 1) // 2
+            self.stats.inc("pair_candidates", g * (g - 1) // 2)
         if has_triple and step in (0, 5):
-            self.stats["triple_candidates"] += int(v[3])
+            self.stats.inc("triple_candidates", int(v[3]))
         return step, int(v[1]), int(v[2])
 
     def _lut_step_native(self, st: State, target, mask, inbits) -> np.ndarray:
@@ -1525,9 +1576,9 @@ class SearchContext:
             )
         step = int(v[0])
         if step == 0 or step >= 3:
-            self.stats["pair_candidates"] += g * (g - 1) // 2
-        self.stats["lut3_candidates"] += int(v[6])
-        self.stats["lut5_candidates"] += int(v[7])
+            self.stats.inc("pair_candidates", g * (g - 1) // 2)
+        self.stats.inc("lut3_candidates", int(v[6]))
+        self.stats.inc("lut5_candidates", int(v[7]))
         return v
 
     def lut_step(self, st: State, target, mask, inbits) -> np.ndarray:
@@ -1586,9 +1637,9 @@ class SearchContext:
             )
         step = int(v[0])
         if step == 0 or step >= 3:
-            self.stats["pair_candidates"] += g * (g - 1) // 2
-        self.stats["lut3_candidates"] += int(v[6])
-        self.stats["lut5_candidates"] += int(v[7])
+            self.stats.inc("pair_candidates", g * (g - 1) // 2)
+        self.stats.inc("lut3_candidates", int(v[6]))
+        self.stats.inc("lut5_candidates", int(v[7]))
         return v
 
     def _lut7_tabs(self):
@@ -1669,8 +1720,8 @@ class SearchContext:
             v[5] = min(nfeas, solve7)
             v[6:10] = sr1[best_t].view(np.int32)
             v[10:14] = sr0[best_t].view(np.int32)
-        self.stats["lut7_candidates"] += int(v[4])
-        self.stats["lut7_solved"] += int(v[5])
+        self.stats.inc("lut7_candidates", int(v[4]))
+        self.stats.inc("lut7_solved", int(v[5]))
         return v
 
     def lut7_step(self, st: State, target, mask, inbits) -> np.ndarray:
@@ -1708,8 +1759,8 @@ class SearchContext:
                 shared=(1, 7, 8),
                 g=g,
             )
-        self.stats["lut7_candidates"] += int(v[4])
-        self.stats["lut7_solved"] += int(v[5])
+        self.stats.inc("lut7_candidates", int(v[4]))
+        self.stats.inc("lut7_solved", int(v[5]))
         return v
 
     def decode_pair_hit(self, st: State, index: int, slot: int, use_not: bool):
@@ -1739,7 +1790,7 @@ class SearchContext:
         b = self.table_bucket(st)
         combos = self._pair_combos(b)
         valid = (combos < g).all(axis=1)
-        self.stats["pair_candidates"] += g * (g - 1) // 2
+        self.stats.inc("pair_candidates", g * (g - 1) // 2)
         with self.prof.phase("pair_sweep"):
             v = self._dispatch(
                 "tuple_match_sweep",
@@ -1790,7 +1841,7 @@ class SearchContext:
                 ),
                 g=g,
             )
-        self.stats["triple_candidates"] += int(v[3])
+        self.stats.inc("triple_candidates", int(v[3]))
         if not bool(v[0]):
             return False, None, None
         row = comb.unrank_combination(int(v[1]), g, 3)

@@ -56,11 +56,13 @@ rendezvous, see README "Fleet-batched search".
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry import trace as _ttrace
 from . import warmup as _warmup
 from .batched import Rendezvous
 
@@ -188,24 +190,29 @@ class FleetRendezvous(Rendezvous):
         super().__init__(n_threads)
         self.plan = plan
         self.warmer = warmer
-        self.stats.update(
-            fleet_dispatches=0,
-            fleet_singletons=0,
-            fleet_stacked_dispatches=0,
-            fleet_warm_hits=0,
-            fleet_warm_misses=0,
-            fleet_lanes=0,
+        self.stats.ensure(
+            "fleet_dispatches",
+            "fleet_singletons",
+            "fleet_stacked_dispatches",
+            "fleet_warm_hits",
+            "fleet_warm_misses",
+            "fleet_lanes",
         )
 
     def _run_group(self, key, entries) -> None:
         n = len(entries)
         if n == 1:
             e = entries[0]
-            out = e["kernel"](*e["args"])
+            # Fleet singletons ARE device dispatches (fleet_stats_into
+            # folds them into device_dispatches), so the span category
+            # is "dispatch" — span count and counter stay reconciled.
+            with _ttrace.span(f"fleet[{key[0]}]", "dispatch",
+                              lanes=1, g=e.get("g")):
+                out = e["kernel"](*e["args"])
             e["result"] = (
                 out if isinstance(out, tuple) else np.asarray(out)
             )
-            self.stats["fleet_singletons"] += 1
+            self.stats.inc("fleet_singletons")
             return
         name, statics = key[0], dict(key[1])
         shared = entries[0]["shared"]
@@ -264,22 +271,30 @@ class FleetRendezvous(Rendezvous):
                 name, statics, shared, lanes, flat, mesh, stacked=stacked
             ))
         out = None
-        if compiled is not None:
-            try:
-                out = compiled(*flat)
-                self.stats["fleet_warm_hits"] += 1
-            except (TypeError, ValueError):
-                # Aval drift raises TypeError, a sharding mismatch from
-                # the AOT Compiled call raises ValueError; the lazy path
-                # below is always correct either way, and the parity
-                # test keeps this at zero.
-                self.warmer.count("warm_aval_mismatches")
-        if out is None:
-            fn = _warmup.fleet_kernel(
-                name, statics, shared, nargs, lanes, mesh, stacked=stacked
-            )
-            out = fn(*flat)
-            self.stats["fleet_warm_misses"] += 1
+        # One merged fleet group = one device dispatch = one "dispatch"
+        # span (the trace makes the O(N)->O(1) merging visible: N
+        # submits collapse into this span's `merged` lanes).
+        with _ttrace.span(f"fleet[{name}]", "dispatch", lanes=lanes,
+                          merged=n, stacked=stacked, g=gmax) as sp:
+            if compiled is not None:
+                try:
+                    out = compiled(*flat)
+                    self.stats.inc("fleet_warm_hits")
+                    sp.set(warm="hit")
+                except (TypeError, ValueError):
+                    # Aval drift raises TypeError, a sharding mismatch
+                    # from the AOT Compiled call raises ValueError; the
+                    # lazy path below is always correct either way, and
+                    # the parity test keeps this at zero.
+                    self.warmer.count("warm_aval_mismatches")
+            if out is None:
+                fn = _warmup.fleet_kernel(
+                    name, statics, shared, nargs, lanes, mesh,
+                    stacked=stacked,
+                )
+                out = fn(*flat)
+                self.stats.inc("fleet_warm_misses")
+                sp.set(warm="miss")
         if isinstance(out, tuple):
             # Pytree output: per-lane device slices (lazy; callers sync
             # their compact verdict element only).
@@ -289,11 +304,11 @@ class FleetRendezvous(Rendezvous):
             out = np.asarray(out)
             for r, e in enumerate(entries):
                 e["result"] = out[r]
-        self.stats["fleet_dispatches"] += 1
+        self.stats.inc("fleet_dispatches")
         if stacked:
-            self.stats["fleet_stacked_dispatches"] += 1
-        self.stats["fleet_lanes"] += lanes
-        self.stats["batched_rows"] += n
+            self.stats.inc("fleet_stacked_dispatches")
+        self.stats.inc("fleet_lanes", lanes)
+        self.stats.inc("batched_rows", n)
 
 
 def fleet_stats_into(ctx, rdv: FleetRendezvous) -> None:
@@ -302,19 +317,15 @@ def fleet_stats_into(ctx, rdv: FleetRendezvous) -> None:
         "fleet_dispatches", "fleet_singletons", "fleet_stacked_dispatches",
         "fleet_warm_hits", "fleet_warm_misses", "fleet_lanes",
     ):
-        ctx.stats[k] = ctx.stats.get(k, 0) + rdv.stats[k]
-    ctx.stats["fleet_submits"] = (
-        ctx.stats.get("fleet_submits", 0) + rdv.stats["submits"]
-    )
-    ctx.stats["fleet_rounds"] = (
-        ctx.stats.get("fleet_rounds", 0) + rdv.stats["dispatches"]
-    )
+        ctx.stats.inc(k, rdv.stats[k])
+    ctx.stats.inc("fleet_submits", rdv.stats["submits"])
+    ctx.stats.inc("fleet_rounds", rdv.stats["dispatches"])
     # Every dispatched leaf — a merged lane group (including each slice
     # of an over-wide group) or a singleton — was one device dispatch;
     # per-thread kernel_call dispatches count themselves.
-    ctx.stats["device_dispatches"] = (
-        ctx.stats.get("device_dispatches", 0)
-        + rdv.stats["fleet_dispatches"] + rdv.stats["fleet_singletons"]
+    ctx.stats.inc(
+        "device_dispatches",
+        rdv.stats["fleet_dispatches"] + rdv.stats["fleet_singletons"],
     )
 
 
@@ -338,6 +349,7 @@ def _run_fleet_wave(ctx, jobs: List[tuple]) -> List[tuple]:
     the wave size is capped — oversized lists must come through
     :func:`run_fleet_circuits` / :func:`run_fleet_waves`, which split
     them."""
+    from ..graph.state import NO_GATE
     from .kwan import create_circuit
     from .batched import RestartContext
 
@@ -359,7 +371,11 @@ def _run_fleet_wave(ctx, jobs: List[tuple]) -> List[tuple]:
         try:
             rctx = RestartContext(ctx, seeds[i], rdv)
             nst, target, mask = jobs[i]
+            t0 = time.perf_counter()
             out = create_circuit(rctx, nst, target, mask, [])
+            rctx.observe_job(
+                f"fleet-{i}", t0, time.perf_counter(), out != NO_GATE
+            )
             results[i] = (nst, out)
             rctx.merge_stats_into(ctx, rdv.cv)
         except BaseException as e:  # surfaced after join
@@ -428,30 +444,33 @@ def _stacked_dispatch(ctx, name, statics, operands, lanes, g=None):
     — executable.  Returns the kernel's raw (stacked) output pytree."""
     shared = _warmup.FLEET_SHARED[name]
     mesh = None if ctx.fleet_plan is None else ctx.fleet_plan.mesh
-    ctx.stats["device_dispatches"] = (
-        ctx.stats.get("device_dispatches", 0) + 1
-    )
+    ctx.stats.inc("device_dispatches")
     warmer = ctx.warmer
-    if warmer is not None:
-        warmer.note_fleet(g, lanes, stacked=True)
-        compiled = warmer.lookup_key(_warmup.fleet_warm_key(
-            name, statics, shared, lanes, operands, mesh, stacked=True
-        ))
-        if compiled is not None:
-            try:
-                out = compiled(*operands)
-                ctx.stats["warm_hits"] = ctx.stats.get("warm_hits", 0) + 1
-                return out
-            except (TypeError, ValueError):
-                # Aval drift (TypeError) or an AOT sharding mismatch
-                # (ValueError): the lazy path below is always correct.
-                warmer.count("warm_aval_mismatches")
-        else:
-            ctx.stats["warm_misses"] = ctx.stats.get("warm_misses", 0) + 1
-    fn = _warmup.fleet_kernel(
-        name, statics, shared, len(operands), lanes, mesh, stacked=True
-    )
-    return fn(*operands)
+    with _ttrace.span(f"stacked[{name}]", "dispatch", lanes=lanes,
+                      g=g, stacked=True) as sp:
+        if warmer is not None:
+            warmer.note_fleet(g, lanes, stacked=True)
+            compiled = warmer.lookup_key(_warmup.fleet_warm_key(
+                name, statics, shared, lanes, operands, mesh, stacked=True
+            ))
+            if compiled is not None:
+                try:
+                    out = compiled(*operands)
+                    ctx.stats.inc("warm_hits")
+                    sp.set(warm="hit")
+                    return out
+                except (TypeError, ValueError):
+                    # Aval drift (TypeError) or an AOT sharding mismatch
+                    # (ValueError): the lazy path below is always
+                    # correct.
+                    warmer.count("warm_aval_mismatches")
+            else:
+                ctx.stats.inc("warm_misses")
+                sp.set(warm="miss")
+        fn = _warmup.fleet_kernel(
+            name, statics, shared, len(operands), lanes, mesh, stacked=True
+        )
+        return fn(*operands)
 
 
 def _stacked_frame(ctx, jobs, done):

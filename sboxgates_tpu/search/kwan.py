@@ -73,7 +73,7 @@ def _create_circuit(
             return ret
     # Node driven by the Python engine (vs stats["engine_nodes"]): the
     # two counters give the engine-active node fraction of a run.
-    ctx.stats["python_nodes"] = ctx.stats.get("python_nodes", 0) + 1
+    ctx.stats.inc("python_nodes")
     ctx.heartbeat(st)
 
     # Steps 1-4 in ONE fused device dispatch; budget gates are applied
@@ -306,10 +306,8 @@ def _engine_replay(ctx, st: State, target, mask, out_gid, added, stats) -> int:
     budgets (exactly as the Python engine's can)."""
     for idx, key in _ENGINE_STATS.items():
         if int(stats[idx]):
-            ctx.stats[key] = ctx.stats.get(key, 0) + int(stats[idx])
-    ctx.stats["engine_nodes"] = (
-        ctx.stats.get("engine_nodes", 0) + int(stats[0])
-    )
+            ctx.stats.inc(key, int(stats[idx]))
+    ctx.stats.inc("engine_nodes", int(stats[0]))
     if out_gid == NO_GATE:
         return NO_GATE
     for row in added:
@@ -405,8 +403,7 @@ def _native_lut_engine_search(
             mux_threads=mux_threads,
         )
     if added is None:  # BAILED: the device-work service failed
-        ctx.stats.clear()
-        ctx.stats.update(stats_snapshot)
+        ctx.stats.restore(stats_snapshot)
         return None
     return _engine_replay(ctx, st, target, mask, out_gid, added, stats)
 

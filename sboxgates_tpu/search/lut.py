@@ -115,7 +115,7 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
             )),
             "lut3.stream",
         )
-        ctx.stats["lut3_candidates"] += int(v[4])
+        ctx.stats.inc("lut3_candidates", int(v[4]))
         if not v[0]:
             return NO_GATE
         return _add_lut3_result(
@@ -125,7 +125,7 @@ def lut3_search(ctx: SearchContext, st: State, target, mask, inbits) -> int:
     found, cstart, feas, r1, r0, examined, _ = ctx.feasible_stream_driver(
         st, target, mask, [], k=3
     )
-    ctx.stats["lut3_candidates"] += examined
+    ctx.stats.inc("lut3_candidates", examined)
     if not found:
         return NO_GATE
     feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
@@ -196,7 +196,7 @@ def _solve_lut5_rows(
         # in every cell and can never be selected
         p1, _ = comb.pad_rows(req1[lo:hi], scs, fill=0xFFFFFFFF)
         p0, _ = comb.pad_rows(req0[lo:hi], scs, fill=0xFFFFFFFF)
-        ctx.stats["lut5_solved"] += hi - lo
+        ctx.stats.inc("lut5_solved", hi - lo)
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the solve verdict decides whether to stop this block
@@ -545,11 +545,11 @@ def _lut5_search_pivot(
                 _pivot_attempt, "lut5.pivot.sharded"
             )
             for k, n in local_stats.items():
-                ctx.stats[k] = ctx.stats.get(k, 0) + n
+                ctx.stats.inc(k, n)
             next_t = int(verdicts[0, 9])
-            ctx.stats["lut5_candidates"] += int(
+            ctx.stats.inc("lut5_candidates", int(
                 size_cum[min(next_t, t_real)] - size_cum[start_t]
-            )
+            ))
             hits = verdicts[verdicts[:, 0] != 0]
             for hv in hits[np.argsort(hits[:, 1])]:
                 if int(hv[0]) == 1:
@@ -595,9 +595,9 @@ def _lut5_search_pivot(
             "lut5.pivot",
         )
         status, next_t = int(v[0]), int(v[8])
-        ctx.stats["lut5_candidates"] += int(
+        ctx.stats.inc("lut5_candidates", int(
             size_cum[min(next_t, t_real)] - size_cum[start_t]
-        )
+        ))
         if status == 0:
             return None
         if status == 1:
@@ -692,7 +692,7 @@ def _lut5_search_device(
     solve_failed = False
     while resolve is not None:
         found, cstart, feas, r1, r0, examined, chunk = resolve()
-        ctx.stats["lut5_candidates"] += examined
+        ctx.stats.inc("lut5_candidates", examined)
         if not found:
             return None
         # Speculative resume: the next rank window's stream launches
@@ -742,7 +742,7 @@ def _lut5_stream_loop(
             "lut5.stream",
         )
         status, cstart = int(v[0]), int(v[6])
-        ctx.stats["lut5_candidates"] += int(v[7])
+        ctx.stats.inc("lut5_candidates", int(v[7]))
         if status == 0:
             return None
         if status == 1:
@@ -902,7 +902,7 @@ def _host_feasible_chunks(
             if not inflight:
                 return
             padded, nvalid, hit, feas, req1p, req0p = inflight.popleft()
-            ctx.stats[stat_key] += nvalid
+            ctx.stats.inc(stat_key, nvalid)
             # Deadline-only sync (host_sync_deadline): this driver IS the
             # degradation target, so a dead device must surface as a loud
             # DispatchTimeout here, never an eternal hang — and never a
@@ -998,7 +998,7 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
             logger.warning(
                 "%s; degrading 7-LUT stage A to the host-chunked driver", e
             )
-            ctx.stats["lut7_candidates"] = cand_before
+            ctx.stats.put("lut7_candidates", cand_before)
             ctx.trip_device_breaker()
             hit_combos, hit_req1, hit_req0, nhits = [], [], [], 0
             use_device_stream = False
@@ -1062,7 +1062,7 @@ def _lut7_device_stage_a(
     max_rows = None
     while resolve is not None and nhits < LUT7_CAP:
         found, cstart, feas, r1, r0, examined, chunk = resolve()
-        ctx.stats["lut7_candidates"] += examined
+        ctx.stats.inc("lut7_candidates", examined)
         if not found:
             break
         # Keep the device busy during the host-side fetch + unrank of
@@ -1139,7 +1139,7 @@ def _lut7_solve_hits(
         size = next(s for s in LUT7_SOLVE_SIZES if s >= hi - lo)
         r1, _ = comb.pad_rows(req1[lo:hi], size, fill=0xFFFFFFFF)
         r0, _ = comb.pad_rows(req0[lo:hi], size, fill=0xFFFFFFFF)
-        ctx.stats["lut7_solved"] += hi - lo
+        ctx.stats.inc("lut7_solved", hi - lo)
         seed = ctx.next_seed()
         v = ctx.host_sync_deadline(
             # jaxlint: ignore[R2] deliberate sync: the lut7 solve verdict gates the early return
@@ -1363,7 +1363,7 @@ def lut_search_from_head(
         # staged path re-counts the same candidate space AND re-solves the
         # fused dispatch's tuples; back out both tallies so stats stay
         # exact.
-        ctx.stats["lut7_candidates"] -= int(v[4])
-        ctx.stats["lut7_solved"] -= int(v[5])
+        ctx.stats.inc("lut7_candidates", -int(v[4]))
+        ctx.stats.inc("lut7_solved", -int(v[5]))
         return _lut7_phase(ctx, st, target, mask, inbits)
     return NO_GATE

@@ -34,6 +34,7 @@ the kept states are identical given identical per-job outcomes.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -127,8 +128,12 @@ def _run_jobs(
             out.extend(run_batched_circuits(ctx, jobs[lo : lo + MAX_WAVE_JOBS]))
         return out
     out = []
-    for nst, target, mask in jobs:
-        out.append((nst, create_circuit(ctx, nst, target, mask, [])))
+    for i, (nst, target, mask) in enumerate(jobs):
+        t0 = time.perf_counter()
+        res = create_circuit(ctx, nst, target, mask, [])
+        ctx.observe_job(f"serial-{i}", t0, time.perf_counter(),
+                        res != NO_GATE)
+        out.append((nst, res))
     return out
 
 

@@ -68,6 +68,8 @@ import numpy as np
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience.faults import fault_point
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -780,11 +782,11 @@ class KernelWarmer:
         self._thread: Optional[threading.Thread] = None
         # Worker-side telemetry; dispatch-side hit/miss tallies live in
         # ctx.stats (kernel_call) — ONE owner per counter, so the -vv
-        # report and the warmup: line can never disagree.
-        self.stats = {
-            "warm_compiled": 0,
-            "warm_failed": 0,
-        }
+        # report and the warmup: line can never disagree.  A private
+        # metrics registry (atomic inc; not the declared ctx schema).
+        self.stats = _tmetrics.MetricsRegistry(
+            {"warm_compiled": 0, "warm_failed": 0}, declared=None
+        )
 
     # -- main-thread API ---------------------------------------------------
 
@@ -930,11 +932,10 @@ class KernelWarmer:
             return _WARM_COMPILED.get(key)
 
     def count(self, key: str) -> None:
-        """Bumps one telemetry counter under the warmer lock (used by the
-        dispatchers for events the warmer itself cannot see, e.g. an aval
-        mismatch surfacing at call time)."""
-        with self._lock:
-            self.stats[key] = self.stats.get(key, 0) + 1
+        """Bumps one telemetry counter (used by the dispatchers for
+        events the warmer itself cannot see, e.g. an aval mismatch
+        surfacing at call time).  The registry increment is atomic."""
+        self.stats.inc(key)
 
     def stats_snapshot(self) -> dict:
         with self._lock:
@@ -1066,8 +1067,13 @@ class KernelWarmer:
                 fault_point("warmup.compile")
                 # .lower on the underlying jitted callable (registry fn,
                 # fleet wrapper, or sharded stream); statics ride as
-                # keywords exactly as the live call passes them.
-                compiled = lower_of()(*avals, **statics).compile()
+                # keywords exactly as the live call passes them.  One
+                # "warmup" span per AOT build: the exported trace shows
+                # the warmer's background activity against the critical
+                # path it keeps clear.
+                with _ttrace.span("warmup.compile", "warmup",
+                                  key=str(key[:2])):
+                    compiled = lower_of()(*avals, **statics).compile()
             except Exception as e:
                 # Any failure means "no warm entry": the dispatcher lazy-
                 # compiles exactly as without a warmer.  Never propagate —

@@ -1,0 +1,65 @@
+"""Unified telemetry: structured tracing, a metrics registry, and a
+crash flight recorder.
+
+The reference has no observability at all — verbosity-gated printf
+progress lines are its entire story (SURVEY §5; sboxgates.c:664-730).
+The TPU build outgrew its ad-hoc replacements (raw ``ctx.stats`` dict
+pokes, a ``-vv``-only phase profiler, per-PR bench JSON schemas); this
+package is the real telemetry layer they migrate onto:
+
+- :mod:`.trace` — structured spans with typed attributes, recorded
+  lock-free per thread and exportable as Chrome/Perfetto
+  ``trace.json`` (``--trace``).  Every device dispatch, compile,
+  warmup build, rendezvous merge, deadline window, and journal write
+  becomes a span, so a fleet run's overlap and stacked-dispatch
+  merging are *visible* instead of inferred from counters.
+- :mod:`.metrics` — named counters/gauges/histograms behind a
+  thread-safe registry facade (``MetricsRegistry``) that replaces the
+  raw ``ctx.stats`` dict (it still reads like a mapping, so existing
+  consumers keep working; mutation goes through atomic ``inc`` /
+  ``observe`` / ``merge`` — the lost-update class PR 4 fixed
+  point-wise in ``deadline.py`` is gone structurally, and jaxlint R6
+  keeps it gone).
+- :mod:`.heartbeat` — a periodic fsync'd ``telemetry.jsonl`` heartbeat
+  in ``--output-dir`` (rank-scoped under ``shard-NN/``, resume-aware
+  alongside the journal) plus an atomic end-of-run ``metrics.json``
+  snapshot that ``bench.py`` consumes.
+- :mod:`.flight` — a bounded in-memory ring of recent spans/events
+  that dumps automatically on ``DispatchTimeout`` exhaustion,
+  circuit-breaker trips, replicated degradation, fault-injection
+  crashes, and fatal exceptions, with ``dist``-aware rank tagging so
+  per-rank dumps from one incident correlate.
+
+Import discipline: this package imports NOTHING from the rest of
+``sboxgates_tpu`` (and never imports jax), so every engine layer —
+``resilience``, ``parallel``, ``utils`` included — can feed it without
+cycles, and the fault-injection fast path stays dict-lookup cheap.
+"""
+
+from .flight import FlightRecorder, flight_dump, flight_recorder
+from .heartbeat import Heartbeat
+from .metrics import (
+    CONTEXT_COUNTERS,
+    GLOBAL,
+    METRICS,
+    MetricsRegistry,
+    bump,
+)
+from .trace import Tracer, instant, set_rank, span, tracer
+
+__all__ = [
+    "CONTEXT_COUNTERS",
+    "FlightRecorder",
+    "GLOBAL",
+    "Heartbeat",
+    "METRICS",
+    "MetricsRegistry",
+    "Tracer",
+    "bump",
+    "flight_dump",
+    "flight_recorder",
+    "instant",
+    "set_rank",
+    "span",
+    "tracer",
+]

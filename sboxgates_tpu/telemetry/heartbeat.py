@@ -1,0 +1,162 @@
+"""Periodic fsync'd telemetry heartbeat + atomic end-of-run snapshot.
+
+A :class:`Heartbeat` appends one JSON line per period to
+``telemetry.jsonl`` in the run's telemetry directory (rank-scoped under
+``shard-NN/`` for multi-process runs, resume-aware: a resumed run
+appends to the prior run's file instead of truncating the evidence of
+the crash window).  Each line carries the registry's scalar counters,
+the process-global registry, uptime, and rank — a killed run's LAST
+line bounds when it died and what it had done, the same role the
+journal plays for search progress.
+
+``stop()`` writes a final line and the atomic ``metrics.json`` snapshot
+(full typed export, histograms included) that ``bench.py`` and the
+serve-mode measurements consume instead of bespoke accounting.
+
+The writer thread registers itself as a flight-recorder incident hook:
+a dump (deadline exhaustion, injected crash, fatal exception) forces an
+immediate out-of-band heartbeat line, so the incident's counter state
+is on disk even when the process dies before the next period.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from . import flight as _flight
+from .metrics import GLOBAL, MetricsRegistry
+
+JSONL_NAME = "telemetry.jsonl"
+SNAPSHOT_NAME = "metrics.json"
+#: metrics.json / telemetry.jsonl schema version.
+SCHEMA = 1
+
+
+class Heartbeat:
+    """Background heartbeat writer; see the module docstring."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        directory: str,
+        interval_s: float = 30.0,
+        rank: int = 0,
+        resume: bool = False,
+        run_config: Optional[dict] = None,
+    ):
+        self.registry = registry
+        self.directory = directory
+        self.interval_s = float(interval_s)
+        self.rank = int(rank)
+        self.run_config = run_config
+        self._seq = 0
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, JSONL_NAME)
+        if not resume:
+            # Fresh run owns the file; a resume appends after the crash
+            # tail so the incident window stays inspectable.
+            open(self.path, "w", encoding="utf-8").close()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Heartbeat":
+        if self.interval_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._work, name="sbg-heartbeat", daemon=True
+            )
+            self._thread.start()
+        _flight.flight_recorder().on_dump(self._on_incident)
+        self.emit(kind="start")
+        return self
+
+    def stop(self, snapshot: bool = True) -> Optional[str]:
+        """Final heartbeat line + (optionally) the atomic metrics.json
+        snapshot; returns the snapshot path.  Idempotent, and the
+        incident hook is unregistered FIRST — a flight dump after stop
+        (a later run in this process, a fatal handler racing teardown)
+        must not append incident lines past this run's final line."""
+        _flight.flight_recorder().remove_hook(self._on_incident)
+        already = self._stop.is_set()
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(self.interval_s + 5.0)
+        if not already:
+            self.emit(kind="final")
+        if not snapshot:
+            return None
+        return self.write_snapshot()
+
+    # -- emission ----------------------------------------------------------
+
+    def _line(self, kind: str) -> dict:
+        rec = {
+            "schema": SCHEMA,
+            "kind": kind,
+            "seq": self._seq,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "time_unix": time.time(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "counters": self.registry.scalars(),
+            "process": GLOBAL.scalars(),
+        }
+        if kind == "start" and self.run_config is not None:
+            rec["config"] = self.run_config
+        return rec
+
+    def emit(self, kind: str = "beat") -> None:
+        """Appends one fsync'd heartbeat line (thread-safe: the periodic
+        writer, incident hooks, and stop() all funnel here)."""
+        with self._lock:
+            rec = self._line(kind)
+            self._seq += 1
+            try:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                return
+        self.registry.inc("heartbeats")
+
+    def _on_incident(self, reason: str) -> None:
+        self.emit(kind=f"incident:{reason}")
+
+    def _work(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.emit()
+
+    # -- snapshot ----------------------------------------------------------
+
+    def write_snapshot(self) -> str:
+        """The atomic end-of-run ``metrics.json``: full typed registry
+        export + process globals, written temp-then-replace so readers
+        never see a torn file."""
+        payload = {
+            "schema": SCHEMA,
+            "rank": self.rank,
+            "time_unix": time.time(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "heartbeat_lines": self._seq,
+            "process": GLOBAL.scalars(),
+            **self.registry.snapshot(),
+        }
+        if self.run_config is not None:
+            payload["config"] = self.run_config
+        path = os.path.join(self.directory, SNAPSHOT_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path

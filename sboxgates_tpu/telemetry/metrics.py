@@ -1,0 +1,402 @@
+"""The metrics registry: named, typed counters/gauges/histograms behind
+a thread-safe facade.
+
+``SearchContext.stats`` is a :class:`MetricsRegistry`: it still READS
+like the dict it replaced (``Mapping`` protocol — subscripts, ``get``,
+``items``, ``dict(ctx.stats)`` all work, so the bench/tests/-vv report
+consumers are untouched), but mutation goes through atomic facade
+methods (``inc`` / ``put`` / ``observe`` / ``merge`` / ``restore``)
+under one internal lock — the unlocked read-modify-write that lost
+updates whenever two mux threads raced a counter (the class of bug PR 4
+fixed point-wise in ``deadline.py``) is gone structurally.  jaxlint R6
+flags any direct ``.stats[...]`` dict mutation outside this package so
+the class cannot creep back.
+
+Every counter a tier-1 run increments must be DECLARED in
+:data:`METRICS` (name, kind, unit) — the registry records undeclared
+names it sees, and the parity test (tests/test_telemetry.py) asserts
+the set stays empty, the same pattern as the kernel warm-registry
+parity test.  Histogram families use a bracketed suffix
+(``device_wait_s[lut5.stream]``): the base name is declared once and
+every member inherits the declaration.
+
+:data:`GLOBAL` is a process-wide registry for signals raised below any
+``SearchContext`` (the pallas→xla fallback tally, native service
+failures); heartbeat lines and the ``metrics.json`` snapshot fold it in
+under ``"process"`` so those degradations are visible in artifacts, not
+just on a terminal someone watched.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections.abc import MutableMapping
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    kind: str
+    unit: str
+    help: str
+
+
+#: The declared metric schema — ONE table for every counter the engine
+#: increments and every histogram it observes.  Keep it sorted by
+#: subsystem; the registry parity test enforces that nothing increments
+#: outside this table.
+METRICS: Dict[str, MetricDef] = {
+    # candidate counters (the reference-visible sweep totals)
+    "pair_candidates": MetricDef(COUNTER, "candidates", "2-input pairs swept"),
+    "triple_candidates": MetricDef(COUNTER, "candidates", "3-gate combos swept"),
+    "lut3_candidates": MetricDef(COUNTER, "candidates", "3-LUT tuples swept"),
+    "lut5_candidates": MetricDef(COUNTER, "candidates", "5-LUT tuples swept"),
+    "lut5_solved": MetricDef(COUNTER, "rows", "5-LUT decomposition solves"),
+    "lut7_candidates": MetricDef(COUNTER, "candidates", "7-LUT tuples swept"),
+    "lut7_solved": MetricDef(COUNTER, "rows", "7-LUT stage-B solve rows"),
+    # dispatch / compile-latency subsystem
+    "device_dispatches": MetricDef(
+        COUNTER, "dispatches",
+        "every device dispatch, whichever path issues it (kernel_call, "
+        "fleet rendezvous groups, stacked fleet steps)",
+    ),
+    "kernel_compiles": MetricDef(COUNTER, "compiles", "lazy jit compiles on the dispatch path"),
+    "compile_stall_s": MetricDef(COUNTER, "s", "wall time stalled in those compiles"),
+    "warm_hits": MetricDef(COUNTER, "lookups", "warmed-executable dispatches"),
+    "warm_misses": MetricDef(COUNTER, "lookups", "warmable dispatches that missed the warm cache"),
+    "table_uploads": MetricDef(COUNTER, "uploads", "live-table device uploads performed"),
+    "table_cache_hits": MetricDef(COUNTER, "hits", "dispatches served from the resident table cache"),
+    # resilience / deadline / replicated degradation
+    "dispatch_retries": MetricDef(COUNTER, "retries", "deadline-guard re-issues"),
+    "deadline_breaches": MetricDef(COUNTER, "breaches", "local deadline breaches"),
+    "breach_barriers": MetricDef(COUNTER, "rounds", "replicated verdict-barrier rounds joined"),
+    "replicated_aborts": MetricDef(COUNTER, "windows", "windows abandoned on an agreed breach"),
+    "degraded_ranks": MetricDef(COUNTER, "events", "retry schedules exhausted on this rank"),
+    "circuit_breaker_trips": MetricDef(COUNTER, "events", "device circuit-breaker flips"),
+    "flight_dumps": MetricDef(COUNTER, "dumps", "flight-recorder dumps written"),
+    "journal_appends": MetricDef(COUNTER, "records", "fsync'd journal records appended"),
+    # fallbacks (also mirrored into GLOBAL for ctx-less sites)
+    "pivot_pallas_fallbacks": MetricDef(
+        COUNTER, "dispatches", "sharded pivot pallas->xla fallbacks"
+    ),
+    # engine (native) activity
+    "engine_nodes": MetricDef(COUNTER, "nodes", "search nodes completed in the native engine"),
+    "python_nodes": MetricDef(COUNTER, "nodes", "search nodes completed by the Python recursion"),
+    "engine_devcalls": MetricDef(COUNTER, "calls", "device-work services for the native engine"),
+    # rendezvous / restart batching
+    "restart_batch_submits": MetricDef(COUNTER, "submits", "restart-batch rendezvous submits"),
+    "restart_batch_dispatches": MetricDef(COUNTER, "dispatches", "restart-batch merged dispatches"),
+    # fleet
+    "fleet_submits": MetricDef(COUNTER, "submits", "fleet rendezvous submits"),
+    "fleet_rounds": MetricDef(COUNTER, "rounds", "fleet rendezvous flush rounds"),
+    "fleet_dispatches": MetricDef(COUNTER, "dispatches", "merged fleet group dispatches"),
+    "fleet_singletons": MetricDef(COUNTER, "dispatches", "1-entry fleet groups (direct dispatch)"),
+    "fleet_stacked_dispatches": MetricDef(COUNTER, "dispatches", "stacked-ladder fleet dispatches"),
+    "fleet_warm_hits": MetricDef(COUNTER, "lookups", "fleet dispatches served warm"),
+    "fleet_warm_misses": MetricDef(COUNTER, "lookups", "fleet dispatches compiled lazily"),
+    "fleet_lanes": MetricDef(COUNTER, "lanes", "total fleet lanes dispatched"),
+    "batched_rows": MetricDef(COUNTER, "rows", "rendezvous-batched kernel rows"),
+    # heartbeat bookkeeping
+    "heartbeats": MetricDef(COUNTER, "lines", "telemetry.jsonl heartbeat lines written"),
+    # histograms (bracketed members inherit the base declaration)
+    "device_wait_s": MetricDef(
+        HISTOGRAM, "s",
+        "per-sync blocked time on a device verdict (per-phase members: "
+        "device_wait_s[<phase>])",
+    ),
+    "dispatch_latency_s": MetricDef(
+        HISTOGRAM, "s",
+        "host-side kernel dispatch issue latency (per-kernel members: "
+        "dispatch_latency_s[<kernel>])",
+    ),
+    "job_time_to_first_hit_s": MetricDef(
+        HISTOGRAM, "s",
+        "per-job wall time from job start to its first completed circuit",
+    ),
+    "job_seconds": MetricDef(HISTOGRAM, "s", "per-job total wall time"),
+}
+
+#: Log-spaced default histogram bounds: 100 µs .. ~17 min, covering a
+#: dispatch RTT through an hour-scale job.
+DEFAULT_BOUNDS: Tuple[float, ...] = tuple(
+    m * (10.0 ** e) for e in range(-4, 3) for m in (1.0, 3.0)
+)
+
+
+def base_name(name: str) -> str:
+    """``device_wait_s[lut5.stream]`` -> ``device_wait_s``: the declared
+    family a bracketed member belongs to."""
+    i = name.find("[")
+    return name if i < 0 else name[:i]
+
+
+class Histogram:
+    """Fixed-bound histogram: count/total/min/max plus per-bucket tallies
+    (bucket ``i`` counts observations <= ``bounds[i]``; the last bucket
+    is the overflow).  Mutated only under the owning registry's lock."""
+
+    __slots__ = ("bounds", "counts", "count", "total", "min", "max")
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_BOUNDS):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def merge(self, other: "Histogram") -> None:
+        assert self.bounds == other.bounds
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def snapshot(self) -> dict:
+        out = {
+            "count": self.count,
+            "total": self.total,
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+        }
+        if self.count:
+            out["min"] = self.min
+            out["max"] = self.max
+            out["mean"] = self.total / self.count
+        return out
+
+
+class MetricsRegistry(MutableMapping):
+    """Thread-safe named-metric store; the ``ctx.stats`` facade.
+
+    Mapping reads/iteration cover the SCALAR metrics (counters/gauges)
+    for drop-in compatibility with the dict this replaced; histograms
+    live alongside and export through :meth:`snapshot`.
+
+    ``declared=None`` disables undeclared-name tracking (private
+    registries: the rendezvous' own counters, the warmer's).
+    """
+
+    def __init__(
+        self,
+        initial: Optional[dict] = None,
+        declared: Optional[Dict[str, MetricDef]] = METRICS,
+    ):
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = dict(initial or {})
+        self._hists: Dict[str, Histogram] = {}
+        self._declared = declared
+        self._undeclared: set = set()
+        if declared is not None:
+            for k in self._values:
+                self._check(k)
+
+    # -- facade mutators ---------------------------------------------------
+
+    def _check(self, name: str) -> None:
+        if self._declared is not None and (
+            base_name(name) not in self._declared
+        ):
+            self._undeclared.add(name)
+
+    def inc(self, name: str, by: float = 1) -> None:
+        """Atomic counter increment (negative ``by`` backs a tally out,
+        e.g. the lut7 degradation recount)."""
+        with self._lock:
+            self._check(name)
+            self._values[name] = self._values.get(name, 0) + by
+
+    def put(self, name: str, value) -> None:
+        """Atomic gauge/counter set (resets, snapshot restores)."""
+        with self._lock:
+            self._check(name)
+            self._values[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """One histogram observation (family members share the base
+        declaration: ``observe('device_wait_s[lut5.stream]', dt)``)."""
+        with self._lock:
+            self._check(name)
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
+    def ensure(self, *names: str) -> None:
+        """Seeds zero-valued counters so reports list them before first
+        increment (the old dict literal's role)."""
+        with self._lock:
+            for n in names:
+                self._check(n)
+                self._values.setdefault(n, 0)
+
+    def merge(self, other: "MetricsRegistry") -> None:
+        """Folds another registry (a RestartContext view's) into this one
+        atomically — the facade replacement for the per-key dict loop."""
+        ovals, ohists = other._snapshot_parts()
+        with self._lock:
+            for k, v in ovals.items():
+                self._check(k)
+                self._values[k] = self._values.get(k, 0) + v
+            for k, h in ohists.items():
+                mine = self._hists.get(k)
+                if mine is None:
+                    self._hists[k] = h
+                else:
+                    mine.merge(h)
+
+    def restore(self, snapshot: dict) -> None:
+        """Resets the scalar metrics to ``snapshot`` (the engine bail
+        path's counter rollback; histograms are monotone and keep)."""
+        with self._lock:
+            self._values = dict(snapshot)
+
+    def fork(self) -> "MetricsRegistry":
+        """A zeroed registry with this one's key set — the per-view stats
+        of a RestartContext (merged back via :meth:`merge`)."""
+        with self._lock:
+            keys = list(self._values)
+        return MetricsRegistry(
+            dict.fromkeys(keys, 0), declared=self._declared
+        )
+
+    # -- reads -------------------------------------------------------------
+
+    def _snapshot_parts(self):
+        with self._lock:
+            vals = dict(self._values)
+            hists = {}
+            for k, h in self._hists.items():
+                c = Histogram(h.bounds)
+                c.merge(h)
+                hists[k] = c
+        return vals, hists
+
+    def scalars(self) -> dict:
+        """Plain-dict snapshot of the scalar metrics."""
+        with self._lock:
+            return dict(self._values)
+
+    def histograms(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: h.snapshot() for k, h in self._hists.items()}
+
+    def snapshot(self) -> dict:
+        """The full typed export (the ``metrics.json`` payload half)."""
+        vals, hists = self._snapshot_parts()
+        return {
+            "counters": vals,
+            "histograms": {k: h.snapshot() for k, h in hists.items()},
+        }
+
+    def undeclared(self) -> set:
+        """Names incremented without a :data:`METRICS` declaration — the
+        registry-parity test asserts this stays empty."""
+        with self._lock:
+            return set(self._undeclared)
+
+    # -- Mapping protocol (dict compatibility) -----------------------------
+
+    def __getitem__(self, key: str):
+        with self._lock:
+            return self._values[key]
+
+    def __setitem__(self, key: str, value) -> None:
+        # Kept for external consumers (tests seeding a counter); package
+        # code uses inc/put — jaxlint R6 enforces it.
+        self.put(key, value)
+
+    def __delitem__(self, key: str) -> None:
+        with self._lock:
+            del self._values[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.scalars())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._values)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._values
+
+    def __repr__(self) -> str:
+        return f"MetricsRegistry({self.scalars()!r})"
+
+
+#: Keys `SearchContext.__init__` seeds to zero — the dict literal it
+#: replaced, kept as data so context stays declarative.
+CONTEXT_COUNTERS: Tuple[str, ...] = (
+    "pair_candidates",
+    "triple_candidates",
+    "lut3_candidates",
+    "lut5_candidates",
+    "lut5_solved",
+    "lut7_candidates",
+    "lut7_solved",
+    "pivot_pallas_fallbacks",
+    "dispatch_retries",
+    "deadline_breaches",
+    "breach_barriers",
+    "replicated_aborts",
+    "degraded_ranks",
+    "device_dispatches",
+    "kernel_compiles",
+    "compile_stall_s",
+    "warm_hits",
+    "warm_misses",
+    "table_uploads",
+    "table_cache_hits",
+)
+
+
+def context_registry() -> MetricsRegistry:
+    """A fresh ``ctx.stats`` registry seeded with the context counters."""
+    return MetricsRegistry(dict.fromkeys(CONTEXT_COUNTERS, 0))
+
+
+#: Process-global registry for ctx-less signal sites (pallas fallbacks,
+#: native service failures); exported under "process" in heartbeat lines
+#: and metrics.json.
+GLOBAL = MetricsRegistry(declared=None)
+
+
+_DICT_LOCK = threading.Lock()
+
+
+def bump(stats, key: str, by: float = 1) -> None:
+    """Atomic increment on EITHER a :class:`MetricsRegistry` or a plain
+    dict (deadline/mesh helpers accept both: production passes the ctx
+    registry, tests and per-attempt scratch pass dicts).  ``None`` is a
+    no-op.  The dict path shares one module lock — same guarantee the
+    old per-module ``_stats_lock`` gave, in one place."""
+    if stats is None:
+        return
+    if isinstance(stats, MetricsRegistry):
+        stats.inc(key, by)
+        return
+    with _DICT_LOCK:
+        stats[key] = stats.get(key, 0) + by
+
+
+def merge_scalars(stats, updates: Iterable[Tuple[str, float]]) -> None:
+    """Folds many (key, delta) pairs into ``stats`` (registry or dict)
+    atomically per key."""
+    for k, v in updates:
+        bump(stats, k, v)

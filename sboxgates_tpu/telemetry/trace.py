@@ -1,0 +1,283 @@
+"""Structured tracing: typed spans, recorded lock-free per thread,
+exported as Chrome/Perfetto ``trace.json``.
+
+Span taxonomy (the ``cat`` field; README "Telemetry" has the table):
+
+==============  ==========================================================
+``dispatch``    one device dispatch — a registry ``kernel_call``, a merged
+                fleet rendezvous group, or a stacked fleet step; span
+                count reconciles exactly with the ``device_dispatches``
+                counter
+``rendezvous``  a base (mux) rendezvous group flush — dispatch merging
+                across concurrent branches (not counted as
+                ``device_dispatches``; the fleet groups are)
+``compile``     a lazy jit compile taken on the dispatch path
+``warmup``      one background AOT kernel build (KernelWarmer)
+``deadline``    a guarded dispatch window / breach / retry / verdict
+``journal``     one fsync'd journal append
+``phase``       a PhaseProfiler phase frame (``--trace`` only)
+``wait``        a consumer blocked on a device sync (overlap accounting)
+``produce``     a background producer's chunk-generation span
+``stall``       a consumer blocked on the prefetch queue
+``fallback``    a degradation signal (pallas→xla, native service failure)
+``job``         one fleet/multibox job (time-to-first-hit source)
+==============  ==========================================================
+
+Recording model: each thread appends finished spans to its own buffer
+(registered once under a lock, then append-only with no locking — list
+append is atomic in CPython), so tracing adds no cross-thread contention
+to the hot dispatch paths.  When tracing is DISABLED (the default), a
+span is two attribute checks plus an optional flight-ring append — no
+timestamps beyond the ones the caller already took, and never a host
+sync (spans time host-side events only).
+
+Rank awareness: ``set_rank`` (called from
+``parallel.distributed.initialize``) tags the exported trace's ``pid``
+with the process rank, so per-rank trace.json files from one pod run
+merge into a single timeline in Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import flight as _flightmod
+
+def set_rank(rank: Optional[int]) -> None:
+    """Pins this process's distributed rank for trace/dump tagging
+    (``None`` restores the environment fallback).  ONE rank store —
+    the flight recorder's — serves both the trace ``pid`` tag and the
+    dump names, so the two can never drift (``flight.configure`` with
+    a rank reaches the trace export too)."""
+    _flightmod.set_rank(rank)
+
+
+def process_rank() -> int:
+    """Rank used for trace/pid and dump tagging: explicit
+    :func:`set_rank` / ``flight.configure`` > ``JAX_PROCESS_ID`` > 0.
+    Never imports jax."""
+    return _flightmod.flight_recorder().rank()
+
+
+class _SpanHandle:
+    """Context manager for one in-flight span; ``set(key=value)`` adds
+    attributes discovered mid-span (warm hit vs compile, lane counts)."""
+
+    __slots__ = ("_tr", "name", "cat", "args", "_t0", "_flight")
+
+    def __init__(self, tr: "Tracer", name: str, cat: str, args, flt: bool):
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._flight = flt
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        if self.args is None:
+            self.args = attrs
+        else:
+            self.args.update(attrs)
+
+    def __enter__(self) -> "_SpanHandle":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tr.record(
+            self.name, self.cat, self._t0, time.perf_counter(),
+            self.args, flight=self._flight,
+        )
+        return False
+
+
+class _NullSpan:
+    """Shared no-op handle for the disabled-and-no-flight fast path."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class Tracer:
+    """Per-process span recorder; see the module docstring.
+
+    ``enabled`` gates the trace buffers only — the flight ring (crash
+    post-mortems) is fed by flight-worthy spans regardless, so a
+    production run without ``--trace`` still leaves a usable dump.
+    """
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        # Paired epoch: spans time with perf_counter (monotone, cheap),
+        # but perf_counter's origin is per-process — two ranks' traces
+        # would land at arbitrary relative offsets.  Exported timestamps
+        # are re-anchored to the wall clock captured at the same moment,
+        # so per-rank trace.json files from one pod run (synced system
+        # clocks) merge into one correlated Perfetto timeline.
+        self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
+        self._lock = threading.Lock()
+        #: (tid, event list) per registered thread — a LIST, not a
+        #: tid-keyed dict: thread idents are reused the moment a thread
+        #: dies (the engine's mux/restart/fleet workers are short-lived
+        #: and per-wave), and keying by ident would let a new worker
+        #: REPLACE a dead one's buffer, silently dropping its spans
+        #: from the export.  Entries are the trace data itself, so the
+        #: list grows exactly with what export needs.  Events are
+        #: (name, cat, t0, dur_or_None, args_or_None) tuples on the
+        #: owning thread's buffer.
+        self._buffers: List[tuple] = []
+        self._tls = threading.local()
+
+    def _buf(self) -> List[tuple]:
+        try:
+            return self._tls.buf
+        except AttributeError:
+            buf: List[tuple] = []
+            with self._lock:
+                self._buffers.append((threading.get_ident(), buf))
+            self._tls.buf = buf
+            return buf
+
+    def reset(self) -> None:
+        """Drops every recorded event and restarts the epoch (tests,
+        bench arms)."""
+        with self._lock:
+            self._buffers.clear()
+        self._tls = threading.local()
+        self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, cat: str, _flight: bool = True, **args):
+        """A context manager timing one span.  ``_flight=False`` keeps
+        high-frequency spans (per-node phases, overlap intervals) out of
+        the bounded flight ring."""
+        if not self.enabled and not _flight:
+            return _NULL
+        return _SpanHandle(self, name, cat, args or None, _flight)
+
+    def record(
+        self, name: str, cat: str, t0: float, t1: float,
+        args: Optional[dict] = None, flight: bool = True,
+    ) -> None:
+        """Records one finished span from caller-supplied timestamps
+        (sites that already measured the interval — sync_verdict, the
+        profiler's overlap hooks — record without re-timing)."""
+        if self.enabled:
+            self._buf().append((name, cat, t0, t1 - t0, args))
+        if flight:
+            _flightmod.note(name, cat, t0, t1 - t0, args)
+
+    def instant(
+        self, name: str, cat: str, _flight: bool = True, **args
+    ) -> None:
+        """A zero-duration event (breaches, fallbacks, journal marks)."""
+        t = time.perf_counter()
+        if self.enabled:
+            self._buf().append((name, cat, t, None, args or None))
+        if _flight:
+            _flightmod.note(name, cat, t, None, args or None)
+
+    # -- export ------------------------------------------------------------
+
+    def events(self) -> List[tuple]:
+        """Every recorded event as (name, cat, t0, dur, tid, args),
+        time-ordered.  Snapshots the per-thread buffers under the
+        registration lock; concurrent appends land in the next call."""
+        with self._lock:
+            bufs = [(tid, list(buf)) for tid, buf in self._buffers]
+        out = [
+            (name, cat, t0, dur, tid, args)
+            for tid, buf in bufs
+            for (name, cat, t0, dur, args) in buf
+        ]
+        out.sort(key=lambda e: e[2])
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object (Perfetto-loadable):
+        complete ``X`` events for spans, ``i`` instants, with ``pid`` =
+        process rank so per-rank files merge into one pod timeline."""
+        rank = process_rank()
+        events: List[dict] = [
+            {
+                "ph": "M", "name": "process_name", "pid": rank, "tid": 0,
+                "args": {"name": f"sboxgates rank {rank}"},
+            },
+            {
+                "ph": "M", "name": "process_sort_index", "pid": rank,
+                "tid": 0, "args": {"sort_index": rank},
+            },
+        ]
+        for name, cat, t0, dur, tid, args in self.events():
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ts": (self.epoch_unix + (t0 - self.epoch)) * 1e6,
+                "pid": rank,
+                "tid": tid,
+            }
+            if dur is None:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = dur * 1e6
+            if args:
+                ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Writes the Perfetto trace to ``path`` (created dirs included);
+        returns the path."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)[:200]
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-global tracer every engine layer records into."""
+    return _TRACER
+
+
+def span(name: str, cat: str, _flight: bool = True, **args):
+    return _TRACER.span(name, cat, _flight=_flight, **args)
+
+
+def instant(name: str, cat: str, _flight: bool = True, **args) -> None:
+    _TRACER.instant(name, cat, _flight=_flight, **args)
+
+
+def trace_null():
+    """The shared no-op span handle (tests assert the disabled fast
+    path allocates nothing)."""
+    return _NULL

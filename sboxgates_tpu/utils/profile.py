@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import trace as _ttrace
+
 
 class _OverlapStream:
     """Per-(phase, consumer) overlap accounting with bounded memory.
@@ -177,12 +179,24 @@ class PhaseProfiler:
             stream = self._overlap[key] = _OverlapStream()
         return stream
 
+    def _trace_overlap(self, name: str, kind: str,
+                       start: float, end: float) -> None:
+        # Overlap streams double as span sources (--trace): each interval
+        # becomes a wait/produce/stall span on the recording thread's
+        # timeline, so the pipeline's producer/consumer interleaving is
+        # visible in Perfetto, not just summed in the overlap table.
+        # High-frequency -> trace buffers only, never the flight ring.
+        tr = _ttrace.tracer()
+        if tr.enabled:
+            tr.record(f"{name}.{kind}", kind, start, end, flight=False)
+
     def add_wait(self, name: str, start: float, end: float,
                  consumer: Optional[int] = None) -> None:
         """Device-wait interval: consumer blocked on a device sync
         between perf_counter timestamps ``start`` and ``end``."""
         if not self.enabled:
             return
+        self._trace_overlap(name, "wait", start, end)
         with self._lock:
             s = self._overlap_stream(name, consumer)
             s.wait_s += end - start
@@ -198,6 +212,7 @@ class PhaseProfiler:
         driver's key (the prefetcher's owner records it at creation)."""
         if not self.enabled:
             return
+        self._trace_overlap(name, "produce", start, end)
         with self._lock:
             s = self._overlap_stream(name, consumer)
             s.produce_s += end - start
@@ -212,6 +227,7 @@ class PhaseProfiler:
         the prefetcher's get() — production on its critical path."""
         if not self.enabled:
             return
+        self._trace_overlap(name, "stall", start, end)
         with self._lock:
             s = self._overlap_stream(name, consumer)
             s.stall_s += end - start
@@ -401,8 +417,16 @@ class _Phase:
         if not prof.enabled:
             return False
         name, t0, child = prof._stack.pop()
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         prof.add(name, dt - child)
         if prof._stack:
             prof._stack[-1][2] += dt
+        # Phase frames are trace spans too (--trace): the profiler is a
+        # span SOURCE, giving the exported timeline the same phase
+        # nesting the -vv table sums.  Trace buffers only (per-node
+        # frequency would churn the bounded flight ring).
+        tr = _ttrace.tracer()
+        if tr.enabled:
+            tr.record(name, "phase", t0, t1, flight=False)
         return False

@@ -1,0 +1,32 @@
+# jaxlint R6 clean twin: mutation through the metrics facade, reads
+# stay plain.  Read as text — never imported.
+
+
+def count_dispatch(ctx):
+    ctx.stats.inc("device_dispatches")
+
+
+def reset_counter(ctx, before):
+    ctx.stats.put("lut7_candidates", before)
+
+
+def bump_param(stats, key):
+    from sboxgates_tpu.telemetry.metrics import bump
+
+    bump(stats, key)
+
+
+def seed_counters(rdv):
+    rdv.stats.ensure("submits", "dispatches")
+
+
+def read_counters(ctx):
+    # Reads (subscript, get, iteration, dict()) are not mutations.
+    total = ctx.stats["device_dispatches"] + ctx.stats.get("warm_hits", 0)
+    return total, dict(ctx.stats)
+
+
+def index_by_counter(ctx, cache, value):
+    # A stats READ in the slice of an unrelated target mutates the
+    # target (cache), not stats.
+    cache[ctx.stats["warm_hits"]] = value

@@ -1,0 +1,26 @@
+# jaxlint R6 fixture: direct stats-dict mutation.  Read as text — never
+# imported.
+
+
+def count_dispatch(ctx):
+    ctx.stats["device_dispatches"] += 1  # line 6: augmented assignment
+
+
+def reset_counter(ctx, before):
+    ctx.stats["lut7_candidates"] = before  # line 10: subscript assignment
+
+
+def bump_param(stats, key):
+    stats[key] = stats.get(key, 0) + 1  # line 14: bare stats param poke
+
+
+def seed_counters(rdv):
+    rdv.stats.update(submits=0, dispatches=0)  # line 18: mutating call
+
+
+def drop_counter(ctx):
+    ctx.stats.pop("warm_hits", None)  # line 22: mutating call
+
+
+def poke_nested(ctx, phase):
+    ctx.stats["device_wait_s"][phase] = 0.0  # line 26: nested subscript

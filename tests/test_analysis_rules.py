@@ -64,6 +64,14 @@ VIOLATIONS = {
     "r3_violation.py": [("R3", 15), ("R3", 23), ("R3", 29)],
     "r4_violation.py": [("R4", 13), ("R4", 14), ("R4", 19)],
     "r5_violation.py": [("R5", 9), ("R5", 18)],
+    "r6_violation.py": [
+        ("R6", 6),
+        ("R6", 10),
+        ("R6", 14),
+        ("R6", 18),
+        ("R6", 22),
+        ("R6", 26),
+    ],
 }
 
 
@@ -74,7 +82,8 @@ def test_violation_fixture_exact_findings(name, expected):
 
 @pytest.mark.parametrize(
     "name",
-    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py", "r5_clean.py"],
+    ["r1_clean.py", "r2_clean.py", "r3_clean.py", "r4_clean.py",
+     "r5_clean.py", "r6_clean.py"],
 )
 def test_clean_twin_scans_empty(name):
     report = lint_fixture(name)

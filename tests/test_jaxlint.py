@@ -31,7 +31,7 @@ def test_package_scan_has_zero_unsuppressed_findings():
 def test_config_comes_from_pyproject():
     config = load_config(ROOT)
     assert config.rules == [
-        "R1", "R2", "R3", "R4", "R5", "R1x", "R2x", "R4x",
+        "R1", "R2", "R3", "R4", "R5", "R6", "R1x", "R2x", "R4x",
     ]
     assert config.whole_program  # cross-module pass is on in the gate
     assert "sboxgates_tpu/search/lut.py" in config.hot_modules

@@ -1,0 +1,585 @@
+"""Unified telemetry subsystem: tracing, metrics registry, heartbeat,
+flight recorder, and the bench meta schema.
+
+Coverage map (ISSUE 9 acceptance criteria):
+
+- Perfetto-export schema: event types, monotonic timestamps, thread
+  ids (``test_perfetto_export_schema``).
+- Registry parity: every counter a tier-1-shaped run increments is
+  declared, named, and typed (``test_registry_parity_*``) — the same
+  pattern as the kernel warm-registry parity test.
+- Dispatch spans reconcile exactly with the ``device_dispatches``
+  counter, on the per-thread path AND through a fleet run
+  (``test_dispatch_span_reconciliation*``).
+- Telemetry off adds zero extra host syncs
+  (``test_trace_adds_zero_host_syncs``).
+- Flight recorder under fault injection: dumps produced at
+  ``dispatch.sweep`` (in-process hang -> deadline exhaustion) and
+  ``ckpt.write`` (subprocess crash), valid JSON, bounded, containing
+  the breaching span; the crash also leaves a final heartbeat line.
+- Bench writers share one meta block; schema drift is rejected
+  (``test_bench_meta_schema``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.telemetry import flight as tflight
+from sboxgates_tpu.telemetry import metrics as tmetrics
+from sboxgates_tpu.telemetry import trace as ttrace
+from sboxgates_tpu.telemetry.heartbeat import Heartbeat
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SBOXES = os.path.join(REPO, "sboxes")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    """Every test starts from a quiet process tracer/recorder and leaves
+    it quiet (both are process-global by design)."""
+    tr = ttrace.tracer()
+    fr = tflight.flight_recorder()
+    tr.enabled = False
+    tr.reset()
+    fr.reset()
+    fr.configure(None)
+    fr.clear_hooks()
+    yield
+    tr.enabled = False
+    tr.reset()
+    fr.reset()
+    fr.configure(None)
+    fr.clear_hooks()
+    ttrace.set_rank(None)
+
+
+# -------------------------------------------------------------------------
+# tracer
+# -------------------------------------------------------------------------
+
+
+def test_tracer_records_spans_across_threads():
+    tr = ttrace.tracer()
+    tr.enabled = True
+
+    with ttrace.span("dispatch[x]", "dispatch", kernel="x") as sp:
+        sp.set(warm="hit")
+
+    def worker():
+        with ttrace.span("dispatch[y]", "dispatch", kernel="y"):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    evs = tr.events()
+    assert len(evs) == 2
+    names = {e[0] for e in evs}
+    assert names == {"dispatch[x]", "dispatch[y]"}
+    tids = {e[4] for e in evs}
+    assert len(tids) == 2  # one buffer per thread
+    x = next(e for e in evs if e[0] == "dispatch[x]")
+    assert x[5] == {"kernel": "x", "warm": "hit"}
+    # time-ordered, spans carry durations
+    assert evs[0][2] <= evs[1][2]
+    assert all(e[3] >= 0 for e in evs)
+
+
+def test_tracer_disabled_records_nothing_but_flight_ring():
+    tr = ttrace.tracer()
+    assert not tr.enabled
+    with ttrace.span("dispatch[x]", "dispatch"):
+        pass
+    ttrace.instant("mark", "journal")
+    # high-frequency form: no flight, disabled -> shared no-op handle
+    h = ttrace.span("phase", "phase", _flight=False)
+    assert h is ttrace.trace_null()
+    assert tr.events() == []
+    ring = tflight.flight_recorder().events()
+    assert {e[0] for e in ring} == {"dispatch[x]", "mark"}
+
+
+def test_perfetto_export_schema(tmp_path):
+    """Chrome/Perfetto trace-event contract: metadata + X/i events,
+    microsecond timestamps that are monotone non-negative, integer
+    thread ids, pid = process rank."""
+    ttrace.set_rank(2)
+    tr = ttrace.tracer()
+    tr.enabled = True
+    with ttrace.span("dispatch[k]", "dispatch", kernel="k", g=64):
+        time.sleep(0.001)
+    ttrace.instant("deadline.breach", "deadline", label="w")
+    with ttrace.span("journal[round_done]", "journal"):
+        pass
+    path = tr.export(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    rest = [e for e in evs if e["ph"] != "M"]
+    assert {e["ph"] for e in rest} <= {"X", "i"}
+    last_ts = -1.0
+    for e in rest:
+        assert set(e) >= {"name", "cat", "ts", "pid", "tid"}
+        assert isinstance(e["tid"], int)
+        assert e["pid"] == 2
+        assert e["ts"] >= 0.0
+        assert e["ts"] >= last_ts  # exported time-ordered
+        last_ts = e["ts"]
+        if e["ph"] == "X":
+            assert e["dur"] >= 0.0
+        else:
+            assert e["s"] == "t"
+    span = next(e for e in rest if e["name"] == "dispatch[k]")
+    assert span["args"] == {"kernel": "k", "g": 64}
+
+
+# -------------------------------------------------------------------------
+# metrics registry
+# -------------------------------------------------------------------------
+
+
+def test_registry_increments_are_atomic_across_threads():
+    r = tmetrics.context_registry()
+
+    def w():
+        for _ in range(2000):
+            r.inc("lut5_candidates")
+
+    threads = [threading.Thread(target=w) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r["lut5_candidates"] == 16000
+
+
+def test_registry_reads_like_the_dict_it_replaced():
+    r = tmetrics.context_registry()
+    r.inc("pair_candidates", 7)
+    assert r["pair_candidates"] == 7
+    assert r.get("nope", 3) == 3
+    assert "pair_candidates" in r
+    assert dict(r)["pair_candidates"] == 7
+    assert dict.fromkeys(r, 0)["device_dispatches"] == 0
+    assert sum(v for k, v in r.items() if k.endswith("_candidates")) == 7
+    # engine bail path: snapshot + restore
+    snap = dict(r)
+    r.inc("pair_candidates", 100)
+    r.restore(snap)
+    assert r["pair_candidates"] == 7
+    # RestartContext views: fork zeroed, merge atomic
+    f = r.fork()
+    assert f["pair_candidates"] == 0
+    f.inc("pair_candidates", 2)
+    f.observe("device_wait_s[test]", 0.5)
+    r.merge(f)
+    assert r["pair_candidates"] == 9
+    assert r.histograms()["device_wait_s[test]"]["count"] == 1
+
+
+def test_registry_flags_undeclared_counters():
+    r = tmetrics.context_registry()
+    r.inc("device_dispatches")
+    r.observe("device_wait_s[lut5.stream]", 0.1)  # bracketed family ok
+    assert r.undeclared() == set()
+    r.inc("totally_unknown_counter")
+    assert r.undeclared() == {"totally_unknown_counter"}
+
+
+def test_histogram_buckets_and_stats():
+    h = tmetrics.Histogram(bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    assert h.counts == [1, 2, 1]
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["min"] == 0.05 and snap["max"] == 5.0
+    assert abs(snap["mean"] - 6.05 / 4) < 1e-12
+
+
+def test_bump_accepts_dicts_and_registries():
+    d = {}
+    tmetrics.bump(d, "x", 2)
+    tmetrics.bump(d, "x")
+    assert d == {"x": 3}
+    r = tmetrics.MetricsRegistry(declared=None)
+    tmetrics.bump(r, "x", 5)
+    assert r["x"] == 5
+    tmetrics.bump(None, "x")  # no-op
+
+
+# -------------------------------------------------------------------------
+# registry parity: tier-1-shaped runs increment only declared counters
+# -------------------------------------------------------------------------
+
+
+def _load_box(name):
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    return load_sbox(os.path.join(SBOXES, f"{name}.txt"))
+
+
+def test_registry_parity_native_search():
+    """A real (native-engine) one-output search touches only declared
+    counters — the registry-parity gate for the host path."""
+    from sboxgates_tpu.search import (
+        Options,
+        SearchContext,
+        generate_graph_one_output,
+        make_targets,
+    )
+    from sboxgates_tpu.graph.state import State
+
+    sbox, n = _load_box("crypto1_fa")
+    ctx = SearchContext(Options(seed=3))
+    generate_graph_one_output(
+        ctx, State.init_inputs(n), make_targets(sbox), 0, save_dir=None,
+        log=lambda s: None,
+    )
+    assert ctx.stats["pair_candidates"] > 0
+    assert ctx.stats.undeclared() == set(), ctx.stats.undeclared()
+
+
+def test_registry_parity_device_dispatch_path():
+    """The device-kernel path (head sweeps + LUT streams, warm-registry
+    telemetry included) also stays inside the declared schema."""
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search import lut as slut
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.core import boolfunc as bf
+
+    ctx = SearchContext(Options(
+        seed=5, lut_graph=True, randomize=False, host_small_steps=False,
+    ))
+    rng = np.random.default_rng(0)
+    st = State.init_inputs(8)
+    while st.num_gates < 24:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    target = np.zeros(8, dtype=np.uint32)  # unrealizable: full sweeps
+    mask = tt.mask_table(8)
+    ctx.lut_step(st, target, mask, [])
+    slut.lut5_search(ctx, st, target, mask, [])
+    assert ctx.stats["device_dispatches"] > 0
+    assert ctx.stats.undeclared() == set(), ctx.stats.undeclared()
+
+
+# -------------------------------------------------------------------------
+# dispatch-span / counter reconciliation
+# -------------------------------------------------------------------------
+
+
+def _dispatch_spans():
+    return [e for e in ttrace.tracer().events() if e[1] == "dispatch"]
+
+
+def test_dispatch_span_reconciliation_direct_path():
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.core import boolfunc as bf
+
+    ctx = SearchContext(Options(seed=1, host_small_steps=False))
+    rng = np.random.default_rng(0)
+    st = State.init_inputs(8)
+    while st.num_gates < 20:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    target = np.zeros(8, dtype=np.uint32)
+    mask = tt.mask_table(8)
+    tr = ttrace.tracer()
+    tr.enabled = True
+    ctx.pair_search(st, target, mask, False)
+    ctx.gate_step(st, target, mask)
+    ctx.triple_search(st, target, mask)
+    assert ctx.stats["device_dispatches"] >= 3
+    assert len(_dispatch_spans()) == ctx.stats["device_dispatches"]
+
+
+def test_dispatch_span_reconciliation_fleet_run():
+    """The acceptance shape: a fleet (merged-dispatch) run's dispatch
+    spans reconcile exactly with the device_dispatches counter, and the
+    merging itself is visible (spans with merged lanes > 1)."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+    from sboxgates_tpu.search.multibox import search_boxes_one_output
+
+    ctx = SearchContext(Options(
+        seed=11, lut_graph=True, randomize=False, host_small_steps=False,
+        native_engine=False, fleet=True, trace=True,
+    ))
+    tr = ttrace.tracer()
+    tr.reset()
+    res = search_boxes_one_output(
+        ctx, toy_fleet_boxes(4), 0, save_dir=None, log=lambda s: None,
+        batched="fleet",
+    )
+    assert all(sts for sts in res.values())
+    spans = _dispatch_spans()
+    assert ctx.stats["device_dispatches"] > 0
+    assert len(spans) == ctx.stats["device_dispatches"]
+    merged = [
+        e for e in spans if e[5] is not None and e[5].get("merged", 0) > 1
+    ]
+    assert merged, "no merged fleet dispatch span recorded"
+    # ttfh histograms observed per job
+    hists = ctx.stats.histograms()
+    assert hists.get("job_time_to_first_hit_s", {}).get("count", 0) >= 4
+
+
+def test_trace_adds_zero_host_syncs():
+    """Tracing on vs off must not change the number of blocking
+    device->host transfers — spans time host-side events only."""
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.utils import sync_guard
+
+    def syncs(trace_on):
+        ctx = SearchContext(Options(seed=1, host_small_steps=False))
+        rng = np.random.default_rng(0)
+        st = State.init_inputs(8)
+        while st.num_gates < 20:
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(bf.XOR, int(a), int(b), GATES)
+        target = np.zeros(8, dtype=np.uint32)
+        mask = tt.mask_table(8)
+        ttrace.tracer().enabled = trace_on
+        with sync_guard(allowed=1 << 30, action="count") as rep:
+            ctx.gate_step(st, target, mask)
+            ctx.pair_search(st, target, mask, False)
+        ttrace.tracer().enabled = False
+        return rep.syncs
+
+    assert syncs(False) == syncs(True)
+
+
+# -------------------------------------------------------------------------
+# heartbeat
+# -------------------------------------------------------------------------
+
+
+def test_heartbeat_lines_and_atomic_snapshot(tmp_path):
+    r = tmetrics.context_registry()
+    r.inc("device_dispatches", 5)
+    hb = Heartbeat(r, str(tmp_path), interval_s=0.05, rank=1).start()
+    time.sleep(0.25)
+    snap_path = hb.stop()
+    lines = [
+        json.loads(ln)
+        for ln in open(tmp_path / "telemetry.jsonl", encoding="utf-8")
+    ]
+    assert lines[0]["kind"] == "start"
+    assert lines[-1]["kind"] == "final"
+    assert len(lines) >= 3  # start + >=1 beat + final
+    for ln in lines:
+        assert ln["rank"] == 1
+        assert ln["counters"]["device_dispatches"] == 5
+        assert "process" in ln and "uptime_s" in ln
+    snap = json.load(open(snap_path))
+    assert snap["counters"]["device_dispatches"] == 5
+    assert "histograms" in snap and snap["rank"] == 1
+    assert not os.path.exists(str(snap_path) + ".tmp")
+
+
+def test_heartbeat_resume_appends(tmp_path):
+    r = tmetrics.MetricsRegistry(declared=None)
+    hb1 = Heartbeat(r, str(tmp_path), interval_s=0, rank=0).start()
+    hb1.stop(snapshot=False)
+    tflight.flight_recorder().clear_hooks()
+    n1 = len(open(tmp_path / "telemetry.jsonl").readlines())
+    hb2 = Heartbeat(
+        r, str(tmp_path), interval_s=0, rank=0, resume=True
+    ).start()
+    hb2.stop(snapshot=False)
+    n2 = len(open(tmp_path / "telemetry.jsonl").readlines())
+    assert n2 > n1  # appended after the prior run's tail, not truncated
+
+
+# -------------------------------------------------------------------------
+# flight recorder
+# -------------------------------------------------------------------------
+
+
+def test_flight_dump_on_deadline_exhaustion(tmp_path):
+    """SBG_FAULTS at dispatch.sweep (hang) + a tiny deadline budget:
+    the exhausted retry schedule dumps a valid, bounded post-mortem
+    containing the breaching span."""
+    from sboxgates_tpu.resilience import faults
+    from sboxgates_tpu.resilience.deadline import (
+        DeadlineConfig,
+        DispatchTimeout,
+        dispatch_with_retry,
+    )
+
+    tflight.configure(str(tmp_path), rank=0)
+    stats = tmetrics.context_registry()
+    faults.disarm("dispatch.sweep")
+    faults.arm("dispatch.sweep", "hang")
+    try:
+        with pytest.raises(DispatchTimeout):
+            dispatch_with_retry(
+                lambda: None,
+                DeadlineConfig(budget_s=0.05, retries=1, backoff_s=0.01),
+                stats=stats,
+                label="lut5.pivot.test",
+            )
+    finally:
+        faults.disarm("dispatch.sweep")
+    dumps = sorted(tmp_path.glob("flight-rank00-*.json"))
+    assert len(dumps) == 1
+    assert dumps[0].stat().st_size <= tflight.DUMP_MAX_BYTES + 4096
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "deadline_exhausted"
+    assert doc["extra"]["label"] == "lut5.pivot.test"
+    assert doc["rank"] == 0
+    # the breaching span: the exhaustion instant naming the label, plus
+    # the per-attempt breach events, are in the ring
+    names = [e["name"] for e in doc["events"]]
+    assert "deadline.exhausted" in names
+    assert names.count("deadline.breach") == 2  # budget + 1 retry
+    exh = next(e for e in doc["events"] if e["name"] == "deadline.exhausted")
+    assert exh["args"]["label"] == "lut5.pivot.test"
+    # counter snapshot rode along
+    assert doc["counters"]["deadline_breaches"] == 2
+    assert stats["flight_dumps"] == 1
+
+
+def test_flight_dump_bounded_under_flood(tmp_path):
+    fr = tflight.flight_recorder()
+    fr.configure(str(tmp_path), rank=0)
+    for i in range(20000):
+        fr.note(f"e{i}", "dispatch", float(i), 0.001, {"x": "y" * 50})
+    path = fr.dump("flood_test")
+    assert path is not None
+    assert os.path.getsize(path) <= tflight.DUMP_MAX_BYTES + 4096
+    doc = json.load(open(path))
+    assert len(doc["events"]) <= tflight.RING_CAP
+
+
+def test_flight_dump_without_directory_is_noop():
+    assert tflight.flight_dump("nowhere") is None
+
+
+def test_circuit_breaker_trip_dumps(tmp_path):
+    from sboxgates_tpu.search import Options, SearchContext
+
+    tflight.configure(str(tmp_path), rank=0)
+    ctx = SearchContext(Options(seed=1))
+    ctx.trip_device_breaker()
+    assert ctx.device_degraded
+    assert ctx.stats["circuit_breaker_trips"] == 1
+    dumps = list(tmp_path.glob("flight-rank00-*.json"))
+    assert len(dumps) == 1
+    assert json.load(open(dumps[0]))["reason"] == "circuit_breaker"
+
+
+def test_flight_dump_and_final_heartbeat_on_injected_crash(tmp_path):
+    """The killed-run acceptance clause: a fault-injected crash
+    (SBG_FAULTS=ckpt.write:crash) through the real CLI leaves BOTH a
+    flight-recorder dump and a final (incident) heartbeat line."""
+    from sboxgates_tpu.resilience.faults import CRASH_EXIT_CODE
+
+    outdir = tmp_path / "run"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        SBG_WARMUP="0",
+        SBG_FAULTS="ckpt.write:crash",
+        SBG_COMPILE_CACHE="",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "sboxgates_tpu",
+            os.path.join(SBOXES, "crypto1_fa.txt"),
+            "--seed", "7", "-o", "0",
+            "--output-dir", str(outdir),
+            "--metrics-interval", "300",
+        ],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == CRASH_EXIT_CODE, proc.stderr
+    dumps = list(outdir.glob("flight-rank00-*.json"))
+    assert dumps, os.listdir(outdir)
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "injected_crash"
+    assert doc["extra"]["site"] == "ckpt.write"
+    # journal appends from the run are in the ring (post-mortem context)
+    assert any(e["cat"] == "journal" for e in doc["events"])
+    lines = [
+        json.loads(ln)
+        for ln in open(outdir / "telemetry.jsonl", encoding="utf-8")
+    ]
+    assert lines[0]["kind"] == "start"
+    assert lines[-1]["kind"] == "incident:injected_crash"
+
+
+# -------------------------------------------------------------------------
+# fallback signals are structured events
+# -------------------------------------------------------------------------
+
+
+def test_pallas_fallback_emits_structured_event():
+    from sboxgates_tpu.parallel import mesh
+
+    tr = ttrace.tracer()
+    tr.enabled = True
+    stats = tmetrics.context_registry()
+    before = tmetrics.GLOBAL.get("pivot_pallas_fallbacks", 0)
+    mesh._note_pallas_fallback("pallas", stats)
+    assert stats["pivot_pallas_fallbacks"] == 1
+    assert tmetrics.GLOBAL["pivot_pallas_fallbacks"] == before + 1
+    ev = [e for e in tr.events() if e[0] == "pallas_fallback"]
+    assert len(ev) == 1 and ev[0][1] == "fallback"
+    assert ev[0][5]["backend"] == "pallas"
+
+
+def test_journal_append_emits_span(tmp_path):
+    from sboxgates_tpu.resilience.journal import SearchJournal
+
+    tr = ttrace.tracer()
+    tr.enabled = True
+    j = SearchJournal.start(str(tmp_path), config={"seed": 1})
+    j.append("round_done", beam=[])
+    names = [e[0] for e in tr.events() if e[1] == "journal"]
+    assert "journal[run_start]" in names
+    assert "journal[round_done]" in names
+
+
+# -------------------------------------------------------------------------
+# bench meta schema
+# -------------------------------------------------------------------------
+
+
+def test_bench_meta_schema():
+    """Every BENCH_*.json writer shares one meta block; this test is the
+    drift gate — new keys or a schema bump must be made here too."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    meta = bench.bench_meta()
+    assert tuple(sorted(meta)) == tuple(sorted(bench.BENCH_META_KEYS))
+    assert meta["metric"] == "meta"
+    assert meta["schema"] == bench.BENCH_SCHEMA == 1
+    assert isinstance(meta["t1_normalization"], str)
+    assert "telemetry.metrics" in meta["counters_source"]
+    entries = [{"metric": "x", "value": 1}]
+    out = bench.with_meta(entries)
+    assert out[0]["metric"] == "meta" and out[1]["metric"] == "x"
+    assert entries[0]["metric"] == "x"  # caller's list untouched
+    again = bench.with_meta(out)
+    assert [e["metric"] for e in again] == ["meta", "x"]  # idempotent
