@@ -68,6 +68,38 @@ _COMMON_METHOD_NAMES = frozenset(
 _THREAD_CTORS = {"threading.Thread", "Thread"}
 
 
+def iter_body_nodes(fn_node: ast.AST):
+    """Every AST node in a function's own BODY — decorators and nested
+    defs/lambdas excluded (decorators are definition-time; nested defs
+    are their own graph entries).  Shared by the R7/R8 contract passes."""
+    stack = list(getattr(fn_node, "body", ()))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def strip_locals(qualname: str) -> str:
+    """``outer.<locals>.inner`` -> ``outer.inner`` — the form config
+    root specs are written in (nobody types ``<locals>`` in pyproject)."""
+    return qualname.replace(".<locals>", "")
+
+
+def spec_matches_function(spec: str, key: str) -> bool:
+    """Does a config root spec ("Qual.Name" or "pkg.mod:Qual.Name")
+    name this function key?  ``<locals>`` segments in the key are
+    transparent."""
+    mod, qual = key.split(":", 1)
+    quals = (qual, strip_locals(qual))
+    if ":" in spec:
+        smod, squal = spec.split(":", 1)
+        return smod == mod and squal in quals
+    return spec in quals
+
+
 def _locally_bound_names(fn: ast.AST) -> Set[str]:
     """Names bound in ``fn``'s own scope: parameters plus assignment /
     loop / with-as / except-as / comprehension targets.  Nested def and
@@ -230,6 +262,20 @@ class Mutation:
 
 
 @dataclass
+class ThreadCreation:
+    """One ``threading.Thread(target=...)`` creation site (R7 pin gate)."""
+
+    func: str  # creating FunctionInfo.key ("" at module scope)
+    path: str
+    line: int
+    col: int
+    #: resolved target function keys (name-based attr fallback may yield
+    #: several candidates; empty when unresolvable)
+    targets: Tuple[str, ...] = ()
+    raw: str = ""  # the target expression as written
+
+
+@dataclass
 class SyncSite:
     """A host-device sync expression inside a function (R2x taint seed)."""
 
@@ -271,12 +317,17 @@ class ProjectGraph:
         self.sync_sites: List[SyncSite] = []
         #: function keys directly named as Thread targets (+ config extras)
         self.thread_roots: List[str] = []
+        #: every Thread(target=...) creation site, resolved or not (R7)
+        self.thread_creations: List[ThreadCreation] = []
         #: jit-decorated or module-scope jit-wrapped functions (+ extras)
         self.jit_roots: List[str] = []
         #: instance attribute names assigned a Lock anywhere in the project
         self.lock_attrs: Set[str] = set()
         #: per-function lock-typed parameter names (fixpoint result)
         self.lock_params: Dict[str, Set[str]] = {}
+        #: set by the R9 pass (analysis.lockorder.LockOrderResult) so
+        #: --graph can export the lock-order graph alongside the calls
+        self.lock_order = None
 
     # -- symbol resolution -------------------------------------------------
 
@@ -544,9 +595,41 @@ class ProjectGraph:
 # module indexing
 
 
-def _classify_module_assign(value: ast.AST) -> str:
+_ABS_LOCK_CTORS = frozenset(
+    {"threading.Lock", "threading.RLock", "threading.Condition"}
+)
+
+
+def _expand_imports(imports: Dict[str, str], name: str) -> str:
+    """Longest-prefix import-alias expansion of a dotted name (e.g.
+    ``_threading.Lock`` -> ``threading.Lock`` under ``import threading
+    as _threading``)."""
+    parts = name.split(".")
+    for cut in range(len(parts), 0, -1):
+        target = imports.get(".".join(parts[:cut]))
+        if target is not None:
+            rest = parts[cut:]
+            return target + ("." + ".".join(rest) if rest else "")
+    return name
+
+
+def is_lock_ctor(name: Optional[str],
+                 imports: Optional[Dict[str, str]] = None) -> bool:
+    """Is this dotted call name a Lock/RLock/Condition constructor,
+    including through an import alias (``import threading as _th``)?"""
+    if name is None:
+        return False
+    if name in _LOCK_CTORS:
+        return True
+    if imports is not None:
+        return _expand_imports(imports, name) in _ABS_LOCK_CTORS
+    return False
+
+
+def _classify_module_assign(value: ast.AST,
+                            imports: Optional[Dict[str, str]] = None) -> str:
     vname = dotted(value.func) if isinstance(value, ast.Call) else None
-    if vname in _LOCK_CTORS:
+    if is_lock_ctor(vname, imports):
         return "lock"
     if isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
         vname in _MUTABLE_CTORS
@@ -588,7 +671,7 @@ def index_module(relpath: str, tree: ast.Module) -> ModuleInfo:
             for t in targets:
                 if not isinstance(t, ast.Name):
                     continue
-                mi.assigns[t.id] = _classify_module_assign(value)
+                mi.assigns[t.id] = _classify_module_assign(value, mi.imports)
                 # Module-scope jit wrapper: name = jax.jit(fn, ...)
                 call = _jit_call_of(value)
                 if call is not None and call.args and isinstance(
@@ -935,10 +1018,11 @@ class _BodyScan(ast.NodeVisitor):
                 continue
             v = kw.value
             fi = self.fi
+            targets: List[str] = []
             if isinstance(v, ast.Name):
                 target = self._resolve_local(v.id)
                 if target is not None:
-                    self.g.thread_roots.append(target.key)
+                    targets.append(target.key)
             elif isinstance(v, ast.Attribute):
                 meth = v.attr
                 if (
@@ -947,14 +1031,23 @@ class _BodyScan(ast.NodeVisitor):
                     and fi.cls is not None
                     and f"{fi.cls}.{meth}" in self.mi.functions
                 ):
-                    self.g.thread_roots.append(
-                        f"{self.mi.name}:{fi.cls}.{meth}"
-                    )
+                    targets.append(f"{self.mi.name}:{fi.cls}.{meth}")
                 else:
                     # same common-name guard as call edges: a target
                     # named like a builtin container/queue method must
                     # not make every same-named project method a root
-                    self.g.thread_roots.extend(self._named_methods(meth))
+                    targets.extend(self._named_methods(meth))
+            self.g.thread_roots.extend(targets)
+            self.g.thread_creations.append(
+                ThreadCreation(
+                    func=fi.key,
+                    path=fi.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    targets=tuple(targets),
+                    raw=dotted(v) or type(v).__name__,
+                )
+            )
 
     # ---- sync sites (R2x taint seeds)
 
@@ -1009,7 +1102,7 @@ def build_graph(
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"
                         and isinstance(node.value, ast.Call)
-                        and dotted(node.value.func) in _LOCK_CTORS
+                        and is_lock_ctor(dotted(node.value.func), mi.imports)
                     ):
                         g.lock_attrs.add(t.attr)
     for meth in g.methods:
@@ -1027,16 +1120,15 @@ def build_graph(
     ):
         g.out_edges.setdefault(e.caller, []).append(e)
 
-    # Configured extra roots.
+    # Configured extra roots.  Bare specs match the qualname with or
+    # without its ``<locals>`` segments ("run_with_deadline.work" pins
+    # the nested ``run_with_deadline.<locals>.work``); module-qualified
+    # specs get the same tolerance on their qualname half.
     def match_config_roots(specs: Sequence[str]) -> List[str]:
         out: List[str] = []
         for spec in specs:
-            if ":" in spec:
-                if spec in g.functions:
-                    out.append(spec)
-                continue
             for key in sorted(g.functions):
-                if key.split(":", 1)[1] == spec:
+                if spec_matches_function(spec, key):
                     out.append(key)
         return out
 

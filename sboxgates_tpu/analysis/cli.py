@@ -67,6 +67,121 @@ def lint_paths(
     return reports
 
 
+def lint_ref(
+    ref: str,
+    config: JaxlintConfig,
+    paths: Optional[List[str]] = None,
+    sources_out: Optional[dict] = None,
+) -> List[FileReport]:
+    """Lints the tree as it exists at git ``ref`` (sources read via
+    ``git show``, never touching the working tree), with the SAME
+    current configuration — so ``--diff-base`` judges old code by
+    today's contracts, which is exactly what an incremental gate wants.
+    ``sources_out``, if given, is filled with relpath -> source lines so
+    callers need not re-fetch the same blobs from git.  Raises
+    ``RuntimeError`` with a one-line message on git failures."""
+    import subprocess
+
+    from .project import analyze_project
+    from .rules import analyze_file, finalize_report
+
+    proc = subprocess.run(
+        ["git", "ls-tree", "-r", "--name-only", ref],
+        cwd=config.root,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cannot list files at {ref!r}: "
+            f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else 'git failed'}"
+        )
+    # git ls-tree paths are repo-relative: absolute scan specs (which
+    # iter_python_files accepts for the working tree) must be
+    # relativized against the project root to match anything.
+    scan = []
+    for p in paths or config.paths:
+        if os.path.isabs(p):
+            p = os.path.relpath(p, config.root)
+        scan.append(os.path.normpath(p).replace(os.sep, "/"))
+    wanted = []
+    for rel in sorted(proc.stdout.splitlines()):
+        if not rel.endswith(".py") or config.is_excluded(rel):
+            continue
+        if any(
+            s in (".", "") or rel == s or rel.startswith(s + "/")
+            for s in scan
+        ):
+            wanted.append(rel)
+    analyses = []
+    for rel in wanted:
+        show = subprocess.run(
+            ["git", "show", f"{ref}:{rel}"],
+            cwd=config.root,
+            capture_output=True,
+            text=True,
+        )
+        if show.returncode != 0:
+            continue  # racy rename/submodule edge: treat as absent
+        if sources_out is not None:
+            sources_out[rel] = show.stdout.splitlines()
+        analyses.append(analyze_file(show.stdout, rel, config))
+    if config.whole_program:
+        reports, _graph = analyze_project(analyses, config)
+        return reports
+    return [finalize_report(fa) for fa in analyses]
+
+
+def _finding_keys(reports: List[FileReport], root: str,
+                  ref: Optional[str] = None,
+                  sources: Optional[dict] = None):
+    """Content-keyed finding multiset: (path, rule, stripped source
+    line).  Keying on the line TEXT instead of the number keeps
+    unrelated edits above a finding from resurrecting it as "new" in
+    differential mode.  ``sources`` seeds the relpath -> lines cache
+    (lint_ref already fetched the base blobs once)."""
+    import subprocess
+    from collections import Counter
+
+    sources = {} if sources is None else sources
+
+    def line_text(path: str, line: int) -> str:
+        if path not in sources:
+            try:
+                if ref is None:
+                    with open(
+                        os.path.join(root, path), "r", encoding="utf-8"
+                    ) as f:
+                        sources[path] = f.read().splitlines()
+                else:
+                    proc = subprocess.run(
+                        ["git", "show", f"{ref}:{path}"],
+                        cwd=root,
+                        capture_output=True,
+                        text=True,
+                    )
+                    sources[path] = (
+                        proc.stdout.splitlines()
+                        if proc.returncode == 0
+                        else []
+                    )
+            except OSError:
+                sources[path] = []
+        lines = sources[path]
+        if 1 <= line <= len(lines):
+            return lines[line - 1].strip()
+        return ""
+
+    counts: Counter = Counter()
+    keyed = []
+    for r in reports:
+        for f in r.findings:
+            key = (f.path, f.rule, line_text(f.path, f.line))
+            counts[key] += 1
+            keyed.append((f, key))
+    return counts, keyed
+
+
 def _flatten(reports: List[FileReport]):
     findings = [f for r in reports for f in r.findings]
     suppressed = [f for r in reports for f in r.suppressed]
@@ -116,6 +231,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--write-baseline",
         metavar="FILE",
         help="write the current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--diff-base",
+        metavar="REF",
+        help="differential mode: report only findings introduced "
+        "relative to git REF (both trees judged by the CURRENT config; "
+        "findings matched by (path, rule, source-line text) so "
+        "unrelated edits don't resurrect old ones) — fast incremental "
+        "output for local iteration while the tier-1 gate stays on the "
+        "zero-findings --baseline",
     )
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
@@ -180,6 +305,48 @@ def main(argv: Optional[List[str]] = None) -> int:
     reports = lint_paths(args.paths or None, config)
     findings, suppressed = _flatten(reports)
     payload = _as_payload(reports)
+
+    if args.diff_base:
+        base_sources: dict = {}
+        try:
+            base_reports = lint_ref(
+                args.diff_base, config, args.paths or None,
+                sources_out=base_sources,
+            )
+        except RuntimeError as e:
+            print(f"jaxlint: {e}", file=sys.stderr)
+            return 2
+        base_counts, _ = _finding_keys(
+            base_reports, config.root, ref=args.diff_base,
+            sources=base_sources,
+        )
+        now_counts, keyed = _finding_keys(reports, config.root)
+        budget = dict(base_counts)
+        new: List[Finding] = []
+        for f, key in keyed:
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+            else:
+                new.append(f)
+        new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        if args.format == "json":
+            json.dump(
+                {
+                    "diff_base": args.diff_base,
+                    "new_findings": [f.as_json() for f in new],
+                    "total_findings": len(findings),
+                },
+                sys.stdout, indent=1, sort_keys=True,
+            )
+            print()
+        else:
+            for f in new:
+                print(f.render())
+            print(
+                f"jaxlint: {len(new)} finding(s) introduced since "
+                f"{args.diff_base} ({len(findings)} total)"
+            )
+        return 1 if new else 0
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:

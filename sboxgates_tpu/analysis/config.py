@@ -17,8 +17,10 @@ from typing import Dict, List, Optional
 
 #: Per-file rules.
 FILE_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
-#: Cross-module rules (whole-program pass only).
-CROSS_RULES = ("R1x", "R2x", "R4x")
+#: Cross-module rules (whole-program pass only).  R7/R8/R9 are the
+#: contract-verification passes: registry drift, bucket discipline,
+#: lock ordering.
+CROSS_RULES = ("R1x", "R2x", "R4x", "R7", "R8", "R9")
 ALL_RULES = FILE_RULES + CROSS_RULES
 
 #: Defaults mirror the committed pyproject table so API callers that never
@@ -27,6 +29,34 @@ DEFAULT_HOT_MODULES = (
     "sboxgates_tpu/ops/*",
     "sboxgates_tpu/search/lut.py",
     "sboxgates_tpu/parallel/mesh.py",
+)
+
+#: Modules whose functions dispatch registered kernels: R7's
+#: registry-bypass check and R8's bucket-discipline pass apply here.
+DEFAULT_DISPATCH_MODULES = (
+    "sboxgates_tpu/search/*",
+    "sboxgates_tpu/ops/*",
+)
+
+#: Names whose presence in (or derivation into) a shape expression marks
+#: it bucket-disciplined (R8).  Any name containing "bucket" counts too.
+DEFAULT_BUCKET_SOURCES = (
+    "bucket_size",
+    "PIVOT_G_BUCKETS",
+    "FLEET_BUCKETS",
+    "STACKED_BUCKETS",
+    "FLEET_LADDER",
+)
+
+#: Call names that block on a device resolve or a cross-rank agreement
+#: (R9: a lock held across one deadlocks against the abandonment path).
+DEFAULT_BLOCKING_CALLS = (
+    "guarded_dispatch",
+    "dispatch_with_retry",
+    "replicated_dispatch_with_retry",
+    "breach_verdict",
+    "sync_verdict",
+    "host_sync_deadline",
 )
 
 
@@ -55,10 +85,23 @@ class JaxlintConfig:
     whole_program: bool = False
     thread_roots: List[str] = field(default_factory=list)
     jit_roots: List[str] = field(default_factory=list)
+    dispatch_modules: List[str] = field(
+        default_factory=lambda: list(DEFAULT_DISPATCH_MODULES)
+    )
+    bucket_sources: List[str] = field(
+        default_factory=lambda: list(DEFAULT_BUCKET_SOURCES)
+    )
+    blocking_calls: List[str] = field(
+        default_factory=lambda: list(DEFAULT_BLOCKING_CALLS)
+    )
 
     def is_hot(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
         return any(fnmatch.fnmatch(rp, pat) for pat in self.hot_modules)
+
+    def is_dispatch(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rp, pat) for pat in self.dispatch_modules)
 
     def is_excluded(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
@@ -172,6 +215,7 @@ def load_config(start: str = ".") -> JaxlintConfig:
     for key in (
         "hot_modules", "rules", "exclude", "paths",
         "thread_roots", "jit_roots",
+        "dispatch_modules", "bucket_sources", "blocking_calls",
     ):
         val = table.get(key)
         if isinstance(val, list) and all(isinstance(x, str) for x in val):

@@ -47,6 +47,7 @@ from .rules import (
     _UNHASHABLE_NODES,
     FileAnalysis,
     FileReport,
+    Finding,
     analyze_file,
     dotted,
     finalize_report,
@@ -328,6 +329,32 @@ def analyze_project(
                         )
                         break
 
+    # Contract-verification passes (R7/R8/R9): registry drift, bucket
+    # discipline, lock ordering.  They share the same graph and the same
+    # raw-finding/suppression plumbing as the other x-rules.
+    if "R7" in config.rules:
+        from .registries import run_r7
+
+        ran.add("R7")
+        for path, items in run_r7(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+    if "R8" in config.rules:
+        from .bucketflow import run_r8
+
+        ran.add("R8")
+        for path, items in run_r8(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+    if "R9" in config.rules:
+        from .lockorder import run_r9
+
+        ran.add("R9")
+        r9_findings, lock_order = run_r9(graph, config)
+        for path, items in r9_findings.items():
+            extra.setdefault(path, []).extend(items)
+        # Stashed for --graph: the resolved lock-order graph rides along
+        # with the call graph so the root-coverage gate can read it.
+        graph.lock_order = lock_order
+
     reports: List[FileReport] = []
     for fa in analyses:
         # Every x-rule that ran is judged for stale markers — including
@@ -339,6 +366,17 @@ def analyze_project(
         reports.append(
             finalize_report(fa, extra.get(fa.path, ()), set(ran))
         )
+    # Findings about unscanned paths (the pyproject config itself, e.g.
+    # a stale thread_roots pin) get a bare report — no inline
+    # suppressions to match there.
+    covered = {fa.path for fa in analyses}
+    for path in sorted(set(extra) - covered):
+        rep = FileReport(path=path)
+        for rule, line, col, msg in sorted(
+            extra[path], key=lambda f: (f[1], f[2], f[0])
+        ):
+            rep.findings.append(Finding(path, line, col, rule, msg))
+        reports.append(rep)
     return reports, graph
 
 
@@ -371,6 +409,17 @@ def graph_json(
     config: Optional[JaxlintConfig] = None,
 ) -> dict:
     """The resolved call graph + roots as a deterministic JSON dict
-    (the ``--graph`` CLI output)."""
+    (the ``--graph`` CLI output).  When R9 ran, the lock-order graph
+    rides along with per-root transitive acquisitions, so the gate can
+    assert every pinned thread root is covered."""
     _reports, graph = lint_project(paths, config, return_graph=True)
-    return graph.as_json()
+    data = graph.as_json()
+    lock_order = getattr(graph, "lock_order", None)
+    if lock_order is not None:
+        lo = lock_order.as_json()
+        lo["root_acquires"] = {
+            root: sorted(lock_order.trans_acquires.get(root, ()))
+            for root in data["thread_roots"]
+        }
+        data["lock_order"] = lo
+    return data

@@ -70,6 +70,25 @@ RULE_DOCS = {
         "entry (transitive reachability; locks may be imported, "
         "re-exported, or passed as parameters)"
     ),
+    "R7": (
+        "registry drift: a use site bypassing or undeclared in one of the "
+        "declared registries (kernel registry / METRICS / fault sites / "
+        "journal config keys / pinned thread roots), or a declared entry "
+        "with no reachable use site"
+    ),
+    "R8": (
+        "bucket discipline: an operand shape at a registered-kernel "
+        "dispatch site derives from a non-bucketed dynamic value — every "
+        "distinct shape is a fresh compile (pad to the declared bucket "
+        "ladders: bucket_size/PIVOT_G_BUCKETS/FLEET_BUCKETS/"
+        "STACKED_BUCKETS)"
+    ),
+    "R9": (
+        "lock-order hazard: a cycle in the lock-acquisition-order graph "
+        "over the thread roots (potential deadlock), or a lock held "
+        "across a blocking dispatch/verdict resolve (deadlocks against "
+        "the abandonment path)"
+    ),
     SUPPRESSION_RULE: (
         "malformed or unused jaxlint suppression (reason is mandatory; a "
         "marker whose finding no longer fires is itself a finding)"

@@ -542,17 +542,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.available_gates is not None
             else bf.DEFAULT_AVAILABLE
         ),
+        # jaxlint: ignore[R7] progress display only; never shapes the draw stream
         verbosity=args.verbose,
         seed=args.seed,
         batch_restarts=args.batch_iterations,
         parallel_mux=False if args.serial_mux else None,
         pipeline_depth=args.pipeline_depth,
+        # jaxlint: ignore[R7] deadline/degradation timing; results bit-identical with or without
         dispatch_timeout_s=args.dispatch_timeout,
+        # jaxlint: ignore[R7] warmup only pre-compiles, never executes; parity-tested identical
         warmup=not args.no_warmup,
         compile_cache=cache_dir,
         fleet=args.fleet,
         fleet_candidates=args.fleet_candidates,
         fleet_max_wave=args.fleet_max_wave,
+        # jaxlint: ignore[R7] telemetry is observation-only (zero-sync counter-asserted)
         trace=args.trace is not None,
     )
 

@@ -234,6 +234,7 @@ class Rendezvous:
         fn = self._vmapped.get(vkey)
         if fn is None:
             in_axes = [None if i in shared else 0 for i in range(nargs)]
+            # jaxlint: ignore[R7] wraps a registry-built kernel post-vmap; memoized in _VMAP_CACHE keyed (kernel, bucket, shared) — the fleet path's warmable twin is FLEET_SHARED
             fn = jax.jit(jax.vmap(entries[0]["kernel"], in_axes=in_axes))
             self._vmapped[vkey] = fn
         stacked = [

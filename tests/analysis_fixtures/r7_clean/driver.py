@@ -1,0 +1,29 @@
+"""Registry-disciplined dispatch side (clean twin): every metric is
+declared, every fault site is known, no jit wrapper is built outside
+the registry home, and a private (``declared=None``) registry's own
+counters are exempt by design."""
+from .registry import KERNELS, MetricsRegistry, fault_point
+
+
+def kernel_call(name, args):
+    return KERNELS[name].name, args
+
+
+def run(xs):
+    return kernel_call("gate_sweep", xs)
+
+
+def tally(stats, n):
+    stats.inc("sweeps", n)
+
+
+def probe():
+    fault_point("ckpt.write")
+
+
+class Rendezvous:
+    def __init__(self):
+        self.stats = MetricsRegistry({"submits": 0}, declared=None)
+
+    def submit(self):
+        self.stats.inc("submits")

@@ -1,0 +1,26 @@
+"""Journal-key contract held (clean twin): every Options field built
+from args is journaled, and every key has an argparse destination."""
+import argparse
+
+JOURNAL_CONFIG_KEYS = (
+    "seed",
+)
+
+JOURNAL_KEY_DEFAULTS = {"seed": None}
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int)
+    return p
+
+
+class Options:
+    def __init__(self, seed=None, verbosity=0):
+        self.seed = seed
+        self.verbosity = verbosity
+
+
+def main(argv):
+    args = build_parser().parse_args(argv)
+    return Options(seed=args.seed, verbosity=0)

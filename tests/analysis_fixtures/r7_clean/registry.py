@@ -1,0 +1,36 @@
+"""Miniature declared registries for the R7 drift pass (clean twin)."""
+from collections import namedtuple
+
+KernelDef = namedtuple("KernelDef", ["name", "statics"])
+
+
+class MetricsRegistry:  # stand-in for telemetry.metrics.MetricsRegistry
+    def __init__(self, initial=None, declared=None):
+        self.values = dict(initial or {})
+
+    def inc(self, name, by=1):
+        self.values[name] = self.values.get(name, 0) + by
+
+
+def fault_point(site):
+    return site
+
+
+KERNELS = {
+    d.name: d
+    for d in (
+        KernelDef("gate_sweep", ()),
+    )
+}
+
+FLEET_SHARED = {
+    "gate_sweep": (0,),
+}
+
+METRICS = {
+    "sweeps": ("counter", "candidates"),
+}
+
+KNOWN_SITES = (
+    "ckpt.write",
+)

@@ -1,0 +1,10 @@
+"""Pinned thread entry (clean twin — the pack config pins 'work')."""
+import threading
+
+
+def work():
+    return None
+
+
+def spawn():
+    threading.Thread(target=work).start()

@@ -1,0 +1,24 @@
+"""Dispatch-side registry escapes (dirty twin)."""
+import jax
+
+from .registry import KERNELS, fault_point
+
+
+def kernel_call(name, args):
+    return KERNELS[name].name, args
+
+
+def run(xs):
+    out = kernel_call("gate_sweep", xs)
+    fn = jax.jit(lambda x: x + 1)
+    return fn(out)
+
+
+def tally(stats, n):
+    stats.inc("sweeps", n)
+    stats.inc("sweep_total", n)
+
+
+def probe():
+    fault_point("ckpt.write")
+    fault_point("ckpt.rename")

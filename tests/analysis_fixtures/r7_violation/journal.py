@@ -1,0 +1,30 @@
+"""Journal-key contract escapes (dirty twin)."""
+import argparse
+
+JOURNAL_CONFIG_KEYS = (
+    "seed",
+    "ghost_flag",
+)
+
+JOURNAL_KEY_DEFAULTS = {"late_flag": 1}
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int)
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+class Options:
+    def __init__(self, seed=None, verbosity=0):
+        self.seed = seed
+        self.verbosity = verbosity
+
+
+def main(argv):
+    args = build_parser().parse_args(argv)
+    return Options(
+        seed=args.seed,
+        verbosity=args.verbose,
+    )

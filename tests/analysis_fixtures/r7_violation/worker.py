@@ -1,0 +1,10 @@
+"""Unpinned thread entry (dirty twin)."""
+import threading
+
+
+def work():
+    return None
+
+
+def spawn():
+    threading.Thread(target=work).start()

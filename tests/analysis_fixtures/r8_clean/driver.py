@@ -1,0 +1,28 @@
+"""Bucket-disciplined operand shapes (clean twin): every axis derives
+from a declared bucket ladder, the padding idiom ``bucket - n``
+included; constants are static (one shape, no hazard)."""
+import numpy as np
+
+from .kernels import bucket_size, kernel_call
+
+
+def sweep(items):
+    b = bucket_size(len(items))
+    ops = np.zeros((b, 8))
+    return kernel_call("gate_sweep", ops)
+
+
+def pad_tail(items, bucket):
+    tail = np.zeros((bucket - len(items), 8))
+    return kernel_call("gate_sweep", tail)
+
+
+def fixed_probe():
+    probe = np.zeros((64, 8))
+    return kernel_call("gate_sweep", probe)
+
+
+def rebucket(arr, items):
+    b = bucket_size(len(items))
+    ops = np.reshape(arr, (b, 8))
+    return kernel_call("gate_sweep", ops)

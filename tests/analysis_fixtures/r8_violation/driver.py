@@ -1,0 +1,22 @@
+"""Unbucketed operand shapes at dispatch sites (dirty twin): every
+distinct shape value compiles a fresh executable."""
+import numpy as np
+
+from .kernels import kernel_call
+
+
+def sweep(items):
+    n = len(items)
+    ops = np.zeros((n, 8))
+    return kernel_call("gate_sweep", ops)
+
+
+def resweep(chunks):
+    for chunk in chunks:
+        pad = np.zeros((len(chunk), 8))
+        kernel_call("gate_sweep", pad)
+
+
+def grow(count):
+    buf = np.ones((count, 8))
+    return kernel_call("gate_sweep", buf)

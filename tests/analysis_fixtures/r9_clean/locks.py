@@ -1,0 +1,5 @@
+"""Module-level locks shared by the pack's two worker paths."""
+import threading
+
+ALPHA = threading.Lock()
+BETA = threading.Lock()

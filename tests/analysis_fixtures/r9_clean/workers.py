@@ -1,0 +1,29 @@
+"""Disciplined locking (clean twin): both paths acquire in the SAME
+global order (ALPHA before BETA), and the blocking resolve runs only
+after the lock is released — the staging pattern R9 asks for."""
+import threading
+
+from .locks import ALPHA, BETA
+
+
+def forward(items):
+    with ALPHA:
+        with BETA:
+            return list(items)
+
+
+def also_forward(items):
+    with ALPHA:
+        with BETA:
+            return list(items)
+
+
+def resolve(ctx, ops):
+    with ALPHA:
+        staged = list(ops)
+    return ctx.guarded_dispatch("gate_sweep", staged)
+
+
+def spawn():
+    threading.Thread(target=forward, args=([],)).start()
+    threading.Thread(target=also_forward, args=([],)).start()

@@ -1,0 +1,30 @@
+"""Lock-order cycle + lock held across a blocking resolve (dirty
+twin): ``forward`` acquires ALPHA then BETA while ``backward`` does the
+reverse — two threads interleaving them deadlock — and ``resolve``
+holds ALPHA across a guarded dispatch, which deadlocks against the
+abandonment path exactly when it needs the lock."""
+import threading
+
+from .locks import ALPHA, BETA
+
+
+def forward(items):
+    with ALPHA:
+        with BETA:
+            return list(items)
+
+
+def backward(items):
+    with BETA:
+        with ALPHA:
+            return list(items)
+
+
+def resolve(ctx, ops):
+    with ALPHA:
+        return ctx.guarded_dispatch("gate_sweep", ops)
+
+
+def spawn():
+    threading.Thread(target=forward, args=([],)).start()
+    threading.Thread(target=backward, args=([],)).start()

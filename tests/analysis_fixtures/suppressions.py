@@ -26,5 +26,5 @@ def probe_c():
 def probe_d():
     try:
         import maybe_missing  # noqa: F401
-    except Exception:  # jaxlint: ignore[R9] no such rule
+    except Exception:  # jaxlint: ignore[R99] no such rule
         return False  # unknown rule: NOT suppressed, plus SUP

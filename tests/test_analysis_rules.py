@@ -20,6 +20,11 @@ from sboxgates_tpu.analysis.rules import SUPPRESSION_RULE
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 
+#: The pre-contract rule set for the legacy multi-file packs: R7's
+#: thread-pin gate would otherwise (correctly) flag the deliberately
+#: unpinned Thread targets those packs spawn to exercise R4/R4x.
+LEGACY_RULES = [r for r in ALL_RULES if r not in ("R7", "R8", "R9")]
+
 
 def lint_fixture(name, **kwargs):
     path = os.path.join(FIXTURES, name)
@@ -30,14 +35,15 @@ def lint_fixture(name, **kwargs):
     return lint_source(source, name, JaxlintConfig(), hot=True, **kwargs)
 
 
-def lint_pack(name, hot_modules=()):
+def lint_pack(name, hot_modules=(), rules=None, **cfg_kwargs):
     """Whole-program lint of one multi-file fixture pack."""
     cfg = JaxlintConfig(
         root=os.path.join(FIXTURES, name),
         paths=["."],
-        rules=list(ALL_RULES),
+        rules=list(LEGACY_RULES if rules is None else rules),
         hot_modules=list(hot_modules),
         whole_program=True,
+        **cfg_kwargs,
     )
     return lint_project(config=cfg)
 
@@ -292,7 +298,7 @@ def test_xrule_findings_suppressible_inline(tmp_path):
         "    threading.Thread(target=work).start()\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     reports = lint_project(config=cfg)
@@ -317,7 +323,7 @@ def test_xrule_markers_not_judged_stale_without_whole_program(tmp_path):
     per_file = lint_source(src, "mod.py", JaxlintConfig())
     assert found(per_file) == []  # not judged: R4x never ran
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     reports = lint_project(config=cfg)
@@ -343,7 +349,7 @@ def test_r2x_for_else_body_is_not_in_the_loop(tmp_path):
         "        return fetch(batch)\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         hot_modules=["*hot*"], whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == []
@@ -367,7 +373,7 @@ def test_r4x_local_shadowing_is_not_module_state(tmp_path):
         "    threading.Thread(target=work).start()\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == []
@@ -406,7 +412,7 @@ def test_r2x_shadowed_callable_is_not_the_imported_helper(tmp_path):
         "        fetch(v)\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         hot_modules=["*hot*"], whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == []
@@ -428,7 +434,7 @@ def test_r4x_tuple_unpacked_local_shadows_module_state(tmp_path):
         "    threading.Thread(target=work).start()\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == []
@@ -451,7 +457,7 @@ def test_r4x_sees_aliased_threading_import(tmp_path):
         "    th.Thread(target=work).start()\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == [
@@ -471,7 +477,7 @@ def test_r2x_stale_acknowledged_source_marker_is_flagged(tmp_path):
         "    return v\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == [
@@ -498,7 +504,7 @@ def test_r1x_annassign_jit_alias_tracks_statics(tmp_path):
         "        jfit(xs, k=i)\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == [
@@ -522,7 +528,7 @@ def test_r2x_while_test_is_in_the_loop(tmp_path):
         "        pass\n"
     )
     cfg = JaxlintConfig(
-        root=str(pack), paths=["."], rules=list(ALL_RULES),
+        root=str(pack), paths=["."], rules=list(LEGACY_RULES),
         hot_modules=["*hot*"], whole_program=True,
     )
     assert pack_found(lint_project(config=cfg)) == [
@@ -541,3 +547,290 @@ def test_pack_scan_is_deterministic():
         f.message for r in lint_pack("r4x_violation") for f in r.findings
     ]
     assert msgs_a == msgs_b
+
+
+# -- contract-verification packs (R7/R8/R9) --------------------------------
+
+#: pack -> (config kwargs, exact sorted (rule, file, line)).  The clean
+#: twins run under the same kwargs as their dirty pack unless listed.
+CONTRACT_PACKS = {
+    "r7_violation": (
+        dict(rules=["R7"], dispatch_modules=["*"], thread_roots=[]),
+        [
+            ("R7", "driver.py", 13),   # registry-bypassing jax.jit
+            ("R7", "driver.py", 19),   # undeclared metric
+            ("R7", "driver.py", 24),   # undeclared fault site
+            ("R7", "journal.py", 6),   # journal key with no argparse dest
+            ("R7", "journal.py", 9),   # default for a non-journaled key
+            ("R7", "journal.py", 29),  # Options field not journaled
+            ("R7", "registry.py", 23),  # dead kernel declaration
+            ("R7", "registry.py", 29),  # FLEET_SHARED outside KERNELS
+            ("R7", "worker.py", 10),   # unpinned thread entry
+        ],
+    ),
+    "r8_violation": (
+        dict(rules=["R8"], dispatch_modules=["*"]),
+        [
+            ("R8", "driver.py", 10),  # shape from len()-derived local
+            ("R8", "driver.py", 16),  # inline len() + loop variable
+            ("R8", "driver.py", 21),  # parameter-shaped operand
+        ],
+    ),
+    "r9_violation": (
+        dict(rules=["R9"], thread_roots=["forward", "backward"]),
+        [
+            ("R9", "workers.py", 13),  # order cycle, first witness hop
+            ("R9", "workers.py", 25),  # lock held across the resolve
+        ],
+    ),
+}
+
+CONTRACT_CLEAN = {
+    "r7_clean": dict(rules=["R7"], dispatch_modules=["*"],
+                     thread_roots=["work"]),
+    "r8_clean": dict(rules=["R8"], dispatch_modules=["*"]),
+    "r9_clean": dict(rules=["R9"],
+                     thread_roots=["forward", "also_forward"]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CONTRACT_PACKS))
+def test_contract_violation_pack_exact_findings(name):
+    kwargs, expected = CONTRACT_PACKS[name]
+    assert pack_found(lint_pack(name, **kwargs)) == expected
+
+
+@pytest.mark.parametrize("name", sorted(CONTRACT_CLEAN))
+def test_contract_clean_twin_scans_empty(name):
+    reports = lint_pack(name, **CONTRACT_CLEAN[name])
+    assert pack_found(reports) == []
+    assert [f for r in reports for f in r.suppressed] == []
+
+
+def test_r7_messages_name_the_registry_and_contract():
+    kwargs, _ = CONTRACT_PACKS["r7_violation"]
+    reports = lint_pack("r7_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    # The bypass finding names the registry's home module.
+    m = by_site[("driver.py", 13)]
+    assert "registry.py" in m and "kernel_call" in m
+    # The drift findings name the violated registry and the entry.
+    assert "sweep_total" in by_site[("driver.py", 19)]
+    assert "METRICS" in by_site[("driver.py", 19)]
+    assert "ckpt.rename" in by_site[("driver.py", 24)]
+    assert "KNOWN_SITES" in by_site[("driver.py", 24)]
+    assert "orphan_sweep" in by_site[("registry.py", 23)]
+    assert "ghost_sweep" in by_site[("registry.py", 29)]
+    assert "thread_roots" in by_site[("worker.py", 10)]
+
+
+def test_r7_clean_exempts_private_declared_none_registry():
+    """The clean driver's Rendezvous tallies into its own
+    MetricsRegistry(declared=None) — a private schema by design, never
+    held to METRICS."""
+    src = open(
+        os.path.join(FIXTURES, "r7_clean", "driver.py"), encoding="utf-8"
+    ).read()
+    assert "declared=None" in src and 'inc("submits")' in src
+    kwargs = CONTRACT_CLEAN["r7_clean"]
+    assert pack_found(lint_pack("r7_clean", **kwargs)) == []
+
+
+def test_r7_stale_thread_pin_is_flagged():
+    """A thread_roots spec matching no function is itself a finding,
+    attributed to the config file (how the stale
+    run_fleet_circuits.worker pin from PR 8's refactor was caught)."""
+    kwargs = dict(CONTRACT_CLEAN["r7_clean"])
+    kwargs["thread_roots"] = ["work", "Retired._gone"]
+    got = pack_found(lint_pack("r7_clean", **kwargs))
+    assert got == [("R7", "pyproject.toml", 1)]
+    reports = lint_pack("r7_clean", **kwargs)
+    msgs = [
+        f.message for r in reports for f in r.findings
+        if r.path == "pyproject.toml"
+    ]
+    assert "Retired._gone" in msgs[0] and "stale" in msgs[0]
+
+
+def test_r9_cycle_message_carries_the_witness():
+    kwargs, _ = CONTRACT_PACKS["r9_violation"]
+    reports = lint_pack("r9_violation", **kwargs)
+    cycle_msgs = [
+        f.message for r in reports for f in r.findings
+        if "cycle" in f.message
+    ]
+    assert len(cycle_msgs) == 1
+    m = cycle_msgs[0]
+    # The witness cycle, with both hops' acquisition sites.
+    assert "locks.ALPHA -> locks.BETA -> locks.ALPHA" in m
+    assert "workers.py:13" in m and "workers.py:19" in m
+
+
+def test_r8_findings_suppressible_inline(tmp_path):
+    """A deliberately unbucketed shape is acknowledged with
+    ignore[R8] + reason, exactly like every other rule."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "kernels.py").write_text(
+        "def kernel_call(name, *ops):\n    return name, ops\n"
+    )
+    (pack / "driver.py").write_text(
+        "import numpy as np\n"
+        "from .kernels import kernel_call\n"
+        "def probe(n):\n"
+        "    # jaxlint: ignore[R8] one-off capability probe, runs once per process\n"
+        "    ops = np.zeros((n, 8))\n"
+        "    kernel_call('gate_sweep', ops)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R8"],
+        dispatch_modules=["*"], whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    assert [
+        (f.rule, r.path, f.line) for r in reports for f in r.suppressed
+    ] == [("R8", "driver.py", 5)]
+
+
+def test_r9_held_lock_suppressible_inline(tmp_path):
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "import threading\n"
+        "GUARD = threading.Lock()\n"
+        "def resolve(ctx, ops):\n"
+        "    with GUARD:\n"
+        "        # jaxlint: ignore[R9] probe path has no deadline budget; nothing can abandon it\n"
+        "        return ctx.guarded_dispatch('gate_sweep', ops)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R9"], whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    assert [
+        (f.rule, r.path, f.line) for r in reports for f in r.suppressed
+    ] == [("R9", "mod.py", 6)]
+
+
+def test_contract_pack_scan_is_deterministic():
+    for name, (kwargs, _) in sorted(CONTRACT_PACKS.items()):
+        a = [
+            (r.path, f.line, f.message)
+            for r in lint_pack(name, **kwargs)
+            for f in r.findings
+        ]
+        b = [
+            (r.path, f.line, f.message)
+            for r in lint_pack(name, **kwargs)
+            for f in r.findings
+        ]
+        assert a == b
+
+
+def test_r8_free_function_reshape_array_operand_is_not_an_axis(tmp_path):
+    """np.reshape(arr, shape): only the shape is provenance-checked —
+    the array operand must not be misread as an axis (while the method
+    form x.reshape(a, b) checks every argument)."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "kernels.py").write_text(
+        "def kernel_call(name, *ops):\n    return name, ops\n"
+    )
+    (pack / "driver.py").write_text(
+        "import numpy as np\n"
+        "from .kernels import kernel_call\n"
+        "def ok(arr, bucket):\n"
+        "    ops = np.reshape(arr, (bucket, 8))\n"
+        "    kernel_call('gate_sweep', ops)\n"
+        "def bad(arr, n):\n"
+        "    ops = arr.reshape(n, 8)\n"
+        "    kernel_call('gate_sweep', ops)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R8"],
+        dispatch_modules=["*"], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        ("R8", "driver.py", 7)
+    ]
+
+
+def test_r7_same_module_use_is_not_a_dead_declaration(tmp_path):
+    """A registry entry used elsewhere in its OWN declaring module is
+    live — only the declaration site itself is excluded from the
+    use-site census."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "registry.py").write_text(
+        "from collections import namedtuple\n"
+        "KernelDef = namedtuple('KernelDef', ['name'])\n"
+        "KERNELS = {d.name: d for d in (KernelDef('gate_sweep'),)}\n"
+        "def kernel_call(name, *ops):\n"
+        "    return KERNELS[name], ops\n"
+        "def drive(ops):\n"
+        "    return kernel_call('gate_sweep', ops)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R7"],
+        dispatch_modules=[], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == []
+
+
+def test_r9_blocking_call_behind_lockfree_wrapper_still_fires(tmp_path):
+    """A helper that wraps guarded_dispatch with no lock of its own is
+    transitively blocking — a caller holding a lock across the WRAPPER
+    is the same hazard as the direct call."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "import threading\n"
+        "GUARD = threading.Lock()\n"
+        "def helper(ctx, ops):\n"
+        "    return ctx.guarded_dispatch('gate_sweep', ops)\n"
+        "def outer(ctx, ops):\n"
+        "    with GUARD:\n"
+        "        return helper(ctx, ops)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R9"], whole_program=True,
+    )
+    got = pack_found(lint_project(config=cfg))
+    assert got == [("R9", "mod.py", 7)]
+
+
+def test_r8_constant_assigned_local_is_static(tmp_path):
+    """n = 128 is one shape forever — flagging it would force a
+    spurious ignore on innocuous code."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "kernels.py").write_text(
+        "def kernel_call(name, *ops):\n    return name, ops\n"
+    )
+    (pack / "driver.py").write_text(
+        "import numpy as np\n"
+        "from .kernels import kernel_call\n"
+        "def probe():\n"
+        "    n = 128\n"
+        "    buf = np.zeros((n, 4))\n"
+        "    kernel_call('gate_sweep', buf)\n"
+        "def churn(items):\n"
+        "    n = 128\n"
+        "    n = len(items)\n"
+        "    buf = np.zeros((n, 4))\n"
+        "    kernel_call('gate_sweep', buf)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R8"],
+        dispatch_modules=["*"], whole_program=True,
+    )
+    # probe's constant n is quiet; churn's rebound-dynamic n still fires
+    assert pack_found(lint_project(config=cfg)) == [
+        ("R8", "driver.py", 10)
+    ]
