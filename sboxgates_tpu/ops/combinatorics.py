@@ -10,6 +10,7 @@ ranges because chunks themselves are split across devices.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import os
 import queue as _queue
@@ -86,6 +87,46 @@ def unrank_combination(rank: int, n: int, k: int) -> np.ndarray:
         out[pos] = e
         e += 1
     return out
+
+
+@functools.lru_cache(maxsize=8)
+def _binom_u64(n: int, k: int) -> np.ndarray:
+    """Exact C(i, j) for i <= n, j <= k as uint64 (fits through
+    C(512, 8) ~ 4.2e17)."""
+    t = np.zeros((n + 1, k + 1), dtype=np.uint64)
+    t[:, 0] = 1
+    for i in range(1, n + 1):
+        t[i, 1:] = t[i - 1, :k] + t[i - 1, 1:]
+    return t
+
+
+def unrank_combinations(ranks, n: int, k: int) -> np.ndarray:
+    """Vectorized :func:`unrank_combination` over a batch of ranks
+    (uint64-safe, so >int32 rank spaces work): the numpy mirror of the
+    device kernels' per-lane unranking loop.  Returns [N, k] int32.
+
+    The per-row scalar loop costs O(g·k) ``math.comb`` calls per row —
+    seconds of serial Python when a hit-dense stage A materializes up to
+    LUT7_CAP rows; this form is O(n) numpy passes for the whole batch.
+    """
+    ranks = np.asarray(ranks, dtype=np.uint64)
+    m = ranks.shape[0]
+    if m == 0:
+        return np.zeros((0, k), np.int32)
+    tbl = _binom_u64(n, k)
+    pos = np.zeros(m, np.int64)
+    rem = ranks.copy()
+    out = np.zeros((k, m), np.int32)
+    lanes = np.arange(k, dtype=np.int64)[:, None]
+    for v in range(n):
+        c = tbl[max(n - v - 1, 0), np.clip(k - 1 - pos, 0, k)]
+        active = pos < k
+        take = active & (rem < c)
+        out = np.where((lanes == pos[None, :]) & take[None, :], v, out)
+        sub = active & ~take
+        rem[sub] -= c[sub]
+        pos[take] += 1
+    return np.ascontiguousarray(out.T)
 
 
 def combination_rank(combo: Sequence[int], n: int) -> int:
@@ -254,6 +295,8 @@ class ChunkPrefetcher:
         self._done = False
         self._inline = self.depth <= 1
         self._consumer_ident: Optional[int] = None
+        self._close_lock = threading.Lock()
+        self._closed_flag = False
         if not self._inline:
             self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
             self._stop = threading.Event()
@@ -344,18 +387,53 @@ class ChunkPrefetcher:
         return item
 
     def close(self) -> None:
-        """Stops the worker promptly and joins it (idempotent)."""
+        """Stops the worker promptly and joins it.
+
+        Idempotent and safe against every unwind path a failed search
+        takes: a second ``close()`` (consumer ``__exit__`` after a
+        supervising thread already closed) returns without touching the
+        drained queue, the queue is drained BOTH before and after the
+        join (the producer may legally complete one more ``_put`` after
+        the first drain — without the second pass those chunk arrays
+        would pin memory for the prefetcher's lifetime), and a sentinel
+        ``None`` is left for any consumer currently blocked inside
+        ``get()`` so it observes end-of-stream instead of hanging on the
+        emptied queue forever.  A worker that still won't join within
+        the timeout is surfaced as a warning — a silently leaked
+        producer thread outliving its failed search is exactly the bug
+        this guards against."""
+        with self._close_lock:
+            already = self._closed_flag
+            self._closed_flag = True
         self._done = True
-        if self._inline:
+        if self._inline or already:
             return
         self._stop.set()
         # Drain so a producer blocked on a full queue can observe _stop.
+        self._drain()
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sbg-chunk-prefetch worker did not join within 10 s; "
+                "a producer thread may outlive this search"
+            )
+        # The producer may have completed one final _put between the
+        # drain and its _stop check; drop it so no chunk arrays stay
+        # pinned, then leave a sentinel for a consumer blocked in get().
+        self._drain()
+        try:
+            self._q.put_nowait(None)
+        except _queue.Full:  # pragma: no cover - depth >= 1 always fits
+            pass
+
+    def _drain(self) -> None:
         try:
             while True:
                 self._q.get_nowait()
         except _queue.Empty:
             pass
-        self._thread.join(timeout=10.0)
 
     @property
     def closed(self) -> bool:

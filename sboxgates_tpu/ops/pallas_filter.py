@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the 5-LUT stage-A feasibility scan.
+
+The XLA formulation of the per-chunk cell-constraint computation
+(``sweeps._cell_constraints_t``) materializes the [32, W, N] cell masks
+and the two [32, N] requirement booleans through HBM before packing them
+down to two uint32[N] constraint words — for a 2^17-row chunk that is
+~34 MB of boolean intermediates per dispatch round, an order of magnitude
+more traffic than the packed outputs.  This kernel fuses the whole
+per-chunk epilogue in VMEM blocks:
+
+- split the candidate axis into lane-sized blocks and expand the 32
+  Karnaugh cells of each block's 5 gathered table rows in-register (the
+  doubling recurrence of ``_cell_constraints_t``);
+- intersect every cell with the required-1/required-0 position sets and
+  reduce over the 8 truth-table words;
+- pack the 32 per-cell bits into one uint32 word per candidate and write
+  ONLY those (plus nothing else) back to HBM.
+
+The candidate gather (``tables[combos]``) stays in XLA — it is a memory
+op Mosaic has no better schedule for — so the kernel's operands are the
+already-transposed ``[5, W, N]`` table rows.
+
+Bit-identical to the XLA path by construction (same cell order: cell
+index bit (k-1-i) is input i's value, so input 0 is the MSB — and the
+``_pack_bits_t`` bit-j-equals-cell-j packing); parity is enforced by
+``tests/test_sweeps.py`` in interpreter mode.  The dispatch-side
+fallback (a failed Mosaic lowering drops to the XLA epilogue with a
+rate-limited note) rides the shared pallas->xla signal in
+``parallel/mesh.py``, like the pivot kernels'.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Candidates per VMEM block: the in-flight cell masks are
+# [32, 8, BLOCK_N] int32 = 512 KiB at 512 lanes — comfortably inside the
+# ~16 MB/core VMEM budget with pipeline double-buffering.
+BLOCK_N = 512
+
+
+def _cells_i32(tabs):
+    """[5, W, BN] int32 table rows -> [32, W, BN] int32 cell masks via the
+    doubling recurrence of sweeps._cell_constraints_t (reverse input
+    order so input 0 lands on the cell-index MSB)."""
+    full = jnp.full(tabs.shape[1:], -1, dtype=jnp.int32)[None]
+    cells = full                                  # [1, W, BN]
+    for i in range(4, -1, -1):
+        t = tabs[i][None]
+        cells = jnp.concatenate([cells & ~t, cells & t], axis=0)
+    return cells                                  # [32, W, BN]
+
+
+@functools.partial(jax.jit, static_argnames=("bn", "interpret"))
+def lut5_filter_cells(tabs, target, mask, *, bn=BLOCK_N, interpret=False):
+    """Packed cell constraints for a chunk of 5-tuples, fused in VMEM.
+
+    ``tabs``: uint32[5, W, N] gathered candidate table rows (candidate
+    axis minormost — the sweep layout); ``target``/``mask``: uint32[W].
+    Returns (req1, req0) uint32[N], bit-identical to
+    ``_pack_bits_t(_cell_constraints_t(tabs, target, mask))``.
+    """
+    from jax.experimental import pallas as pl
+
+    n = tabs.shape[2]
+    assert n % bn == 0, (n, bn)
+    w = tabs.shape[1]
+
+    def kernel(t_ref, need1_ref, need0_ref, r1_ref, r0_ref):
+        cells = _cells_i32(t_ref[:])              # [32, W, bn] i32
+        need1 = need1_ref[:].reshape(1, w, 1)
+        need0 = need0_ref[:].reshape(1, w, 1)
+        req1 = ((cells & need1) != 0).any(axis=1)  # [32, bn]
+        req0 = ((cells & need0) != 0).any(axis=1)
+        sh = jax.lax.broadcasted_iota(jnp.int32, (32, 1), 0)
+        # bit j of the packed word = cell j (the _pack_bits_t order);
+        # disjoint bits, so the int32 sum over cells equals the OR —
+        # including cell 31 on the sign bit.
+        r1_ref[:] = (req1.astype(jnp.int32) << sh).sum(axis=0)[None]
+        r0_ref[:] = (req0.astype(jnp.int32) << sh).sum(axis=0)[None]
+
+    as_i32 = lambda a: jax.lax.bitcast_convert_type(a, jnp.int32)
+    need1 = as_i32(mask & target).reshape(1, w)
+    need0 = as_i32(mask & ~target).reshape(1, w)
+    req1, req0 = pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((5, w, bn), lambda i: (0, 0, i)),
+            pl.BlockSpec((1, w), lambda i: (0, 0)),
+            pl.BlockSpec((1, w), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+        ],
+        interpret=interpret,
+    )(as_i32(tabs), need1, need0)
+    return (
+        jax.lax.bitcast_convert_type(req1[0], jnp.uint32),
+        jax.lax.bitcast_convert_type(req0[0], jnp.uint32),
+    )

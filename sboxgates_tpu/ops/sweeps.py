@@ -1713,3 +1713,410 @@ def solve_inner_function(
         free = ~setmask & ((1 << num_f) - 1)
         func |= int(rng.integers(0, 1 << num_f)) & free
     return func
+
+
+# -------------------------------------------------------------------------
+# Wide (64-bit) rank streaming
+#
+# The int32 device streams above cover C(g, k) < 2^31; larger spaces
+# (C(g, 7) crosses at g = 76) historically fell back to host-side chunk
+# enumeration (ops.combinatorics.ChunkPrefetcher: unrank + filter + pad on
+# a host thread, one upload per chunk).  These kernels extend the
+# device-resident enumeration to ranks up to 2^64 by carrying every rank
+# as a (lo, hi) uint32 pair — the binomial table, the loop cursor, and the
+# per-lane remainders all do double-word arithmetic — so the whole space
+# sweeps inside one while_loop dispatch exactly like feasible_stream, and
+# the ChunkPrefetcher is demoted to the CPU/degraded fallback path.
+# -------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def binom_table_wide(max_n: int = 513, max_k: int = 8):
+    """Exact C(n, k) for n < max_n, k <= max_k as two uint32 planes
+    (lo, hi): C(512, 8) ~ 4.2e17 needs 59 bits, far past the saturating
+    uint32 table :func:`binom_table` serves the int32 streams.  Built
+    from the ONE exact-u64 Pascal construction
+    (combinatorics._binom_u64), which also feeds the host batch
+    unranker — the two sides can never diverge."""
+    from .combinatorics import _binom_u64
+
+    t = _binom_u64(max_n - 1, max_k)
+    lo = (t & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (t >> np.uint64(32)).astype(np.uint32)
+    return lo, hi
+
+
+def _pair_lt(alo, ahi, blo, bhi):
+    """Unsigned 64-bit a < b over (lo, hi) uint32 pairs (elementwise)."""
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def _unrank_combos_wide(blo, bhi, g, k, rlo, rhi):
+    """64-bit twin of :func:`_unrank_combos`: lexicographic unranking with
+    (lo, hi) uint32 pair remainders.  blo/bhi: [513, 9] uint32 planes;
+    rlo/rhi: [N] uint32 rank halves (each < C(g, k)).  Returns combos
+    [k, N] int32 — the combination VALUES still fit int32 (< 513); only
+    the ranks need the pair arithmetic."""
+    n = rlo.shape[0]
+    pos0 = jnp.zeros(n, jnp.int32)
+    out0 = jnp.zeros((k, n), jnp.int32)
+
+    def body(v, state):
+        pos, rem_lo, rem_hi, out = state
+        row = jnp.maximum(g - v - 1, 0)
+        col = jnp.clip(k - 1 - pos, 0, 8)
+        c_lo = blo[row][col]
+        c_hi = bhi[row][col]
+        active = pos < k
+        take = active & _pair_lt(rem_lo, rem_hi, c_lo, c_hi)
+        sel = (jnp.arange(k, dtype=jnp.int32)[:, None] == pos[None, :]) & take[None, :]
+        out = jnp.where(sel, v, out)
+        sub = active & ~take
+        borrow = (rem_lo < c_lo).astype(jnp.uint32)
+        rem_lo = jnp.where(sub, rem_lo - c_lo, rem_lo)
+        rem_hi = jnp.where(sub, rem_hi - c_hi - borrow, rem_hi)
+        pos = pos + take.astype(jnp.int32)
+        return pos, rem_lo, rem_hi, out
+
+    _, _, _, out = jax.lax.fori_loop(0, g, body, (pos0, rlo, rhi, out0))
+    return out
+
+
+def _stream_chunk_constraints_wide(
+    tables, blo, bhi, g, k, target, mask, excl, base_lo, base_hi,
+    total_lo, total_hi, chunk, backend="xla",
+):
+    """64-bit twin of :func:`_stream_chunk_constraints`: the chunk's ranks
+    are base + arange(chunk) in pair arithmetic.  ``backend="pallas"``
+    (k=5 only) runs the cell-constraint epilogue as the fused VMEM
+    kernel (ops/pallas_filter.py) — bit-identical words.  Returns
+    (feasible [chunk] bool, req1 packed, req0 packed)."""
+    i = jnp.arange(chunk, dtype=jnp.uint32)
+    rlo = base_lo + i
+    rhi = base_hi + (rlo < base_lo).astype(jnp.uint32)
+    valid = _pair_lt(rlo, rhi, total_lo, total_hi)
+    # Clamp invalid lanes to total - 1 so the unrank loop stays in range.
+    tb = (total_lo == 0).astype(jnp.uint32)
+    tm1_lo = total_lo - jnp.uint32(1)
+    tm1_hi = total_hi - tb
+    combos = _unrank_combos_wide(
+        blo, bhi, g, k,
+        jnp.where(valid, rlo, tm1_lo), jnp.where(valid, rhi, tm1_hi),
+    )
+    hit_excl = (combos[:, :, None] == excl[None, None, :]).any(axis=(0, 2))
+    valid = valid & ~hit_excl
+    tabs = jnp.transpose(tables[combos], (0, 2, 1))          # [k, W, N]
+    if backend == "pallas":
+        assert k == 5, "pallas filter epilogue is k=5 only"
+        from .pallas_filter import lut5_filter_cells
+
+        r1p, r0p = lut5_filter_cells(
+            tabs, target, mask,
+            interpret=jax.default_backend() == "cpu",
+        )
+        feasible = valid & ((r1p & r0p) == 0)
+        return feasible, r1p, r0p
+    req1, req0 = _cell_constraints_t(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=0)
+    return feasible, _pack_bits_t(req1), _pack_bits_t(req0)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk", "backend"))
+def feasible_stream_wide(
+    tables, binom_lo, binom_hi, g, target, mask, excl,
+    start_lo, start_hi, total_lo, total_hi, *, k, chunk, backend="xla",
+):
+    """64-bit-rank sibling of :func:`feasible_stream`: sweeps ranks
+    [start, total) — each a uint32 (lo, hi) pair — in chunks inside one
+    dispatch, stopping at the first chunk containing a feasible k-tuple.
+
+    Returns (verdict int32[3] packed as [found, cstart_lo, cstart_hi],
+    feasible [chunk] bool, req1, req0 packed).  The chunk-start halves are
+    bitcast int32; callers reassemble ``cstart = lo + (hi << 32)`` as
+    unsigned and derive examined-rank counts host-side (an in-kernel
+    count would need the same pair arithmetic for no benefit — the host
+    already holds start/total as Python ints).  ``backend`` picks the
+    per-chunk cell-constraint epilogue: ``"pallas"`` (k=5) fuses it in
+    VMEM (ops/pallas_filter.py), bit-identical to the XLA default."""
+    start_lo = jnp.asarray(start_lo, jnp.uint32)
+    start_hi = jnp.asarray(start_hi, jnp.uint32)
+    total_lo = jnp.asarray(total_lo, jnp.uint32)
+    total_hi = jnp.asarray(total_hi, jnp.uint32)
+    r1_0 = jnp.zeros((chunk,) if k <= 5 else (chunk, (1 << k) // 32), jnp.uint32)
+    init = (
+        start_lo, start_hi, jnp.bool_(False), start_lo, start_hi,
+        jnp.zeros(chunk, bool), r1_0, r1_0,
+    )
+
+    def cond(s):
+        nlo, nhi, found = s[0], s[1], s[2]
+        return (~found) & _pair_lt(nlo, nhi, total_lo, total_hi)
+
+    def body(s):
+        nlo, nhi = s[0], s[1]
+        feasible, r1, r0 = _stream_chunk_constraints_wide(
+            tables, binom_lo, binom_hi, g, k, target, mask, excl,
+            nlo, nhi, total_lo, total_hi, chunk, backend=backend,
+        )
+        xlo = nlo + jnp.uint32(chunk)
+        xhi = nhi + (xlo < nlo).astype(jnp.uint32)
+        return (xlo, xhi, feasible.any(), nlo, nhi, feasible, r1, r0)
+
+    _, _, found, clo, chi, feasible, r1, r0 = jax.lax.while_loop(
+        cond, body, init
+    )
+    verdict = jnp.stack(
+        [found.astype(jnp.int32), _bitcast_i32(clo), _bitcast_i32(chi)]
+    )
+    return verdict, feasible, r1, r0
+
+
+# -------------------------------------------------------------------------
+# 5-LUT feasibility filter head (XLA + hand-written pallas backend)
+# -------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("backend",))
+def lut5_filter(tables, combos, valid, target, mask, *, backend="xla"):
+    """Stage-A feasibility filter specialized to 5-tuples — the hottest
+    per-chunk head of the big-space streams (ROOFLINE.md): same contract
+    as :func:`lut_filter` (feasible, req1 packed, req0 packed), plus a
+    hand-written Pallas backend (``backend="pallas"``,
+    ops/pallas_filter.py) that fuses the 32-cell expansion, the
+    required-set intersection tests, and the bit packing in VMEM blocks
+    — the [32, W, N] boolean intermediates the XLA formulation
+    materializes through HBM never leave the core.  The candidate gather
+    stays XLA either way (it is a memory op Mosaic has no better
+    schedule for).  Bit-identical verdicts for both backends
+    (parity-tested in interpreter mode); the dispatch-side fallback
+    signal lives with the pivot one in parallel/mesh.py."""
+    tabs = jnp.transpose(tables[combos], (1, 2, 0))          # [5, W, N]
+    if backend == "pallas":
+        from .pallas_filter import lut5_filter_cells
+
+        r1, r0 = lut5_filter_cells(
+            tabs, target, mask,
+            interpret=jax.default_backend() == "cpu",
+        )
+        feasible = valid & ((r1 & r0) == 0)
+        return feasible, r1, r0
+    if backend != "xla":
+        raise ValueError(f"unknown filter backend {backend!r}")
+    req1, req0 = _cell_constraints_t(tabs, target, mask)
+    feasible = valid & ~(req1 & req0).any(axis=0)
+    return feasible, _pack_bits_t(req1), _pack_bits_t(req0)
+
+
+# -------------------------------------------------------------------------
+# Fused multi-round search driver
+#
+# Every round of the greedy chain workloads used to cost one full host
+# round trip: dispatch the sweep, sync the verdict, append the found gate
+# to the host State, re-upload the mutated table array, dispatch the next
+# round.  round_driver keeps the whole search state DEVICE-RESIDENT — the
+# padded table array is a while_loop carry, the per-round targets/masks
+# ride as [max_rounds, W] operands, and a hit's new gate table is computed
+# from its operand rows and written into the array with
+# dynamic_update_slice — so the host syncs ONCE per up-to-max_rounds
+# rounds, on a compact hit journal it replays onto the State afterwards.
+# -------------------------------------------------------------------------
+
+
+def _eval_lut_words(func, ta, tb, tc):
+    """Device twin of :func:`sboxgates_tpu.core.ttable.eval_lut` for
+    single uint32[W] table rows: bit k of ``func`` is the output for
+    inputs k = A<<2 | B<<1 | C.  ``func`` may be traced."""
+    fu = jnp.asarray(func, jnp.uint32)
+    out = jnp.zeros_like(ta)
+    for j in range(8):
+        m = ta if (j >> 2) & 1 else ~ta
+        m = m & (tb if (j >> 1) & 1 else ~tb)
+        m = m & (tc if j & 1 else ~tc)
+        sel = jnp.uint32(0) - ((fu >> j) & jnp.uint32(1))
+        out = out | (m & sel)
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk3", "chunk5", "has5", "max_rounds", "solve_rows"),
+)
+def round_driver(
+    tables, binom, g0, targets, masks, excl, seeds, dc_draws, n_rounds,
+    total5_cap, splits, w_tab, m_tab,
+    *, chunk3, chunk5, has5, max_rounds, solve_rows=1024,
+):
+    """Up to ``n_rounds`` greedy search rounds in ONE dispatch.
+
+    Round r tries, against the CURRENT table array: (1) an existing gate
+    matching targets[r] under masks[r] (newest-first selection, the
+    :func:`match_scan` scan order), (2) the complement of one (appends a
+    NOT row), (3) the whole-space 3-LUT stream
+    (:func:`_lut3_stream_core` — appends one LUT row), and (4, when
+    ``has5``) the small-space 5-LUT stream (:func:`_lut5_stream_core` —
+    appends the outer and inner LUT rows of the decomposition).  A hit
+    computes the new gate row(s) from the winning operands with
+    :func:`_eval_lut_words` and writes them at the live height ``g``;
+    the next round sweeps the grown array without any host involvement.
+    A miss (or an in-kernel 5-LUT solver overflow) freezes the loop so
+    the host can run the full recursive search for that round.
+
+    tables: [B, W] uint32 zero-padded to its gate bucket — the append
+    capacity; the caller must guarantee g0 + 2 * n_rounds <= B.
+    targets/masks: [max_rounds, W] uint32; seeds/dc_draws: [max_rounds]
+    int32 pre-drawn per-round kernel seeds and don't-care fill bytes
+    (both drawn in ONE host block per chain segment, so the PRNG stream
+    is identical for every rounds-per-dispatch choice).  total5_cap:
+    int32 scalar — rounds whose C(g, 5) meets or exceeds it skip the
+    in-kernel 5-LUT stream (the pivot-sized spaces the host runs
+    separately).  splits/w_tab/m_tab: :func:`lut5_split_tables`.
+
+    Returns int32 [max_rounds + 1, 8]: row r =
+    [kind, x0, x1, x2, x3, ex3, ex5, 0] with kind
+      0 = miss (host runs the full search for round r)
+      1 = existing gate          (x0 = gate id; nothing appended)
+      2 = complement             (x0 = gate id; one NOT row appended)
+      3 = 3-LUT                  (x0 = rank, x1 = func byte)
+      4 = 5-LUT                  (x0 = rank, x1 = sigma, x2 = func_outer,
+                                  x3 = func_inner; two rows appended)
+      5 = 5-LUT solver overflow  (x0 = chunk start; host takes the round)
+    ex3/ex5 count candidate ranks the round's streams examined.  The
+    final row is [rounds_done, g_final, 0, ...]: rounds_done < n_rounds
+    means round rounds_done missed and its row holds the miss marker.
+    """
+    B = tables.shape[0]
+    z = jnp.int32(0)
+    g0 = jnp.asarray(g0, jnp.int32)
+    n_rounds = jnp.asarray(n_rounds, jnp.int32)
+    hits0 = jnp.zeros((max_rounds, 8), jnp.int32)
+    init = (z, g0, tables, jnp.bool_(False), hits0)
+
+    def cond(s):
+        r, stop = s[0], s[3]
+        return (~stop) & (r < n_rounds)
+
+    def body(s):
+        r, g, tabs, _, hits = s
+        target = targets[r]
+        maskr = masks[r]
+        seed = seeds[r]
+        dc = dc_draws[r]
+        valid = jnp.arange(B) < g
+        eq = tt.eq_mask(tabs, target, maskr) & valid
+        neq = tt.eq_mask(~tabs, target, maskr) & valid
+        sprio = _priority(B, seed, det_newest=True)
+        direct = eq.any()
+        scan_found = direct | neq.any()
+        scan_gid = jnp.where(
+            direct,
+            jnp.argmax(jnp.where(eq, sprio, 0)),
+            jnp.argmax(jnp.where(neq, sprio, 0)),
+        ).astype(jnp.int32)
+
+        def pack_row(kind, x0=z, x1=z, x2=z, x3=z, ex3=z, ex5=z):
+            return jnp.stack(
+                [jnp.asarray(kind, jnp.int32), x0, x1, x2, x3, ex3, ex5, z]
+            )
+
+        def scan_hit(_):
+            comp = ~tabs[scan_gid]
+            appended = jax.lax.dynamic_update_slice(tabs, comp[None], (g, z))
+            tabs_out = jnp.where(direct, tabs, appended)
+            g_out = g + jnp.where(direct, 0, 1)
+            return pack_row(jnp.where(direct, 1, 2), scan_gid), tabs_out, g_out
+
+        def try_lut3(_):
+            total3 = binom[g, 3].astype(jnp.int32)
+            f3, rank3, r1c, r0c, ex3 = _lut3_stream_core(
+                tabs, binom, g, target, maskr, excl, z, total3,
+                seed ^ 0x55D3, chunk3,
+            )
+
+            def lut3_hit(_):
+                func = (r1c | (dc & ~(r1c | r0c))) & 0xFF
+                combo = _unrank_combos(binom, g, 3, rank3[None])
+                newtab = _eval_lut_words(
+                    func, tabs[combo[0, 0]], tabs[combo[1, 0]],
+                    tabs[combo[2, 0]],
+                )
+                tabs_out = jax.lax.dynamic_update_slice(
+                    tabs, newtab[None], (g, z)
+                )
+                return (
+                    pack_row(3, rank3, func, ex3=ex3), tabs_out, g + 1
+                )
+
+            def try_lut5(_):
+                if not has5:
+                    return pack_row(0, ex3=ex3), tabs, g
+                total5u = binom[g, 5]
+                small5 = (g >= 5) & (
+                    total5u < jnp.asarray(total5_cap, jnp.uint32)
+                )
+                total5 = jnp.where(
+                    small5, total5u.astype(jnp.int32), z
+                )
+                status, rank5, sigma, fo, sr1, sr0, cstart, ex5 = (
+                    _lut5_stream_core(
+                        tabs, binom, g, target, maskr, excl, z, total5,
+                        w_tab, m_tab, seed ^ 0x1BF5, chunk5, solve_rows,
+                    )
+                )
+
+                def lut5_hit(_):
+                    combo5 = _unrank_combos(binom, g, 5, rank5[None])[:, 0]
+                    perm = splits[sigma]
+                    ga, gb, gc = combo5[perm[0]], combo5[perm[1]], combo5[perm[2]]
+                    gd, ge = combo5[perm[3]], combo5[perm[4]]
+                    outer_tab = _eval_lut_words(fo, tabs[ga], tabs[gb], tabs[gc])
+                    r1u = jax.lax.bitcast_convert_type(sr1, jnp.uint32)
+                    r0u = jax.lax.bitcast_convert_type(sr0, jnp.uint32)
+                    w = w_tab[sigma, fo]
+                    func_inner = z
+                    # Group j = 4*o + m: inner-LUT cells where the outer
+                    # output is o and the (d, e) pattern is m — the
+                    # grouping _decode_lut5 / solve_inner_function apply
+                    # on the host, with dc filling the unconstrained
+                    # groups (the reference's randomized don't-cares).
+                    for j in range(8):
+                        o, m = j >> 2, j & 3
+                        cells = m_tab[sigma, m] & (w if o else ~w)
+                        has1 = (r1u & cells) != 0
+                        setb = ((r1u | r0u) & cells) != 0
+                        dcb = (dc >> j) & 1
+                        bit = jnp.where(has1, 1, jnp.where(setb, 0, dcb))
+                        func_inner = func_inner | (bit << j)
+                    inner_tab = _eval_lut_words(
+                        func_inner, outer_tab, tabs[gd], tabs[ge]
+                    )
+                    t1 = jax.lax.dynamic_update_slice(
+                        tabs, outer_tab[None], (g, z)
+                    )
+                    t2 = jax.lax.dynamic_update_slice(
+                        t1, inner_tab[None], (g + 1, z)
+                    )
+                    return (
+                        pack_row(4, rank5, sigma, fo, func_inner, ex3, ex5),
+                        t2, g + 2,
+                    )
+
+                def lut5_miss(_):
+                    kind = jnp.where(status == 2, 5, 0)
+                    x0 = jnp.where(status == 2, cstart, z)
+                    return pack_row(kind, x0, ex3=ex3, ex5=ex5), tabs, g
+
+                return jax.lax.cond(status == 1, lut5_hit, lut5_miss, None)
+
+            return jax.lax.cond(f3, lut3_hit, try_lut5, None)
+
+        row, tabs_out, g_out = jax.lax.cond(
+            scan_found, scan_hit, try_lut3, None
+        )
+        hits_out = jax.lax.dynamic_update_slice(hits, row[None], (r, z))
+        stop = (row[0] == 0) | (row[0] == 5)
+        r_out = r + jnp.where(stop, 0, 1)
+        return (r_out, g_out, tabs_out, stop, hits_out)
+
+    r_f, g_f, _, _, hits = jax.lax.while_loop(cond, body, init)
+    tail = jnp.concatenate([jnp.stack([r_f, g_f]), jnp.zeros(6, jnp.int32)])
+    return jnp.concatenate([hits, tail[None]], axis=0)

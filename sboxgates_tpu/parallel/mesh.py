@@ -524,6 +524,45 @@ def _note_pallas_fallback(backend: str, stats) -> None:
         )
 
 
+#: Filter-head sibling of the pivot fallback tally above (shared lock;
+#: separate counter so the two degradations stay distinguishable).
+_FILTER_FALLBACKS = 0
+
+
+def filter_fallback_count() -> int:
+    """How many 5-LUT feasibility-filter dispatches fell back from the
+    pallas kernel to the XLA epilogue in this process."""
+    return _FILTER_FALLBACKS
+
+
+def note_filter_pallas_fallback(backend: str, stats, exc=None) -> None:
+    """The lut5 feasibility-filter head's pallas->xla degradation signal
+    (search.lut routes here on a failed Mosaic lowering): same
+    lock-protected counter + structured instant + rate-limited stderr
+    pattern as :func:`_note_pallas_fallback`, so every pallas head in
+    the tree degrades through one visible mechanism."""
+    global _FILTER_FALLBACKS
+    with _PALLAS_LOCK:
+        _FILTER_FALLBACKS += 1
+        n = _FILTER_FALLBACKS
+    _tmetrics.bump(stats, "filter_pallas_fallbacks")
+    _tmetrics.GLOBAL.inc("filter_pallas_fallbacks")
+    _ttrace.instant(
+        "pallas_fallback", "fallback", backend=backend, head="lut5_filter",
+        n=n,
+    )
+    if n <= _PALLAS_PRINT_FIRST or n % _PALLAS_PRINT_EVERY == 0:
+        why = f" ({exc})" if exc is not None else ""
+        print(
+            f"sboxgates_tpu: SBG_FILTER_BACKEND={backend!r} failed to "
+            "lower; the 5-LUT feasibility filter falls back to the XLA "
+            f"epilogue (bit-identical results){why} "
+            f"[fallback #{n} this process]",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def pivot_accum_name(backend: str) -> str:
     """Count-matrix accumulation dtype name for a pivot backend — ONE
     mapping shared by the live dispatch statics below and the mesh

@@ -375,6 +375,7 @@ class SearchContext:
         self._gate_engine_caller = None
         self._lut_engine_caller = None
         self._binom = None
+        self._binom_wide = None
         self._lut5_tabs = None
         self._lut7_tabs_cache = None
         self._native_probe = None
@@ -647,6 +648,7 @@ class SearchContext:
         if demoted:
             self.mesh_plan = None
             self._binom = None
+            self._binom_wide = None
             self._pair_combo_cache.clear()
             self.invalidate_device_tables()
         path = _tflight.flight_dump(
@@ -990,6 +992,19 @@ class SearchContext:
         if self._binom is None:
             self._binom = self.place_replicated(sweeps.binom_table())
         return self._binom
+
+    @property
+    def binom_wide(self):
+        """Device-resident exact (lo, hi) uint32 binomial planes for the
+        64-bit-rank streams (sweeps.feasible_stream_wide) — the device
+        enumeration that replaced the host ChunkPrefetcher path for
+        spaces past int32 rank arithmetic."""
+        if self._binom_wide is None:
+            lo, hi = sweeps.binom_table_wide()
+            self._binom_wide = (
+                self.place_replicated(lo), self.place_replicated(hi)
+            )
+        return self._binom_wide
 
     @staticmethod
     def excl_array(inbits) -> np.ndarray:

@@ -642,12 +642,14 @@ def lut5_search(ctx: SearchContext, st: State, target, mask, inbits) -> Optional
         comb.n_choose_k(g, 5) < PIVOT_MIN_TOTAL
         and not sweeps.device_rank_limit(g, 5)
     ):
-        # Host-routed outright: the circuit breaker tripped (a prior
-        # dispatch exhausted its whole retry schedule — re-probing a dead
-        # device per node would stall budget*(retries+1) every time), or
-        # the rank exceeds int32 and there is no device path to degrade
-        # from.  Either way a host-driver DispatchTimeout must propagate,
-        # never trigger a second fallback run.
+        # Routed to the big-space driver outright: the circuit breaker
+        # tripped (a prior dispatch exhausted its whole retry schedule —
+        # re-probing a dead device per node would stall
+        # budget*(retries+1) every time), or the rank exceeds int32 so
+        # the int32 streams below cannot express it.  _lut5_search_host
+        # owns its own degradation ladder since the 64-bit device
+        # enumeration: device-resident wide stream -> breaker trip +
+        # host chunk stream -> loud DispatchTimeout (never a third run).
         return _lut5_search_host(ctx, st, target, mask, inbits)
     try:
         return _lut5_search_device(ctx, st, target, mask, inbits)
@@ -848,12 +850,192 @@ def lut5_resume_overflow(
     return res
 
 
+def filter_backend() -> str:
+    """Stage-A 5-LUT feasibility filter backend (SBG_FILTER_BACKEND,
+    default xla): ``pallas`` runs the chunk's 32-cell expansion +
+    required-set tests + bit packing as the fused VMEM kernel
+    (ops/pallas_filter.py) instead of the XLA epilogue that round-trips
+    the [32, W, N] boolean intermediates through HBM.  Bit-identical
+    verdicts (parity-tested); a failed Mosaic lowering latches back to
+    xla with the shared rate-limited fallback note
+    (parallel.mesh.note_filter_pallas_fallback)."""
+    import os
+
+    return os.environ.get("SBG_FILTER_BACKEND", "xla")
+
+
+# Latch for a failed pallas filter lowering: probe once, degrade to the
+# XLA epilogue for the rest of the process (mutated only under the lock —
+# concurrent mux-branch threads reach the filter dispatch sites).
+_FILTER_LOCK = threading.Lock()
+_FILTER_PALLAS_BROKEN = False
+
+
+def _filter_pallas_ok() -> bool:
+    return filter_backend() == "pallas" and not _FILTER_PALLAS_BROKEN
+
+
+def _latch_filter_xla(ctx: SearchContext, exc: BaseException) -> None:
+    global _FILTER_PALLAS_BROKEN
+    with _FILTER_LOCK:
+        _FILTER_PALLAS_BROKEN = True
+    from ..parallel.mesh import note_filter_pallas_fallback
+
+    note_filter_pallas_fallback("pallas", ctx.stats, exc)
+
+
+def _filter_call(ctx: SearchContext, tables, chunk_placed, valid, jt, jm, g, k):
+    """One stage-A filter dispatch: the k=5 head honors the
+    SBG_FILTER_BACKEND lever (pallas -> xla latch on lowering failure);
+    every other arity — and the latched path — takes the generic
+    :func:`sboxgates_tpu.ops.sweeps.lut_filter` kernel."""
+    if k == 5 and _filter_pallas_ok():
+        try:
+            return ctx.kernel_call(
+                "lut5_filter", dict(backend="pallas"),
+                (tables, chunk_placed, valid, jt, jm), g=g,
+            )
+        except Exception as e:  # jaxlint: ignore[R5] deliberate degrade: a failed Mosaic lowering (any of several jax error types) latches the filter to the XLA epilogue — bit-identical — and the shared fallback signal logs it
+            _latch_filter_xla(ctx, e)
+    return ctx.kernel_call(
+        "lut_filter", {}, (tables, chunk_placed, valid, jt, jm), g=g
+    )
+
+
+def _device_enum_enabled() -> bool:
+    """SBG_DEVICE_ENUM=0 forces the host ChunkPrefetcher enumeration
+    even on healthy device backends (an A/B + escape lever)."""
+    import os
+
+    return os.environ.get("SBG_DEVICE_ENUM", "1") != "0"
+
+
+def _feasible_chunks(
+    ctx: SearchContext, st: State, target, mask, inbits,
+    k: int, chunk_cap: int, stat_key: str, phase: str,
+):
+    """Feasibility-chunk stream for spaces beyond int32 rank arithmetic:
+    routes to the device-resident 64-bit enumeration
+    (:func:`_device_feasible_chunks` — unranking inside the kernel's
+    while_loop, no host combination materialization) on healthy
+    single-plan backends, and to the host ChunkPrefetcher pipeline
+    (:func:`_host_feasible_chunks`) on the CPU-fallback path: a tripped
+    device breaker, a candidate mesh (the sharded streams own that
+    placement), or an explicit SBG_DEVICE_ENUM=0.
+
+    Both streams yield ``(combos_fn, feasible, req1p, req0p)`` per
+    verdict-true chunk in strict rank order — ``combos_fn(rows)``
+    materializes just the hit rows' combinations — so consumers are
+    routing-blind.  Candidate accounting differs by construction: the
+    device stream charges RANKS examined (excluded combinations are
+    masked, not skipped), the host stream charges post-filter rows."""
+    if (
+        ctx.mesh_plan is None
+        and not ctx.device_degraded
+        and _device_enum_enabled()
+    ):
+        return _device_feasible_chunks(
+            ctx, st, target, mask, inbits, k, chunk_cap, stat_key, phase
+        )
+    return _host_feasible_chunks(
+        ctx, st, target, mask, inbits, k, chunk_cap, stat_key, phase
+    )
+
+
+def _device_feasible_chunks(
+    ctx: SearchContext, st: State, target, mask, inbits,
+    k: int, chunk_cap: int, stat_key: str, phase: str,
+):
+    """Device-resident feasibility stream for >int32-rank spaces: one
+    :func:`sboxgates_tpu.ops.sweeps.feasible_stream_wide` dispatch sweeps
+    from the resume point to the next feasible chunk (ranks carried as
+    uint32 pairs, unranking on device), so the host never unranks,
+    filters, or uploads combination chunks — the work the
+    ChunkPrefetcher thread existed to hide.  Yields the router's
+    ``(combos_fn, feasible, req1p, req0p)`` tuples; a deadline breach
+    propagates :class:`DispatchTimeout` for the consumer to degrade to
+    the host stream."""
+    g = st.num_gates
+    total = comb.n_choose_k(g, k)
+    if total <= 0:
+        return
+    chunk = pick_chunk(total, chunk_cap)
+    tables = ctx.device_tables(st)
+    blo, bhi = ctx.binom_wide
+    jt = ctx.place_replicated(np.asarray(target))
+    jm = ctx.place_replicated(np.asarray(mask))
+    jexcl = ctx.place_replicated(ctx.excl_array(inbits))
+    # Mutable cell, not a per-def default: the deadline guard's on_retry
+    # re-issues through the SAME closure, and a pallas->xla latch must
+    # apply to those re-issues too (a def-time default would retry the
+    # broken lowering and escape the DispatchTimeout degradation path).
+    be = {"backend": "pallas" if (k == 5 and _filter_pallas_ok()) else "xla"}
+    ckey = threading.get_ident()
+    start = 0
+    while start < total:
+
+        def issue(s=start):
+            return ctx.kernel_call(
+                "feasible_stream_wide",
+                dict(k=k, chunk=chunk, backend=be["backend"]),
+                (
+                    tables, blo, bhi, g, jt, jm, jexcl,
+                    np.uint32(s & 0xFFFFFFFF), np.uint32(s >> 32),
+                    np.uint32(total & 0xFFFFFFFF), np.uint32(total >> 32),
+                ),
+                g=g,
+            )
+
+        try:
+            pending = {"out": issue()}
+        except Exception as e:
+            # Deliberate degrade: a failed Mosaic lowering of the
+            # in-stream pallas filter latches to the XLA epilogue
+            # (bit-identical) and re-issues; anything else propagates.
+            if be["backend"] != "pallas":
+                raise
+            _latch_filter_xla(ctx, e)
+            be["backend"] = "xla"
+            pending = {"out": issue()}
+        v = ctx.guarded_dispatch(
+            # jaxlint: ignore[R2] deliberate sync: one compact int32[3] verdict per whole-space while_loop dispatch, by design
+            lambda: np.asarray(ctx.sync_verdict(
+                phase, pending["out"][0], consumer=ckey
+            )),
+            f"{phase}.wide",
+            on_retry=lambda: pending.update(out=issue()),
+        )
+        found = bool(v[0])
+        cstart = int(np.uint32(v[1])) | (int(np.uint32(v[2])) << 32)
+        if not found:
+            ctx.stats.inc(stat_key, total - start)
+            return
+        ctx.stats.inc(stat_key, min(cstart + chunk, total) - start)
+        _, feas, r1, r0 = pending["out"]
+
+        def combos_fn(rows, cs=cstart):
+            # Vectorized batch unrank: a hit-dense stage A materializes
+            # up to LUT7_CAP rows, and a per-row Python unrank here
+            # would reintroduce the serial host cost this stream exists
+            # to retire.
+            return comb.unrank_combinations(
+                # jaxlint: ignore[R2] host-side rows index array (np.nonzero output) being widened to uint64; no device value flows here
+                np.uint64(cs) + np.asarray(rows, np.uint64), g, k
+            )
+
+        # jaxlint: ignore[R2] deliberate sync: feasibility bitmap resolved only after the verdict said hit (one pull per feasible chunk)
+        yield combos_fn, np.asarray(feas), r1, r0
+        start = cstart + chunk
+
+
 def _host_feasible_chunks(
     ctx: SearchContext, st: State, target, mask, inbits,
     k: int, chunk_cap: int, stat_key: str, phase: str,
 ):
-    """Pipelined host-chunked feasibility stream shared by the lut5 and
-    lut7 host fallbacks (spaces beyond int32 rank arithmetic).
+    """Pipelined host-chunked feasibility stream — the CPU-fallback half
+    of :func:`_feasible_chunks` (tripped device breaker, candidate
+    meshes, SBG_DEVICE_ENUM=0); device backends take the 64-bit
+    device-resident enumeration instead.
 
     A background producer (Options.pipeline_depth) streams unrank +
     filter-exclude + pad up to ``depth`` chunks ahead while as many
@@ -888,11 +1070,9 @@ def _host_feasible_chunks(
                     break
                 padded, nvalid = item
                 valid = ctx.place_chunk(np.arange(csize) < nvalid)
-                feas, req1p, req0p = ctx.kernel_call(
-                    "lut_filter", {},
-                    (tables, ctx.place_chunk(padded), valid, jtarget,
-                     jmask),
-                    g=g,
+                feas, req1p, req0p = _filter_call(
+                    ctx, tables, ctx.place_chunk(padded), valid, jtarget,
+                    jmask, g, k,
                 )
                 # Compact per-chunk verdict: pad rows are invalid and so
                 # never feasible, so any(feas) == any(feas[:csize]).
@@ -916,37 +1096,56 @@ def _host_feasible_chunks(
                 )
             ):
                 continue
-            # jaxlint: ignore[R2] deliberate sync: feasibility bitmap resolved only after the pipelined verdict said hit
-            yield padded, np.asarray(feas)[:csize], req1p, req0p
+            yield (
+                lambda rows, p=padded: p[rows],
+                # jaxlint: ignore[R2] deliberate sync: feasibility bitmap resolved only after the pipelined verdict said hit
+                np.asarray(feas)[:csize], req1p, req0p,
+            )
 
 
 def _lut5_search_host(
     ctx: SearchContext, st: State, target, mask, inbits
 ) -> Optional[dict]:
-    """Host-chunked fallback for spaces beyond int32 rank arithmetic.
-
-    Pipelined via :func:`_host_feasible_chunks`; chunks resolve strictly
-    in stream order and in-flight work past a hit is discarded, so the
-    returned decomposition — and the candidate statistics — are
-    bit-identical to the serial (depth=1) driver."""
+    """Big-space 5-LUT driver (spaces beyond int32 rank arithmetic):
+    device-resident 64-bit enumeration on healthy backends, the
+    pipelined host ChunkPrefetcher stream on the CPU-fallback path
+    (:func:`_feasible_chunks` routes).  Chunks resolve strictly in rank
+    order and in-flight work past a hit is discarded, so the returned
+    decomposition is identical for every route and pipeline depth.  A
+    deadline breach on the device-enumeration route trips the circuit
+    breaker and re-runs through the host stream (same first hit)."""
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jw, jm = ctx.place_replicated(w_tab), ctx.place_replicated(m_tab)
-    chunks = _host_feasible_chunks(
-        ctx, st, target, mask, inbits, k=5, chunk_cap=LUT5_CHUNK,
-        stat_key="lut5_candidates", phase="lut5.host_stream",
-    )
-    with closing(chunks):
-        for padded, feas, req1p, req0p in chunks:
-            fidx = np.nonzero(feas)[0]
-            res = _solve_lut5_rows(
-                ctx, st, target, mask, padded[fidx],
-                # jaxlint: ignore[R2] deliberate sync: hit-row gather happens at most once per feasible chunk
-                np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
-                jw, jm, splits, w_tab, m_tab,
-            )
-            if res is not None:
-                return res
-    return None
+    cand_before = ctx.stats["lut5_candidates"]
+    try:
+        chunks = _feasible_chunks(
+            ctx, st, target, mask, inbits, k=5, chunk_cap=LUT5_CHUNK,
+            stat_key="lut5_candidates", phase="lut5.host_stream",
+        )
+        with closing(chunks):
+            for combos_fn, feas, req1p, req0p in chunks:
+                fidx = np.nonzero(feas)[0]
+                res = _solve_lut5_rows(
+                    ctx, st, target, mask, combos_fn(fidx),
+                    # jaxlint: ignore[R2] deliberate sync: hit-row gather happens at most once per feasible chunk
+                    np.asarray(req1p)[fidx], np.asarray(req0p)[fidx],
+                    jw, jm, splits, w_tab, m_tab,
+                )
+                if res is not None:
+                    return res
+        return None
+    except DispatchTimeout as e:
+        if ctx.device_degraded:
+            # Already on the host stream: the fallback fails loudly, it
+            # never re-enters the degradation machinery.
+            raise
+        logger.warning(
+            "%s; degrading the big-space 5-LUT enumeration to the host "
+            "chunk stream", e,
+        )
+        ctx.stats.put("lut5_candidates", cand_before)
+        ctx.trip_device_breaker()
+        return _lut5_search_host(ctx, st, target, mask, inbits)
 
 
 # -------------------------------------------------------------------------
@@ -1003,24 +1202,26 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
             hit_combos, hit_req1, hit_req0, nhits = [], [], [], 0
             use_device_stream = False
     if not use_device_stream:
-        chunks = _host_feasible_chunks(
-            ctx, st, target, mask, inbits, k=7, chunk_cap=LUT7_CHUNK,
-            stat_key="lut7_candidates", phase=phase,
-        )
-        with closing(chunks):
-            for padded, feas, req1p, req0p in chunks:
-                fidx = np.nonzero(feas)[0]
-                hit_combos.append(padded[fidx])
-                # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
-                hit_req1.append(np.asarray(req1p)[fidx])
-                # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
-                hit_req0.append(np.asarray(req0p)[fidx])
-                nhits += len(fidx)
-                if nhits >= LUT7_CAP:
-                    # Same stopping rule as the serial loop's while-check:
-                    # chunks past the cap crossing are never consumed (and
-                    # their candidates never counted).
-                    break
+        cand_before = ctx.stats["lut7_candidates"]
+        try:
+            hit_combos, hit_req1, hit_req0, nhits = _lut7_stage_a_chunks(
+                ctx, st, target, mask, inbits, phase
+            )
+        except DispatchTimeout as e:
+            if ctx.device_degraded:
+                raise
+            # The 64-bit device enumeration breached its deadline:
+            # restart collection from rank 0 through the host chunk
+            # stream (same reset-and-recount rule as the int32 device
+            # stream's degradation above).
+            logger.warning(
+                "%s; degrading 7-LUT stage A to the host chunk stream", e
+            )
+            ctx.stats.put("lut7_candidates", cand_before)
+            ctx.trip_device_breaker()
+            hit_combos, hit_req1, hit_req0, nhits = _lut7_stage_a_chunks(
+                ctx, st, target, mask, inbits, phase
+            )
 
     if nhits == 0:
         empty = np.zeros((0,), np.uint32)
@@ -1032,6 +1233,36 @@ def _lut7_collect_hits(ctx: SearchContext, st: State, target, mask, inbits):
         perm = ctx.rng.permutation(len(combos))
         combos, req1, req0 = combos[perm], req1[perm], req0[perm]
     return combos, req1, req0
+
+
+def _lut7_stage_a_chunks(ctx: SearchContext, st: State, target, mask, inbits, phase):
+    """Big-space half of 7-LUT stage A: collect feasible tuples through
+    the :func:`_feasible_chunks` router (device-resident 64-bit
+    enumeration, or the host ChunkPrefetcher stream on the CPU-fallback
+    path), capped at LUT7_CAP with the serial loop's stopping rule."""
+    hit_combos: List[np.ndarray] = []
+    hit_req1: List[np.ndarray] = []
+    hit_req0: List[np.ndarray] = []
+    nhits = 0
+    chunks = _feasible_chunks(
+        ctx, st, target, mask, inbits, k=7, chunk_cap=LUT7_CHUNK,
+        stat_key="lut7_candidates", phase=phase,
+    )
+    with closing(chunks):
+        for combos_fn, feas, req1p, req0p in chunks:
+            fidx = np.nonzero(feas)[0]
+            hit_combos.append(combos_fn(fidx))
+            # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
+            hit_req1.append(np.asarray(req1p)[fidx])
+            # jaxlint: ignore[R2] deliberate sync: hit-row gather on an already-resolved feasibility verdict
+            hit_req0.append(np.asarray(req0p)[fidx])
+            nhits += len(fidx)
+            if nhits >= LUT7_CAP:
+                # Same stopping rule as the serial loop's while-check:
+                # chunks past the cap crossing are never consumed (and
+                # their candidates never counted).
+                break
+    return hit_combos, hit_req1, hit_req0, nhits
 
 
 def _lut7_device_stage_a(

@@ -1,0 +1,322 @@
+"""Fused multi-round on-device search driver.
+
+Greedy chain workloads — solve round r's (target, mask) with one (or
+two) new gates over the CURRENT graph, append, move to round r+1 — used
+to pay one full host round trip per round: dispatch the sweep, sync the
+verdict, mutate the host :class:`~sboxgates_tpu.graph.state.State`,
+re-upload the grown table array, dispatch again.  On network-attached
+hardware that link latency dominates the chain (ROOFLINE.md).
+
+:func:`run_round_chain` drives the :func:`sboxgates_tpu.ops.sweeps.round_driver`
+kernel instead: the padded table array, the per-round targets/masks, and
+the hit journal live on device, a ``lax.while_loop`` advances sweep →
+verdict → append-gate for up to N rounds per dispatch, and the host
+syncs ONCE per window — replaying the compact hit rows onto the State
+(every append re-verified through the ordinary mutators, never trusted
+blindly).  ``rounds_per_dispatch=1`` is the per-round reference loop:
+the same kernel, one round per dispatch, one sync and one table upload
+per round — which is what makes the fused/serial comparison (bench.py
+``--device-rounds``, BENCH_MULTIROUND.json) an apples-to-apples
+dispatch-count measurement.  Circuits, statistics draws, and journals
+are bit-identical for every ``rounds_per_dispatch`` value: the per-round
+kernel seeds and don't-care fill bytes are drawn in ONE host block per
+chain segment, so the PRNG stream does not depend on the window split
+(the same discipline the fleet waves use for their seed blocks).
+
+A round the kernel cannot finish — no single-gate/3-LUT/small-5-LUT
+construction exists, or the in-kernel 5-LUT solver overflowed — falls
+back to the full recursive search (:func:`sboxgates_tpu.search.kwan.create_circuit`)
+for that round only, then the chain re-enters the fused driver.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graph.state import GATES, NO_GATE, State
+from ..ops import combinatorics as comb
+from ..ops import sweeps
+from ..resilience.deadline import DispatchTimeout
+from ..telemetry import trace as _ttrace
+from .context import (
+    BUCKETS,
+    LUT5_HEAD_SOLVE_ROWS,
+    PIVOT_MIN_TOTAL,
+    STREAM_CHUNK,
+    SearchContext,
+    pick_chunk,
+)
+
+#: Static ``max_rounds`` ladder for the fused driver: the hit-journal and
+#: target/mask operand heights pad to the smallest covering rung, so the
+#: jitted round_driver sees a small fixed set of shapes (the R8 bucket
+#: discipline — registered in [tool.jaxlint] bucket_sources).
+ROUND_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def round_bucket(n: int) -> int:
+    for b in ROUND_BUCKETS:
+        if n <= b:
+            return b
+    return ROUND_BUCKETS[-1]
+
+
+def _chain_bucket(g: int, want: int) -> Tuple[int, int]:
+    """(table bucket, rounds) for a window starting at gate count ``g``:
+    the smallest gate bucket with append capacity for ``want`` rounds at
+    the worst case of two gates per round, shrinking the window when even
+    the top bucket cannot hold it."""
+    for b in BUCKETS:
+        if b >= g + 2 * want:
+            return b, want
+    cap = (BUCKETS[-1] - g) // 2
+    if cap < 1:
+        raise ValueError(f"no append capacity for a round at {g} gates")
+    return BUCKETS[-1], min(want, cap)
+
+
+def _draw_round_block(ctx: SearchContext, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-round kernel seeds + don't-care fill bytes for the next ``n``
+    rounds, drawn in ONE block so the stream is independent of how the
+    chain is later split into dispatch windows."""
+    if not ctx.opt.randomize:
+        return np.full(n, -1, np.int32), np.zeros(n, np.int32)
+    seeds = np.asarray([ctx.next_seed() for _ in range(n)], np.int32)
+    dcs = np.asarray(
+        [int(ctx.rng.integers(0, 256)) for _ in range(n)], np.int32
+    )
+    return seeds, dcs
+
+
+def _gate_rows(st: State, g_from: int) -> List[List[int]]:
+    """The gates appended past ``g_from`` as journal-able
+    [type, in1, in2, in3, function] rows (the native-engine replay row
+    format, consumed by State.replay_gate)."""
+    return [
+        [int(g.type), int(g.in1), int(g.in2), int(g.in3), int(g.function)]
+        for g in st.gates[g_from:]
+    ]
+
+
+def _replay_round(
+    ctx: SearchContext, st: State, row: np.ndarray, target, mask
+) -> int:
+    """Applies one device-completed round's hit row onto the host State
+    through the ordinary (table-recomputing, self-verifying) mutators.
+    Returns the round's output gate id."""
+    kind, x0, x1, x2, x3 = (int(v) for v in row[:5])
+    if kind == 1:
+        st.verify_gate(x0, target, mask)
+        return x0
+    if kind == 2:
+        gid = st.add_not_gate(x0, GATES)
+        st.verify_gate(gid, target, mask)
+        return gid
+    if kind == 3:
+        a, b, c = (int(v) for v in comb.unrank_combination(x0, st.num_gates, 3))
+        gid = st.add_lut(x1, a, b, c)
+        st.verify_gate(gid, target, mask)
+        return gid
+    if kind == 4:
+        splits, _, _ = sweeps.lut5_split_tables()
+        combo = comb.unrank_combination(x0, st.num_gates, 5)
+        a, b, c, d, e = (int(combo[p]) for p in splits[x1])
+        outer = st.add_lut(x2, a, b, c)
+        gid = st.add_lut(x3, outer, d, e)
+        st.verify_gate(gid, target, mask)
+        return gid
+    raise AssertionError(f"round_driver reported unknown hit kind {kind}")
+
+
+def _default_fallback(ctx: SearchContext, st: State, target, mask) -> int:
+    from .kwan import create_circuit  # deferred: kwan imports context
+
+    return create_circuit(ctx, st, target, mask, [])
+
+
+def run_round_chain(
+    ctx: SearchContext,
+    st: State,
+    rounds: Sequence[Tuple[np.ndarray, np.ndarray]],
+    *,
+    rounds_per_dispatch: int = 8,
+    journal=None,
+    fallback: Optional[Callable] = None,
+) -> List[int]:
+    """Solves a chain of (target, mask) rounds greedily over one shared,
+    growing graph, fusing up to ``rounds_per_dispatch`` rounds per device
+    dispatch (``rounds_per_dispatch=1`` is the per-round reference loop —
+    same kernel, one host sync and one table upload per round).
+
+    Returns the per-round output gate ids.  Rounds the kernel cannot
+    finish run ``fallback(ctx, st, target, mask)`` (default: the full
+    recursive search) on the host; a chain whose device dispatches
+    exhaust their deadline retry schedule trips the circuit breaker and
+    completes entirely through the fallback.
+
+    ``journal`` (a :class:`sboxgates_tpu.resilience.SearchJournal`)
+    records one ``chain_round`` record per completed round — the
+    appended gate rows, the output gate, and the host PRNG position — so
+    a killed chain resumes bit-identically, and the journal bytes are
+    identical for every ``rounds_per_dispatch`` (records are per ROUND,
+    never per dispatch window).
+    """
+    # Clamp to the top ROUND_BUCKETS rung: the hit-journal and operand
+    # heights pad to it, so a larger request would overrun the window
+    # arrays (N is "configurable", not unbounded).
+    n_per = max(1, min(int(rounds_per_dispatch), ROUND_BUCKETS[-1]))
+    outs: List[int] = []
+    r = 0
+    blk = None
+    if journal is not None:
+        blk = journal.last("chain_seeds")
+        recs = journal.of_type("chain_round")
+        for rec in recs:
+            tgt, msk = rounds[rec["round"]]
+            for t, i1, i2, i3, fn in rec["gates"]:
+                st.replay_gate(t, i1, i2, i3, fn)
+            st.verify_gate(rec["out"], tgt, msk)
+            outs.append(rec["out"])
+        if recs:
+            ctx.rng_restore(recs[-1]["rng"])
+            r = recs[-1]["round"] + 1
+
+    if blk is not None:
+        # Resume: the per-round seed/fill block was drawn — and consumed
+        # from the PRNG — by the original run; re-drawing from the
+        # restored position would shift every remaining round's stream.
+        base = int(blk["base"])
+        seeds = np.asarray(blk["seeds"], np.int32)
+        dcs = np.asarray(blk["dcs"], np.int32)
+        if not outs:
+            # Killed after the block draw but before any round
+            # completed: no chain_round record restored the PRNG, so the
+            # post-draw position recorded WITH the block is the resume
+            # point (a fresh rng here would shift every later draw).
+            ctx.rng_restore(blk["rng"])
+    else:
+        base = r
+        seeds, dcs = _draw_round_block(ctx, len(rounds) - r)
+        if journal is not None:
+            journal.append(
+                "chain_seeds", base=base,
+                seeds=[int(x) for x in seeds], dcs=[int(x) for x in dcs],
+                rng=ctx.rng_snapshot(),
+            )
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jsplits = ctx.place_replicated(splits)
+    jw = ctx.place_replicated(w_tab)
+    jm = ctx.place_replicated(m_tab)
+    jexcl = ctx.place_replicated(SearchContext.excl_array([]))
+    degraded = ctx.device_degraded
+
+    def record(rnd: int, out: int, g_from: int) -> None:
+        outs.append(out)
+        if journal is not None:
+            journal.append(
+                "chain_round", round=rnd, out=out,
+                gates=_gate_rows(st, g_from), rng=ctx.rng_snapshot(),
+            )
+
+    def host_round(rnd: int) -> None:
+        target, mask = rounds[rnd]
+        g_from = st.num_gates
+        ctx.stats.inc("round_driver_fallbacks")
+        out = (fallback or _default_fallback)(ctx, st, target, mask)
+        if out == NO_GATE:
+            raise RuntimeError(f"round {rnd}: no circuit found")
+        record(rnd, out, g_from)
+
+    while r < len(rounds):
+        if (
+            degraded
+            or ctx.device_degraded
+            # No append capacity at the gate cap (a worst-case round
+            # adds two gates): the host search still owns the round —
+            # it can match an existing gate or add the one final row.
+            or st.num_gates + 2 > BUCKETS[-1]
+        ):
+            host_round(r)
+            r += 1
+            continue
+        g = st.num_gates
+        want = min(n_per, len(rounds) - r)
+        b, n = _chain_bucket(g, want)
+        rb = round_bucket(n)
+        targets = np.zeros((rb, 8), np.uint32)
+        masks = np.zeros((rb, 8), np.uint32)
+        for i in range(n):
+            targets[i] = np.asarray(rounds[r + i][0], np.uint32)
+            masks[i] = np.asarray(rounds[r + i][1], np.uint32)
+        wseeds = np.zeros(rb, np.int32)
+        wdcs = np.zeros(rb, np.int32)
+        wseeds[:n] = seeds[r - base : r - base + n]
+        wdcs[:n] = dcs[r - base : r - base + n]
+        padded = np.zeros((b, 8), np.uint32)
+        padded[:g] = st.live_tables()
+        chunk3 = pick_chunk(comb.n_choose_k(b, 3), STREAM_CHUNK[3])
+        chunk5 = pick_chunk(PIVOT_MIN_TOTAL, STREAM_CHUNK[5])
+        ckey = threading.get_ident()
+
+        def issue():
+            return ctx.kernel_call(
+                "round_driver",
+                dict(
+                    chunk3=chunk3, chunk5=chunk5, has5=True, max_rounds=rb,
+                    solve_rows=LUT5_HEAD_SOLVE_ROWS,
+                ),
+                (
+                    ctx.place_replicated(padded), ctx.binom, g,
+                    ctx.place_replicated(targets),
+                    ctx.place_replicated(masks), jexcl,
+                    ctx.place_replicated(wseeds),
+                    ctx.place_replicated(wdcs), n, PIVOT_MIN_TOTAL,
+                    jsplits, jw, jm,
+                ),
+                g=g,
+            )
+
+        try:
+            with _ttrace.span("round_driver", "round", rounds=n, g=g):
+                pending = {"out": issue()}
+                hits = ctx.guarded_dispatch(
+                    # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused window — the sync this driver exists to amortize
+                    lambda: np.asarray(ctx.sync_verdict(
+                        "round_driver", pending["out"], consumer=ckey
+                    )),
+                    "round_driver",
+                    on_retry=lambda: pending.update(out=issue()),
+                )
+        except DispatchTimeout as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s; degrading the round chain to the host fallback", e
+            )
+            ctx.trip_device_breaker()
+            degraded = True
+            continue
+
+        rounds_done = int(hits[rb, 0])
+        ctx.stats.inc("round_driver_rounds", rounds_done)
+        ctx.stats.observe("rounds_per_dispatch", float(rounds_done))
+        counted = rounds_done + (1 if rounds_done < n else 0)
+        for i in range(counted):
+            ctx.stats.inc("lut3_candidates", int(hits[i, 5]))
+            ctx.stats.inc("lut5_candidates", int(hits[i, 6]))
+        for i in range(rounds_done):
+            target, mask = rounds[r + i]
+            g_from = st.num_gates
+            out = _replay_round(ctx, st, hits[i], target, mask)
+            record(r + i, out, g_from)
+        r += rounds_done
+        if rounds_done < n:
+            # The kernel froze on round r: miss or in-kernel solver
+            # overflow — either way the full recursive search owns it.
+            host_round(r)
+            r += 1
+    assert st.num_gates == len(st.gates)
+    return outs

@@ -172,6 +172,26 @@ KERNELS: Dict[str, KernelDef] = {
                    "backend")),
         KernelDef("lut5_pivot_tile", ("tl", "th")),
         KernelDef("pivot_pair_cells", ()),
+        # Fused multi-round driver (search/rounds.py): device-resident
+        # search state advanced sweep->verdict->append for up to
+        # max_rounds per dispatch.  Not warmable: its shapes key on the
+        # (gate bucket x ROUND_BUCKETS rung) cross product of a chain
+        # the warmer cannot predict; the persistent compile cache still
+        # covers restarts.
+        KernelDef(
+            "round_driver",
+            ("chunk3", "chunk5", "has5", "max_rounds", "solve_rows"),
+            warmable=False,
+        ),
+        # 64-bit-rank device enumeration (search/lut.py big-space
+        # streams) and the 5-LUT filter head with the pallas backend:
+        # dispatched on g-exact shapes / env-levered backends, so they
+        # stay registered-but-unwarmable like the old pivot kernels.
+        KernelDef(
+            "feasible_stream_wide", ("k", "chunk", "backend"),
+            warmable=False,
+        ),
+        KernelDef("lut5_filter", ("backend",), warmable=False),
     )
 }
 

@@ -85,6 +85,19 @@ METRICS: Dict[str, MetricDef] = {
     "pivot_pallas_fallbacks": MetricDef(
         COUNTER, "dispatches", "sharded pivot pallas->xla fallbacks"
     ),
+    "filter_pallas_fallbacks": MetricDef(
+        COUNTER, "dispatches",
+        "5-LUT feasibility-filter pallas->xla lowering fallbacks",
+    ),
+    # fused multi-round driver (search/rounds.py)
+    "round_driver_rounds": MetricDef(
+        COUNTER, "rounds",
+        "search rounds completed on device by the fused round driver",
+    ),
+    "round_driver_fallbacks": MetricDef(
+        COUNTER, "rounds",
+        "chain rounds the fused driver handed to the host recursion",
+    ),
     # engine (native) activity
     "engine_nodes": MetricDef(COUNTER, "nodes", "search nodes completed in the native engine"),
     "python_nodes": MetricDef(COUNTER, "nodes", "search nodes completed by the Python recursion"),
@@ -120,6 +133,12 @@ METRICS: Dict[str, MetricDef] = {
         "per-job wall time from job start to its first completed circuit",
     ),
     "job_seconds": MetricDef(HISTOGRAM, "s", "per-job total wall time"),
+    "rounds_per_dispatch": MetricDef(
+        HISTOGRAM, "rounds",
+        "search rounds completed per fused round-driver dispatch (1.0 "
+        "everywhere = the per-round loop; the fused driver's whole point "
+        "is pushing this toward its rounds-per-dispatch setting)",
+    ),
 }
 
 #: Log-spaced default histogram bounds: 100 µs .. ~17 min, covering a
@@ -351,6 +370,9 @@ CONTEXT_COUNTERS: Tuple[str, ...] = (
     "lut7_candidates",
     "lut7_solved",
     "pivot_pallas_fallbacks",
+    "filter_pallas_fallbacks",
+    "round_driver_rounds",
+    "round_driver_fallbacks",
     "dispatch_retries",
     "deadline_breaches",
     "breach_barriers",

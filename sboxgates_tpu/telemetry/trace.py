@@ -21,6 +21,9 @@ Span taxonomy (the ``cat`` field; README "Telemetry" has the table):
 ``stall``       a consumer blocked on the prefetch queue
 ``fallback``    a degradation signal (pallas→xla, native service failure)
 ``job``         one fleet/multibox job (time-to-first-hit source)
+``round``       one fused round-driver dispatch window (search/rounds.py):
+                args carry the window's rounds and entry gate count; the
+                ``rounds_per_dispatch`` histogram holds the completions
 ==============  ==========================================================
 
 Recording model: each thread appends finished spans to its own buffer

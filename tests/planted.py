@@ -82,6 +82,48 @@ def verify_lut7_result(st, target, mask, res) -> bool:
     return bool(tt.eq_mask(got, target, mask))
 
 
+def build_round_chain(n_rounds=10, gates0=12, seed=7, deep_last=False):
+    """(start state, [(target, mask), ...]) for the fused multi-round
+    driver tests: each target is one 3-LUT over the SIMULATED evolving
+    state (operands sorted BEFORE building the target, so the planted
+    table matches the simulated append for non-symmetric functions too).
+    ``deep_last`` appends a FINAL target needing a 3-level LUT tree
+    (7 distinct leaves) the round kernel cannot finish — the
+    host-fallback path.  Last only: the fallback recursion's gate
+    choices are its own, so no later planted target may depend on them.
+    bench.py's ``_round_chain_problem`` mirrors this construction (bench
+    must not import from tests/)."""
+    rng = np.random.default_rng(seed)
+    st = State.init_inputs(8)
+    while st.num_gates < gates0:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    mask = tt.mask_table(8)
+    sim = st.copy()
+    rounds = []
+    for _ in range(n_rounds):
+        a, b, c = sorted(
+            int(x) for x in rng.choice(sim.num_gates, size=3, replace=False)
+        )
+        func = int(rng.integers(1, 255))
+        rounds.append(
+            (tt.eval_lut(func, sim.table(a), sim.table(b), sim.table(c)), mask)
+        )
+        sim.add_lut(func, a, b, c)
+    if deep_last:
+        gs = rng.choice(sim.num_gates, size=7, replace=False)
+        o = tt.eval_lut(
+            0x96, sim.table(int(gs[0])), sim.table(int(gs[1])),
+            sim.table(int(gs[2])),
+        )
+        m = tt.eval_lut(
+            0xE8, sim.table(int(gs[3])), sim.table(int(gs[4])),
+            sim.table(int(gs[5])),
+        )
+        rounds.append((tt.eval_lut(0xCA, o, m, sim.table(int(gs[6]))), mask))
+    return st, rounds
+
+
 def verify_lut5_result(st, target, mask, res) -> bool:
     """True iff res = {func_outer, func_inner, gates} realizes the target."""
     a, b, c, d, e = (int(g) for g in res["gates"])

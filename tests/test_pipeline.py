@@ -135,8 +135,12 @@ def test_prefetcher_consumer_exception_cleans_up():
 
 def _force_host_path(monkeypatch, chunk5=1024, chunk7=8192):
     """Route lut5/lut7 searches through the host-chunked fallbacks with
-    small chunks so the planted spaces span many chunks."""
+    small chunks so the planted spaces span many chunks.  SBG_DEVICE_ENUM=0
+    pins the ChunkPrefetcher route: these tests exercise the host chunk
+    pipeline itself, which healthy backends otherwise skip in favor of
+    the device-resident 64-bit enumeration."""
     monkeypatch.setattr(sweeps, "device_rank_limit", lambda g, k: False)
+    monkeypatch.setenv("SBG_DEVICE_ENUM", "0")
     monkeypatch.setattr(slut, "LUT5_CHUNK", chunk5)
     monkeypatch.setattr(slut, "LUT7_CHUNK", chunk7)
 
@@ -449,3 +453,88 @@ def test_cli_rejects_bad_pipeline_depth():
     from sboxgates_tpu.cli import main
 
     assert main(["--pipeline-depth", "0"]) != 0
+
+
+# -- close() hardening ------------------------------------------------------
+
+
+def test_prefetcher_close_is_idempotent():
+    """A second close (consumer __exit__ after a supervising thread
+    already closed) must be a no-op, with the worker joined once."""
+    pf = comb.ChunkPrefetcher(comb.CombinationStream(30, 5), 64, (), depth=3)
+    assert pf.get() is not None
+    pf.close()
+    assert pf.closed
+    pf.close()
+    pf.close()
+    assert pf.closed
+    assert pf.get() is None
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_close_wakes_blocked_consumer():
+    """close() from a supervising thread must wake a consumer blocked in
+    get() — the drain alone would leave it hanging on the emptied queue
+    forever (the pre-hardening bug shape)."""
+
+    class SlowStream:
+        """First chunk arrives, then production blocks until released."""
+
+        def __init__(self):
+            self.release = threading.Event()
+            self.inner = comb.CombinationStream(30, 5)
+            self.calls = 0
+
+        def next_chunk(self, n):
+            self.calls += 1
+            if self.calls > 1:
+                self.release.wait(timeout=20.0)
+                return None
+            return self.inner.next_chunk(n)
+
+    stream = SlowStream()
+    pf = comb.ChunkPrefetcher(stream, 64, (), depth=2)
+    got = []
+    done = threading.Event()
+
+    def consume():
+        got.append(pf.get())
+        got.append(pf.get())  # blocks: producer is stuck in next_chunk
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    pf.close()
+    stream.release.set()
+    assert done.wait(timeout=10.0), "consumer stayed blocked after close()"
+    t.join(timeout=10.0)
+    assert got[0] is not None and got[1] is None
+    # and the worker does not outlive the failed search
+    for _ in range(100):
+        if pf.closed:
+            break
+        time.sleep(0.05)
+    assert pf.closed
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_close_drains_late_put():
+    """The producer may complete one final _put between close()'s first
+    drain and its _stop check; the second drain must drop it so no chunk
+    arrays stay pinned in the dead prefetcher's queue."""
+    for _ in range(10):  # the race window is timing-dependent; iterate
+        pf = comb.ChunkPrefetcher(
+            comb.CombinationStream(30, 5), 64, (), depth=2
+        )
+        assert pf.get() is not None
+        pf.close()
+        # Whatever survived must be at most the wake-up sentinel.
+        items = []
+        try:
+            while True:
+                items.append(pf._q.get_nowait())
+        except Exception:
+            pass
+        assert all(i is None for i in items)
+        assert not _prefetch_threads()

@@ -379,3 +379,97 @@ def test_crash_matrix_lut_mode(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert xml_digests(killed) == xml_digests(ok)
+
+
+# -- fused multi-round chain driver: journal identity + resume -------------
+
+
+def _chain_problem():
+    from planted import build_round_chain
+
+    return build_round_chain(n_rounds=10, gates0=12, seed=7)
+
+
+def _run_chain(tmp_path, name, n_per, rounds=None, st=None, resume=False):
+    from sboxgates_tpu.resilience.journal import SearchJournal
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.rounds import run_round_chain
+
+    if st is None:
+        st, rounds = _chain_problem()
+    d = os.path.join(str(tmp_path), name)
+    if resume:
+        journal = SearchJournal.resume(d)
+    else:
+        journal = SearchJournal.start(d, {"mode": "round_chain", "seed": 5})
+    ctx = SearchContext(Options(
+        lut_graph=True, randomize=True, seed=5, warmup=False,
+        parallel_mux=False,
+    ))
+    outs = run_round_chain(
+        ctx, st, rounds, rounds_per_dispatch=n_per, journal=journal
+    )
+    return st, outs, d
+
+
+@pytest.mark.parametrize("n_per", [2, 8])
+def test_round_chain_journal_byte_identical_across_n(tmp_path, n_per):
+    """Fused chains must journal BYTE-identically to the per-round loop:
+    records are per round (never per dispatch window), and the PRNG
+    block draw makes the recorded rng positions window-independent."""
+    st1, outs1, d1 = _run_chain(tmp_path, "serial", 1)
+    st2, outs2, d2 = _run_chain(tmp_path, f"fused{n_per}", n_per)
+    assert outs1 == outs2
+    assert st1.tables.tobytes() == st2.tables.tobytes()
+    j1 = open(os.path.join(d1, "search.journal.jsonl"), "rb").read()
+    j2 = open(os.path.join(d2, "search.journal.jsonl"), "rb").read()
+    assert j1 == j2
+
+
+@pytest.mark.parametrize("keep_seq", [1, 4])
+def test_round_chain_resumes_bit_identical(tmp_path, keep_seq):
+    """A chain killed mid-run resumes from its journal to the identical
+    final circuit: replay the recorded rounds, restore the PRNG, and
+    continue through the fused driver.  keep_seq=1 is the window where
+    the seed block was drawn (and journaled) but NO round completed —
+    the resume must restore the post-block-draw PRNG position from the
+    chain_seeds record itself."""
+    import json
+
+    ref_st, ref_outs, ref_dir = _run_chain(tmp_path, "ref", 8)
+
+    # Simulate the crash: truncate the journal after keep_seq records
+    # (run_start + chain_seeds + completed rounds) into a fresh dir.
+    recs = [
+        json.loads(ln) for ln in open(
+            os.path.join(ref_dir, "search.journal.jsonl"), encoding="utf-8"
+        )
+    ]
+    kept = [r for r in recs if r["seq"] <= keep_seq]
+    killed = os.path.join(str(tmp_path), "killed")
+    os.makedirs(killed)
+    with open(
+        os.path.join(killed, "search.journal.jsonl"), "w", encoding="utf-8"
+    ) as f:
+        for r in kept:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+    st, rounds = _chain_problem()
+    res_st, res_outs, res_dir = _run_chain(
+        tmp_path, "killed", 8, rounds=rounds, st=st, resume=True
+    )
+    assert res_outs == ref_outs
+    assert res_st.tables.tobytes() == ref_st.tables.tobytes()
+    # The resumed journal's chain records must equal the reference's.
+    ref_recs = [r for r in recs if r["type"] == "chain_round"]
+    res_recs = [
+        json.loads(ln) for ln in open(
+            os.path.join(res_dir, "search.journal.jsonl"), encoding="utf-8"
+        )
+    ]
+    res_recs = [r for r in res_recs if r["type"] == "chain_round"]
+    assert [
+        {k: v for k, v in r.items() if k != "seq"} for r in ref_recs
+    ] == [
+        {k: v for k, v in r.items() if k != "seq"} for r in res_recs
+    ]

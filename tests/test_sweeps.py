@@ -572,3 +572,254 @@ def test_pivot_search_respects_exclusions(rng):
     mask = tt.mask_table(8)
     ctx = SearchContext(Options(seed=2, lut_graph=True))
     assert _lut5_search_pivot(ctx, st, target, mask, [1, 4]) is None
+
+
+# -- wide (64-bit) rank streaming ------------------------------------------
+
+
+def test_wide_unrank_matches_host_at_big_ranks():
+    """Pair-arithmetic unranking parity with the host reference at ranks
+    past int32 (C(200, 5) ~ 2.5e9)."""
+    import jax
+    import jax.numpy as jnp
+
+    blo, bhi = sweeps.binom_table_wide()
+    g, k = 200, 5
+    total = comb.n_choose_k(g, k)
+    assert total > 2**31
+    ranks = [0, 1, 123456, 2**31 - 1, 2**31, 2**31 + 12345, total - 1]
+    rlo = np.array([r & 0xFFFFFFFF for r in ranks], np.uint32)
+    rhi = np.array([r >> 32 for r in ranks], np.uint32)
+    out = np.asarray(jax.jit(
+        lambda a, b: sweeps._unrank_combos_wide(
+            jnp.asarray(blo), jnp.asarray(bhi), g, k, a, b
+        )
+    )(rlo, rhi))
+    for i, r in enumerate(ranks):
+        np.testing.assert_array_equal(
+            out[:, i], comb.unrank_combination(r, g, k)
+        )
+
+
+def _wide_stream_case(rng, g=40, k=5, planted=True):
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.graph.state import GATES, State
+
+    st = State.init_inputs(8)
+    while st.num_gates < g:
+        a, b = rng.choice(st.num_gates, size=2, replace=False)
+        st.add_gate(bf.XOR, int(a), int(b), GATES)
+    mask = tt.mask_table(8)
+    if planted:
+        target = tt.eval_lut(
+            0x96, st.table(g - 10), st.table(g - 7), st.table(g - 3)
+        )
+    else:
+        target = rng.integers(0, 2**32, size=8, dtype=np.uint32)
+    tables = np.zeros((64, 8), np.uint32)
+    tables[:g] = st.live_tables()
+    return tables, target, mask
+
+
+@pytest.mark.parametrize("excl", [(), (3, 17)])
+def test_feasible_stream_wide_matches_int32_stream(rng, excl):
+    """The 64-bit pair-arithmetic stream must return the identical
+    verdict, chunk start, and constraint arrays as feasible_stream on a
+    space both can express — including exclusion masking."""
+    import jax.numpy as jnp
+
+    g, k, chunk = 40, 5, 1024
+    tables, target, mask = _wide_stream_case(rng, g, k)
+    total = comb.n_choose_k(g, k)
+    ex = np.full(8, -1, np.int32)
+    for i, b in enumerate(excl):
+        ex[i] = b
+    blo, bhi = sweeps.binom_table_wide()
+    for start in (0, total - 4 * chunk):
+        vw, fw, r1w, r0w = sweeps.feasible_stream_wide(
+            jnp.asarray(tables), jnp.asarray(blo), jnp.asarray(bhi), g,
+            jnp.asarray(target), jnp.asarray(mask), jnp.asarray(ex),
+            np.uint32(start & 0xFFFFFFFF), np.uint32(start >> 32),
+            np.uint32(total & 0xFFFFFFFF), np.uint32(total >> 32),
+            k=k, chunk=chunk,
+        )
+        vi, fi, r1i, r0i = sweeps.feasible_stream(
+            jnp.asarray(tables), jnp.asarray(sweeps.binom_table()), g,
+            jnp.asarray(target), jnp.asarray(mask), jnp.asarray(ex),
+            start, total, k=k, chunk=chunk,
+        )
+        vw, vi = np.asarray(vw), np.asarray(vi)
+        assert vw[0] == vi[0]
+        cstart = int(np.uint32(vw[1])) | (int(np.uint32(vw[2])) << 32)
+        assert cstart == int(vi[1])
+        np.testing.assert_array_equal(np.asarray(fw), np.asarray(fi))
+        np.testing.assert_array_equal(np.asarray(r1w), np.asarray(r1i))
+        np.testing.assert_array_equal(np.asarray(r0w), np.asarray(r0i))
+
+
+def test_device_feasible_chunks_matches_host_chunks(rng, monkeypatch):
+    """The device-resident 64-bit enumeration and the ChunkPrefetcher
+    host stream must surface the identical feasible rows (combos and
+    packed constraint words) for the same space."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search import lut as slut
+    from contextlib import closing
+
+    from planted import build_planted_lut7
+
+    st, target, mask = build_planted_lut7()
+
+    def collect(route_env):
+        monkeypatch.setenv("SBG_DEVICE_ENUM", route_env)
+        ctx = SearchContext(Options(seed=7, warmup=False))
+        hits = []
+        chunks = slut._feasible_chunks(
+            ctx, st, target, mask, [1], k=7, chunk_cap=8192,
+            stat_key="lut7_candidates", phase="lut7.stageA",
+        )
+        with closing(chunks):
+            for combos_fn, feas, r1, r0 in chunks:
+                fidx = np.nonzero(feas)[0]
+                hits.append((
+                    combos_fn(fidx), np.asarray(r1)[fidx],
+                    np.asarray(r0)[fidx],
+                ))
+        assert hits
+        return (
+            np.concatenate([h[0] for h in hits]),
+            np.concatenate([h[1] for h in hits]),
+            np.concatenate([h[2] for h in hits]),
+        )
+
+    dev = collect("1")
+    host = collect("0")
+    np.testing.assert_array_equal(dev[0], host[0])
+    np.testing.assert_array_equal(dev[1], host[1])
+    np.testing.assert_array_equal(dev[2], host[2])
+
+
+# -- 5-LUT feasibility filter head (pallas backend) ------------------------
+
+
+def test_lut5_filter_pallas_bit_identical(rng):
+    """The fused VMEM filter kernel must produce the identical packed
+    constraint words and feasibility verdicts as the XLA epilogue
+    (interpreter mode on CPU)."""
+    import jax.numpy as jnp
+
+    tables, target, mask = _wide_stream_case(rng, g=40, k=5)
+    combos = np.stack(
+        [comb.unrank_combination(r, 40, 5) for r in range(1024)]
+    ).astype(np.int32)
+    valid = rng.integers(0, 2, size=1024).astype(bool)
+    args = (
+        jnp.asarray(tables), jnp.asarray(combos), jnp.asarray(valid),
+        jnp.asarray(target), jnp.asarray(mask),
+    )
+    fx, r1x, r0x = sweeps.lut5_filter(*args, backend="xla")
+    fp, r1p, r0p = sweeps.lut5_filter(*args, backend="pallas")
+    np.testing.assert_array_equal(np.asarray(fx), np.asarray(fp))
+    np.testing.assert_array_equal(np.asarray(r1x), np.asarray(r1p))
+    np.testing.assert_array_equal(np.asarray(r0x), np.asarray(r0p))
+    assert np.asarray(fx).any()
+
+
+def test_feasible_stream_wide_pallas_backend_bit_identical(rng):
+    """backend="pallas" inside the wide stream's while_loop must match
+    the XLA epilogue bit for bit."""
+    import jax.numpy as jnp
+
+    g, k, chunk = 40, 5, 1024
+    tables, target, mask = _wide_stream_case(rng, g, k)
+    total = comb.n_choose_k(g, k)
+    ex = np.full(8, -1, np.int32)
+    blo, bhi = sweeps.binom_table_wide()
+    args = (
+        jnp.asarray(tables), jnp.asarray(blo), jnp.asarray(bhi), g,
+        jnp.asarray(target), jnp.asarray(mask), jnp.asarray(ex),
+        np.uint32(0), np.uint32(0),
+        np.uint32(total & 0xFFFFFFFF), np.uint32(total >> 32),
+    )
+    outs = {}
+    for backend in ("xla", "pallas"):
+        v, f, r1, r0 = sweeps.feasible_stream_wide(
+            *args, k=k, chunk=chunk, backend=backend
+        )
+        outs[backend] = (
+            np.asarray(v), np.asarray(f), np.asarray(r1), np.asarray(r0)
+        )
+    for a, b in zip(outs["xla"], outs["pallas"]):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- fused multi-round driver ----------------------------------------------
+
+
+def _round_chain_case(n_rounds=10, seed=7, gates=12, deep_last=False):
+    """Shared planted-chain fixture (tests/planted.py holds the one
+    construction the driver tests and the resume tests both use)."""
+    from planted import build_round_chain
+
+    return build_round_chain(
+        n_rounds=n_rounds, gates0=gates, seed=seed, deep_last=deep_last
+    )
+
+
+@pytest.mark.parametrize("seed", [None, 123, 999])
+def test_round_chain_bit_identity_across_n(seed):
+    """Fused N-round chains must produce byte-identical circuits to the
+    per-round (N=1) loop for every rounds-per-dispatch and seed."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.rounds import run_round_chain
+
+    sigs = []
+    for n in (1, 2, 8):
+        st, rounds = _round_chain_case()
+        ctx = SearchContext(Options(
+            lut_graph=True, randomize=seed is not None, seed=seed,
+            warmup=False, parallel_mux=False,
+        ))
+        outs = run_round_chain(ctx, st, rounds, rounds_per_dispatch=n)
+        for (tgt, msk), out in zip(rounds, outs):
+            st.verify_gate(out, tgt, msk)
+        sigs.append((
+            tuple(outs), st.tables.tobytes(),
+            tuple(
+                (g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates
+            ),
+        ))
+        # every round completed on device (no fallback in this chain)
+        assert ctx.stats["round_driver_fallbacks"] == 0
+        assert ctx.stats["round_driver_rounds"] == len(rounds)
+    assert sigs[0] == sigs[1] == sigs[2]
+
+
+def test_round_chain_scan_kinds_and_fallback():
+    """Existing-gate and complement rounds must not append LUTs, and a
+    round the kernel cannot finish must run the host recursion — with
+    the chain bit-identical across rounds-per-dispatch either way."""
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.rounds import run_round_chain
+
+    st0, rounds = _round_chain_case(n_rounds=4, deep_last=True)
+    mask = tt.mask_table(8)
+    # Prepend a direct-match round (an input's own table) and a
+    # complement round.
+    rounds = [
+        (st0.table(3).copy(), mask),
+        ((~st0.table(5)).copy(), mask),
+    ] + rounds
+    sigs = []
+    for n in (1, 8):
+        st = st0.copy()
+        ctx = SearchContext(Options(
+            lut_graph=True, randomize=False, warmup=False,
+            parallel_mux=False, native_engine=False,
+        ))
+        outs = run_round_chain(ctx, st, rounds, rounds_per_dispatch=n)
+        assert outs[0] == 3  # direct match: no new gate
+        for (tgt, msk), out in zip(rounds, outs):
+            st.verify_gate(out, tgt, msk)
+        assert ctx.stats["round_driver_fallbacks"] == 1
+        sigs.append((tuple(outs), st.tables.tobytes()))
+    assert sigs[0] == sigs[1]
