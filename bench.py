@@ -707,7 +707,7 @@ def _round_chain_problem(n_rounds: int, gates0: int, seed: int = 7):
     return st, rounds
 
 
-def bench_device_rounds(n_fused: int = 8) -> list:
+def bench_device_rounds(n_fused: int = 8, n_rounds: int = None) -> list:
     """Fused multi-round driver vs the per-round loop
     (BENCH_MULTIROUND.json): the dispatch-count half of the multi-round
     tentpole, measurable on any backend.
@@ -730,8 +730,12 @@ def bench_device_rounds(n_fused: int = 8) -> list:
     # (g0 + rounds + 2*N <= 64): the A/B then compiles exactly TWO
     # round_driver executables (the N=1 and N=8 rungs) — the dispatch
     # ratio is size-independent, and CPU CI pays seconds, not minutes,
-    # of XLA compile for the heavy fused while_loop.
-    n_rounds = 24 if SMOKE else 32
+    # of XLA compile for the heavy fused while_loop.  ``n_rounds``
+    # overrides the default sizing (the --check drift gate pins a small
+    # fixed chain: its gated ratios are size-independent, and the gate
+    # runs on every tier-1 pass).
+    if n_rounds is None:
+        n_rounds = 24 if SMOKE else 32
     gates0 = 12
     entries = []
     arms = {}
@@ -1320,12 +1324,16 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
 
     # Telemetry overhead A/B (the acceptance gate for the telemetry
     # subsystem): one pipelined sweep per arm under its own sync_guard —
-    # tracing OFF (the production default; registry + flight ring only)
-    # vs the process tracer ON.  Spans time host-side events only, so
-    # the sync counts MUST be identical (asserted: zero extra host
-    # syncs); the wall-time delta is the <=1% budget, reported as a
-    # fraction of the trace-off rate.
+    # everything OFF (the production default; registry + flight ring
+    # only) vs the full observability stack ON: the process tracer,
+    # attribution lazy cost capture, and a live /status endpoint
+    # serving throughout the sweep.  Spans and status snapshots read host state
+    # only, so the sync counts MUST be identical (asserted: zero extra
+    # host syncs); the wall-time delta is the <=1% budget, reported as
+    # a fraction of the everything-off rate.
+    from sboxgates_tpu.telemetry import attribution as tattr
     from sboxgates_tpu.telemetry import trace as ttrace
+    from sboxgates_tpu.telemetry.status import StatusServer
 
     tr = ttrace.tracer()
     assert not tr.enabled, "tracer unexpectedly on in the bench process"
@@ -1334,12 +1342,38 @@ def bench_host_stream_pipeline(g=None, strict_guards=False) -> list:
         r_off, _ = sweep(2)
     tr.reset()
     tr.enabled = True
+    lazy_before = tattr.lazy_capture_enabled()
+    tattr.set_lazy_capture(True)
+    status = None
     try:
         with sync_guard(allowed=1 << 30, action="count",
                         label="telemetry-on") as s_on:
-            r_on, c_on = sweep(2)
+            ctx_on = SearchContext(Options(seed=1, lut_graph=True,
+                                           pipeline_depth=2))
+            status = StatusServer(ctx_on.stats, port=0).start()
+            t0 = time.perf_counter()
+            res = slut._lut5_search_host(ctx_on, st, target, mask, [])
+            dt = time.perf_counter() - t0
+            assert res is None, "unexpected 5-LUT hit in bench state"
+            r_on, c_on = ctx_on.stats["lut5_candidates"] / dt, ctx_on
+        # Success path only: one poll proving the endpoint serves the
+        # live registry (a failed arm must surface ITS error, not a
+        # poll error masking it from a finally).
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{status.port}/status", timeout=10
+        ) as resp:
+            assert json.load(resp)["counters"].get(
+                "lut5_candidates", 0
+            ) > 0
     finally:
         tr.enabled = False
+        tattr.set_lazy_capture(lazy_before)
+        if status is not None:
+            # Unconditional: no dangling serve thread or socket past
+            # this entry, whichever way the arm ended.
+            status.shutdown()
     extra_syncs = s_on.syncs - s_off.syncs
     assert extra_syncs == 0, (
         f"tracing added {extra_syncs} host syncs — spans must never "
@@ -2427,6 +2461,308 @@ def bench_pallas_exec(best) -> dict:
     }
 
 
+def bench_roofline() -> list:
+    """Measured roofline placement for EVERY kernel in the ``KERNELS``
+    registry (BENCH_ROOFLINE.json) — the maintained successor to
+    ROOFLINE.md's hand-derived single-kernel memo.
+
+    For each registered kernel the entry (a) captures XLA's own
+    ``cost_analysis()`` / ``memory_analysis()`` at compile time through
+    the telemetry attribution layer (the same capture production runs
+    make), and (b) measures resolved per-dispatch wall time —
+    ``kernel_call`` + ``block_until_ready`` — into a dedicated join
+    registry, so the achieved FLOP/s / bytes/s rates are end-to-end,
+    not async-issue latencies.  Kernels the real drivers dispatch
+    unconditionally are driven through the real drivers (gate/LUT node
+    heads, the streams, the pivot path, the fused round driver); the
+    conditional tails (solvers, overflow re-drives, the filter heads,
+    the 64-bit-rank stream) are dispatched directly with
+    registry-validated operands.
+
+    On CPU CI the absolute rates are plumbing-grade; the entry's
+    hardware-independent claims are coverage (every registry kernel has
+    a (kernel, bucket) cost row) and the placement arithmetic.  On
+    silicon the same mode writes the real roofline."""
+    import jax
+
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.ops import sweeps
+    from sboxgates_tpu.search import Options, SearchContext, run_round_chain
+    from sboxgates_tpu.search import context as C
+    from sboxgates_tpu.search import lut as slut
+    from sboxgates_tpu.search.warmup import KERNELS
+    from sboxgates_tpu.telemetry import attribution as tattr
+    from sboxgates_tpu.telemetry import metrics as tmetrics
+
+    tattr.reset()
+    tattr.note_backend(jax.default_backend())
+    tattr.set_lazy_capture(True)
+    join = tmetrics.MetricsRegistry(declared=None)
+    reps = 2 if SMOKE else 3
+
+    def grow(n, seed=0):
+        rng = np.random.default_rng(seed)
+        st = State.init_inputs(8)
+        while st.num_gates < n:
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(bf.XOR, int(a), int(b), GATES)
+        return st
+
+    mask = tt.mask_table(8)
+    miss = np.zeros(8, dtype=np.uint32)  # unrealizable: full sweeps
+
+    def instrument(ctx):
+        """Swaps ctx.kernel_call for a resolving, latency-observing
+        wrapper (warm passes run first on the plain method, so compile
+        stalls never pollute the measured distribution)."""
+        orig = ctx.kernel_call
+
+        def timed(name, statics, args, g=None, _orig=orig):
+            t0 = time.perf_counter()
+            out = _orig(name, statics, args, g=g)
+            jax.block_until_ready(out)
+            # Same (kernel, bucket) member key the attribution join
+            # prefers — resolved wall time, one histogram per row.
+            b = tattr.derive_bucket(args)
+            key = (
+                f"dispatch_latency_s[{name}/{b}]" if b is not None
+                else f"dispatch_latency_s[{name}]"
+            )
+            join.observe(key, time.perf_counter() - t0)
+            return out
+
+        ctx.kernel_call = timed
+
+    # -- gate-mode node heads ---------------------------------------------
+    ctxg = SearchContext(Options(
+        seed=1, randomize=False, host_small_steps=False,
+        parallel_mux=False,
+    ))
+    stg = grow(20)
+
+    def gate_drivers():
+        ctxg.gate_step(stg, miss, mask)
+        ctxg.pair_search(stg, miss, mask, False)
+        ctxg.triple_search(stg, miss, mask)
+
+    gate_drivers()  # warm: compiles happen here, costs captured
+    instrument(ctxg)
+    for _ in range(reps):
+        gate_drivers()
+
+    # -- LUT-mode heads, streams, pivot path ------------------------------
+    ctx = SearchContext(Options(
+        seed=1, lut_graph=True, randomize=False, host_small_steps=False,
+        parallel_mux=False,
+    ))
+    st16, st24 = grow(16, seed=1), grow(24, seed=2)
+    st50 = grow(50, seed=3)
+    live50 = np.asarray(st50.live_tables())
+    # Planted 5-LUT hit ((a^b^c)^(d^e) decomposes), so the pivot sweep
+    # exits on an early tile instead of walking all of C(50,5) on CPU.
+    hit5 = (live50[10] ^ live50[20] ^ live50[30] ^ live50[40]
+            ^ live50[49]).astype(np.uint32)
+
+    def lut_drivers():
+        ctx.lut_step(st24, miss, mask, [])           # lut_step_stream
+        ctx.lut7_step(st16, miss, mask, [])          # lut7_step_stream
+        slut.lut3_search(ctx, st24, miss, mask, [])  # lut3_stream
+        slut.lut5_search(ctx, st24, miss, mask, [])  # lut5_stream
+        slut.lut5_search(ctx, st50, hit5, mask, [])  # pivot cells+stream
+
+    # -- conditional tails, dispatched directly ---------------------------
+    binom = sweeps.binom_table()
+    blo, bhi = sweeps.binom_table_wide()
+    _, w_tab, m_tab = sweeps.lut5_split_tables()
+    idx_tab, pp_tab = sweeps.lut7_pair_tables()
+    excl = SearchContext.excl_array([])
+    tab24 = np.zeros((C.bucket_size(24), 8), dtype=np.uint32)
+    tab24[:24] = np.asarray(st24.live_tables())
+    infeasible = np.uint32(0xFFFFFFFF)
+    tl, th = slut.pivot_tile_shape(50)
+    p2pad, tpad = slut.pivot_padded_shapes(50, tl, th)
+
+    def tail_dispatches():
+        ctx.kernel_call(
+            "feasible_stream", dict(k=5, chunk=4096),
+            (tab24, binom, 24, miss, mask, excl, 0, 4096), g=24,
+        )
+        ctx.kernel_call(
+            "feasible_stream_wide", dict(k=5, chunk=4096, backend="xla"),
+            (tab24, blo, bhi, 24, miss, mask, excl, 0, 0, 4096, 0), g=24,
+        )
+        ctx.kernel_call(
+            "lut_filter", {},
+            (tab24, np.zeros((1024, 7), np.int32),
+             np.ones(1024, bool), miss, mask), g=24,
+        )
+        ctx.kernel_call(
+            "lut5_filter", dict(backend="xla"),
+            (tab24, np.zeros((1024, 5), np.int32),
+             np.ones(1024, bool), miss, mask), g=24,
+        )
+        ctx.kernel_call(
+            "lut5_solve", {},
+            (np.full(1024, infeasible), np.full(1024, infeasible),
+             w_tab, m_tab, 0), g=24,
+        )
+        ctx.kernel_call(
+            "lut7_solve", {},
+            (np.full((256, 4), infeasible), np.full((256, 4), infeasible),
+             idx_tab, pp_tab, 0), g=24,
+        )
+        cells = np.zeros((4, p2pad, 8), np.uint32)
+        ctx.kernel_call(
+            "lut5_pivot_tile", dict(tl=tl, th=th),
+            (np.zeros((C.bucket_size(50), 8), np.uint32), cells, cells,
+             cells, np.zeros(p2pad, bool), np.zeros(p2pad, bool),
+             np.zeros((tpad, 5), np.int32), 0), g=50,
+        )
+
+    lut_drivers()
+    tail_dispatches()
+    instrument(ctx)
+    for _ in range(reps):
+        lut_drivers()
+        tail_dispatches()
+
+    # -- fused round driver (real chain driver) ---------------------------
+    ctxr = SearchContext(Options(
+        lut_graph=True, randomize=False, warmup=False, parallel_mux=False,
+    ))
+    str_, rounds = _round_chain_problem(8, 12)
+    run_round_chain(ctxr, str_, rounds, rounds_per_dispatch=4)  # warm
+    instrument(ctxr)
+    str2, rounds2 = _round_chain_problem(8, 12)
+    run_round_chain(ctxr, str2, rounds2, rounds_per_dispatch=4)
+
+    rows = tattr.table(join)
+    covered = {r["kernel"] for r in rows}
+    missing = sorted(set(KERNELS) - covered)
+    entries = [
+        {"metric": f"roofline_{r['kernel']}", "unit": "roofline row", **r}
+        for r in rows
+    ]
+    entries.append({
+        "metric": "roofline_coverage",
+        "unit": "kernels",
+        "value": len(covered),
+        "registry_kernels": len(KERNELS),
+        "missing": missing,
+        "backend": tattr.backend(),
+        "peaks": tattr.peaks(),
+    })
+    if missing:
+        raise AssertionError(
+            f"roofline coverage hole: no cost row for {missing}"
+        )
+    return entries
+
+
+# --- drift gates (bench.py --check) ---------------------------------------
+#
+# The repo carries 13 committed BENCH_*.json files and, until this
+# comparator, zero automated regression detection over them.  --check
+# re-runs a CHEAP section and diffs its t1-normalized / structural
+# headline metrics against the committed baseline with explicit noise
+# bands, exiting nonzero on regression.  Only window-normalized ratios
+# are gated (dispatch ratios, speedups) — raw cand/s across machines or
+# throttle windows is exactly the comparison the t1 convention forbids.
+
+#: name -> (runner, baseline file, [(metric, field, band, direction)]).
+#: direction "lower": regression = new > base*(1+band);
+#: "higher": regression = new < base*(1-band);
+#: "exact": regression = new != base.
+BENCH_CHECKS = {
+    "multiround": (
+        # Fixed small chain: the gated dispatch/sync ratios are
+        # size-independent, and this section rides every tier-1 run.
+        lambda: bench_device_rounds(8, n_rounds=16),
+        "BENCH_MULTIROUND.json",
+        [
+            ("device_rounds_dispatch_ratio", "value", 0.01, "lower"),
+            ("device_rounds_dispatch_ratio", "sync_ratio", 0.01, "lower"),
+            ("device_rounds_dispatch_ratio", "circuits_bit_identical",
+             0.0, "exact"),
+        ],
+    ),
+    "hoststream": (
+        bench_host_stream_pipeline,
+        "BENCH_PIPELINE.json",
+        [
+            # Generous band: CPU-CI speedups breathe with load; the
+            # gate exists to catch the pipeline silently serializing
+            # (ratio collapsing toward <= 1), not 10% noise.
+            ("lut5_host_stream_pipelined", "speedup_vs_serial",
+             0.35, "higher"),
+        ],
+    ),
+}
+
+
+def bench_check(sections=None) -> int:
+    """``bench.py --check [section...]``: the perf-drift gate.  Returns
+    the process exit code (0 = inside every noise band)."""
+    sections = list(sections) if sections else ["multiround"]
+    report, regressions = [], []
+    for name in sections:
+        if name not in BENCH_CHECKS:
+            print(json.dumps({
+                "metric": "bench_check", "error": f"unknown section {name}",
+                "known": sorted(BENCH_CHECKS),
+            }))
+            return 2
+        runner, baseline_file, gates = BENCH_CHECKS[name]
+        path = os.path.join(HERE, baseline_file)
+        if not os.path.exists(path):
+            report.append({
+                "section": name, "status": "no-baseline",
+                "baseline": baseline_file,
+            })
+            continue
+        with open(path) as f:
+            base_entries = json.load(f)
+        entries = runner()
+
+        def field_of(entries_, metric, field):
+            for e in entries_:
+                if e.get("metric") == metric and field in e:
+                    return e[field]
+            return None
+
+        for metric, field, band, direction in gates:
+            base = field_of(base_entries, metric, field)
+            new = field_of(entries, metric, field)
+            row = {
+                "section": name, "metric": metric, "field": field,
+                "baseline": base, "measured": new, "band": band,
+                "direction": direction,
+            }
+            if base is None or new is None:
+                row["status"] = "skipped (missing value)"
+            else:
+                if direction == "exact":
+                    bad = new != base
+                elif direction == "lower":
+                    bad = new > base * (1.0 + band)
+                else:  # "higher"
+                    bad = new < base * (1.0 - band)
+                row["status"] = "REGRESSED" if bad else "ok"
+                if bad:
+                    regressions.append(row)
+            report.append(row)
+    print(json.dumps({
+        "metric": "bench_check",
+        "sections": sections,
+        "gates": report,
+        "regressions": len(regressions),
+        "ok": not regressions,
+    }, indent=1))
+    return 1 if regressions else 0
+
+
 def _backend_alive(timeout_s: float = 120.0):
     """Probes device availability in a subprocess with a hard timeout.
 
@@ -2518,6 +2854,40 @@ def main() -> None:
             n_fused = max(1, int(sys.argv[i + 1]))
         detail = bench_device_rounds(n_fused)
         with open(os.path.join(HERE, "BENCH_MULTIROUND.json"), "w") as f:
+            json.dump(with_meta(detail), f, indent=1)
+        print(json.dumps(detail[-1]))
+        return
+    if "--check" in sys.argv:
+        # Drift gate: re-run a cheap section, diff its t1-normalized /
+        # structural headline metrics against the committed BENCH_*.json
+        # baseline with explicit noise bands, exit nonzero on
+        # regression.  CPU-safe (the tier-1 suite runs the multiround
+        # section on every verify).
+        if SMOKE or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--check")
+        sections = []
+        for a in sys.argv[i + 1:]:
+            if a.startswith("-"):
+                break
+            sections.append(a)
+        raise SystemExit(bench_check(sections or None))
+    if "--roofline" in sys.argv:
+        # Standalone mode: the measured roofline for every registry
+        # kernel (BENCH_ROOFLINE.json) — ROOFLINE.md's maintained
+        # successor.  Honors JAX_PLATFORMS; CPU runs exercise coverage
+        # and the placement arithmetic, silicon runs write the real
+        # numbers.
+        if SMOKE:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_roofline()
+        with open(os.path.join(HERE, "BENCH_ROOFLINE.json"), "w") as f:
             json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[-1]))
         return

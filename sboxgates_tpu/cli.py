@@ -144,6 +144,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "default trace.json in --output-dir); purely "
                         "observational — results are bit-identical with "
                         "or without it")
+    p.add_argument("--status-port", type=int, default=None, metavar="PORT",
+                   help="serve a read-only live-introspection endpoint "
+                        "on http://127.0.0.1:PORT/status — a JSON "
+                        "snapshot of counters, histogram quantiles, "
+                        "search-space coverage with derived ETA, "
+                        "warmup/breaker state, and the per-kernel "
+                        "roofline attribution table; 0 binds an "
+                        "ephemeral port (reported in the heartbeat "
+                        "start line's config).  Observation-only: "
+                        "results are bit-identical with or without it")
     p.add_argument("--metrics-interval", type=float, default=60.0,
                    metavar="S",
                    help="telemetry heartbeat period in seconds (default "
@@ -333,6 +343,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _err(f"Bad fleet max wave value: {args.fleet_max_wave}")
     if args.metrics_interval < 0:
         return _err(f"Bad metrics interval value: {args.metrics_interval}")
+    if args.status_port is not None and not (0 <= args.status_port <= 65535):
+        return _err(f"Bad status port value: {args.status_port}")
     if args.output_dir is None:
         args.output_dir = "."
     # Telemetry artifacts (heartbeat JSONL, metrics.json, flight-recorder
@@ -558,6 +570,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet_max_wave=args.fleet_max_wave,
         # jaxlint: ignore[R7] telemetry is observation-only (zero-sync counter-asserted)
         trace=args.trace is not None,
+        # jaxlint: ignore[R7] live-introspection endpoint; observation-only, never shapes the draw stream
+        status_port=args.status_port,
     )
 
     # ONE construction serves both the journal's recorded configuration
@@ -682,12 +696,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             tele_dir = _shard_dir(tele_root, rank)
         else:
             tele_dir = tele_root
+    # With the persistent cache live, re-lowering a just-compiled kernel
+    # is a cache deserialize — cheap enough for kernel_call to capture
+    # cost analysis (telemetry/attribution.py) on its lazy compiles too,
+    # so metrics.json's attribution section fills on the lazy paths the
+    # warmer doesn't cover.  Scoped to this run and restored in
+    # _teardown (the flag is process state; without a cache a second
+    # lowering would silently double a cold compile, so it stays off).
+    from .telemetry import attribution as _tattr
+
+    lazy_capture_prev = _tattr.lazy_capture_enabled()
+    if cache_dir is not None:
+        _tattr.set_lazy_capture(True)
+
+    # Live status endpoint (--status-port): started BEFORE the heartbeat
+    # so the bound port (ephemeral with --status-port 0) rides the
+    # heartbeat start line's config and tooling can find it.
+    status_server = None
+    if opt.status_port is not None:
+        from .telemetry.status import StatusServer
+
+        status_server = StatusServer(
+            ctx.stats, port=opt.status_port,
+            extra={"engine": ctx.status_state},
+            gates_fn=lambda: ctx.last_dispatch_gates,
+        ).start()
+        log(
+            "Status endpoint on "
+            f"http://127.0.0.1:{status_server.port}/status"
+        )
     heartbeat = None
     if tele_dir is not None:
         _flight.configure(tele_dir, rank=rank)
+        hb_config = run_config
+        if status_server is not None:
+            # Copied, not mutated: run_config also feeds the
+            # multi-process startup-agreement digest, and a per-rank
+            # ephemeral port must never enter that.
+            hb_config = dict(run_config, status_port=status_server.port)
         heartbeat = Heartbeat(
             ctx.stats, tele_dir, interval_s=args.metrics_interval,
-            rank=rank, resume=resume, run_config=run_config,
+            rank=rank, resume=resume, run_config=hb_config,
         ).start()
 
     torn_down = False
@@ -706,6 +755,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if torn_down:
             return
         torn_down = True
+        # Signal handlers are process state like the tracer/recorder:
+        # restore them so an in-process caller's next run (or the
+        # interpreter's own defaults) aren't left pointing at this
+        # run's torn-down context.
+        for _sig, _prev in prev_handlers.items():
+            try:
+                signal.signal(_sig, _prev)
+            except (ValueError, OSError):
+                pass
+        prev_handlers.clear()
+        _tattr.set_lazy_capture(lazy_capture_prev)
+        if status_server is not None:
+            # Bounded: closes the socket and joins the serve thread —
+            # no dangling thread or port past teardown.
+            status_server.shutdown()
         if ctx.warmer is not None:
             # Bounded join; a worker parked in a hung backend compile is
             # a daemon and never blocks exit.
@@ -760,6 +824,64 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"{k}={v}" for k, v in sorted(ws.items())
                 ))
         return 0
+
+    # Preemption observability: managed pods deliver SIGTERM before the
+    # kill (resilience/faults.py) — that grace window exists for exactly
+    # this post-mortem.  The handler runs the dump + full teardown
+    # (flight dump with its forced out-of-band heartbeat line, final
+    # heartbeat line, atomic metrics.json, trace export) on a WORKER
+    # thread with a bounded join, then re-raises the signal with the
+    # default disposition so the exit status still says "killed by
+    # SIGTERM".  The worker matters: a signal handler runs on the main
+    # thread mid-bytecode, and if that thread was interrupted while
+    # holding a telemetry lock (the registry's, the recorder's), doing
+    # the dump inline would re-acquire a non-reentrant lock and
+    # deadlock away the whole grace window — the bounded join turns
+    # that worst case into "exit after 15 s with whatever got out"
+    # instead of a hang until SIGKILL.
+    #
+    # SIGINT is deliberately NOT handled: Python's default
+    # KeyboardInterrupt unwinds the search stack (prefetcher close,
+    # journal/fleet cleanup — orderly shutdown a hard kill would skip)
+    # and then produces the same artifacts through the fatal-exception
+    # dump + the finally _teardown below.
+    import signal
+
+    prev_handlers = {}
+    #: Bounded grace for the signal-dump worker; managed-pod
+    #: SIGTERM->SIGKILL windows are typically 15-30 s.
+    signal_dump_join_s = 15.0
+
+    def _on_signal(signum, frame) -> None:
+        name = signal.Signals(signum).name
+
+        def work() -> None:
+            path = _flight.flight_dump(
+                f"signal:{name}", registry=ctx.stats,
+                extra={"signal": name},
+            )
+            if path is not None:
+                ctx.stats.inc("flight_dumps")
+            _teardown()
+
+        t = _threading.Thread(
+            target=work, name="sbg-signal-dump", daemon=True
+        )
+        t.start()
+        t.join(signal_dump_join_s)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    import threading as _threading
+
+    if _threading.current_thread() is _threading.main_thread():
+        try:
+            prev_handlers[signal.SIGTERM] = signal.signal(
+                signal.SIGTERM, _on_signal
+            )
+        except (ValueError, OSError):
+            # Embedders with their own signal policy keep it.
+            pass
 
     if args.verbose >= 1:
         # Byte-format parity with the reference's listing incl. trailing

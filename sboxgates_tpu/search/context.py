@@ -9,6 +9,7 @@ candidate spaces through the jitted kernels in :mod:`sboxgates_tpu.ops.sweeps`.
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 import time
 from collections import OrderedDict
@@ -23,12 +24,15 @@ from ..graph.state import GATES, State
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience import deadline as _deadline
+from ..telemetry import attribution as _tattr
 from ..telemetry import flight as _tflight
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import trace as _ttrace
 from ..utils import guards as _guards
 from ..utils.profile import PhaseProfiler
 from . import warmup as _warmup
+
+logger = logging.getLogger(__name__)
 
 # Gate-count buckets: live tables are zero-padded up to the next bucket so
 # jitted sweeps see a small, fixed set of shapes.  Two buckets only — gather
@@ -228,6 +232,14 @@ class Options:
     # host-side events only (zero extra device syncs) and results are
     # identical on or off.
     trace: bool = False
+    # Live status endpoint (--status-port, telemetry.status): serve a
+    # read-only /status JSON snapshot (counters, histogram quantiles,
+    # search-space coverage + ETA, warmup/breaker state, attribution
+    # table) on this local port.  None (default) = off; 0 = bind an
+    # ephemeral port, reported via the heartbeat start line's config.
+    # Purely observational: the snapshot reads the registry and the
+    # attribution store — zero device syncs, results identical on/off.
+    status_port: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -415,6 +427,11 @@ class SearchContext:
         # them at exit).
         if opt.trace:
             _ttrace.tracer().enabled = True
+        # Pin the attribution backend so roofline rows are drawn against
+        # the right peaks table (telemetry never imports jax itself).
+        import jax as _jax
+
+        _tattr.note_backend(_jax.default_backend())
         # Device-resident live-table cache (device_tables): placed
         # [bucket, 8] buffers memoized on content digest.  Shared BY
         # REFERENCE (dict + lock) with every RestartContext view, so
@@ -472,6 +489,11 @@ class SearchContext:
 
         self._hb = {"next": None, "t0": 0.0, "calls": 0}
         self._hb_lock = _threading.Lock()
+        # Gate count of the most recent node sweep (device OR native
+        # path) — the |C(g,k)| denominator the /status coverage section
+        # reads through status_state().  Plain int store: atomic, and
+        # deliberately outside the stats registry (merge() sums).
+        self.last_dispatch_gates: Optional[int] = None
 
     # -- helpers ----------------------------------------------------------
 
@@ -809,6 +831,28 @@ class SearchContext:
     def _kernel_call_traced(self, name, statics, args, g, sp):
         warmer = self.warmer
         t_issue = time.perf_counter()
+        if g is not None:
+            # Coverage denominator for the /status endpoint: the gate
+            # count the latest dispatch swept at (|C(g,k)| source).  A
+            # plain attribute, NOT a registry gauge — the stats
+            # registry's merge() sums scalars (correct for counters,
+            # nonsense for a gauge), and the native/device parity
+            # tests compare full scalar dicts.
+            self.last_dispatch_gates = g
+        bucket = _tattr.derive_bucket(args)
+        cost = _tattr.annotation(name, bucket)
+        if cost is not None:
+            # Cost args on the dispatch span (Perfetto renders them):
+            # two dict lookups when captured, nothing otherwise.
+            sp.set(**cost)
+        # Latency histogram member keyed like the attribution rows —
+        # per (kernel, bucket), so a kernel dispatched at two padded
+        # shapes never pools their latencies (a bucket-64 roofline row
+        # joined against bucket-512 latencies would misplace both).
+        lat_key = (
+            f"dispatch_latency_s[{name}/{bucket}]" if bucket is not None
+            else f"dispatch_latency_s[{name}]"
+        )
         if warmer is not None:
             warmer.note_gates(g)
             compiled = warmer.lookup(name, statics, args)
@@ -822,8 +866,7 @@ class SearchContext:
                 try:
                     out = compiled(*args)
                     self.stats.observe(
-                        f"dispatch_latency_s[{name}]",
-                        time.perf_counter() - t_issue,
+                        lat_key, time.perf_counter() - t_issue
                     )
                     return out
                 except (TypeError, ValueError) as e:
@@ -859,10 +902,33 @@ class SearchContext:
             _ttrace.tracer().record(
                 f"compile[{name}]", "compile", t0, t1, {"kernel": name}
             )
+            self._capture_lazy_cost(name, statics, args, bucket)
         # Host-side issue latency (async dispatch: this is queue/trace
         # cost, not device time — device time shows up in device_wait_s).
-        self.stats.observe(f"dispatch_latency_s[{name}]", t1 - t_issue)
+        self.stats.observe(lat_key, t1 - t_issue)
         return out
+
+    def _capture_lazy_cost(self, name, statics, args, bucket) -> None:
+        """Cost capture for a lazy compile observed at kernel_call: the
+        jit cache holds no handle to the executable, so the attribution
+        row comes from re-lowering through the AOT path.  Gated on
+        ``telemetry.attribution.set_lazy_capture`` — the CLI enables it
+        for runs with a persistent compile cache (the re-lower is then
+        a cache deserialize) and ``bench.py --roofline`` enables it
+        explicitly; otherwise only the warmer's AOT builds feed the
+        table, so a cold compile is never silently paid twice.  Once
+        per (kernel, bucket), never on the steady-state dispatch path,
+        and a failure only costs the row."""
+        if not _tattr.lazy_capture_enabled() or _tattr.have(name, bucket):
+            return
+        try:
+            compiled = _warmup.KERNELS[name].fn.lower(
+                *args, **statics
+            ).compile()
+            _tattr.capture(name, compiled, args, bucket=bucket,
+                           source="lazy")
+        except Exception as e:
+            logger.debug("lazy cost capture for %s failed: %r", name, e)
 
     def observe_job(
         self, name: str, t0: float, t1: float, found: bool
@@ -888,6 +954,22 @@ class SearchContext:
         """Warmer-side telemetry (compiled/failed/in-flight counts) for
         the -vv summary and bench reports; {} when the warmer is off."""
         return {} if self.warmer is None else self.warmer.stats_snapshot()
+
+    def status_state(self) -> dict:
+        """Engine-state section of the live ``/status`` snapshot
+        (telemetry.status.StatusServer ``extra`` provider): warmup,
+        circuit-breaker/degradation, and execution-plan facts the
+        registry's counters alone cannot carry.  Read-only and
+        lock-light — safe to call from the status-server thread."""
+        return {
+            "device_degraded": self.device_degraded,
+            "deadline_enabled": bool(self.deadline_cfg.enabled),
+            "warmup": self.warmup_stats(),
+            "mesh": self.mesh_plan is not None,
+            "fleet": self.fleet_plan is not None or self.opt.fleet,
+            "lut_graph": self.opt.lut_graph,
+            "last_dispatch_gates": self.last_dispatch_gates,
+        }
 
     def place_chunk(self, arr, fill=0):
         """Shards a [N, ...] candidate array over the mesh (no-op without one)."""
@@ -1473,6 +1555,7 @@ class SearchContext:
         """Host-native fused node step (csrc sbg_gate_step) — bit-identical
         verdict to the device kernel, without the dispatch."""
         g = st.num_gates
+        self.last_dispatch_gates = g
         has_not = bool(self.not_entries) and not self.opt.lut_graph
         has_triple = not self.opt.lut_graph and g >= 3
         total3 = comb.n_choose_k(g, 3) if has_triple else 0
@@ -1564,6 +1647,7 @@ class SearchContext:
         from .. import native
 
         g = st.num_gates
+        self.last_dispatch_gates = g
         total3 = comb.n_choose_k(g, 3)
         total5 = comb.n_choose_k(g, 5)
         has5 = lut_head_has5(g)
@@ -1675,6 +1759,7 @@ class SearchContext:
         from .. import native
 
         g = st.num_gates
+        self.last_dispatch_gates = g
         total7 = comb.n_choose_k(g, 7)
         chunk7 = pick_chunk(max(total7, 1), STREAM_CHUNK[7])
         solve7 = LUT7_HEAD_SOLVE_ROWS

@@ -68,6 +68,7 @@ import numpy as np
 from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience.faults import fault_point
+from ..telemetry import attribution as _tattr
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import trace as _ttrace
 
@@ -1019,7 +1020,7 @@ class KernelWarmer:
         try:
             if self.plan.mesh is not None:
                 jobs = [
-                    (key, (lambda b=builder: b().lower), avals, {})
+                    (key, (lambda b=builder: b().lower), avals, {}, key[1])
                     for key, builder, avals in mesh_warm_specs(self.plan, g)
                 ]
             else:
@@ -1029,6 +1030,7 @@ class KernelWarmer:
                         (lambda n=spec.name: KERNELS[n].fn.lower),
                         spec.avals,
                         dict(spec.statics),
+                        spec.name,
                     )
                     for spec in warm_specs(self.plan, g)
                 ]
@@ -1055,7 +1057,7 @@ class KernelWarmer:
                             n, s, sh, na, lanes, self.plan.fleet_mesh,
                             stacked=st,
                         ).lower),
-                    avals, {},
+                    avals, {}, name,
                 )
                 for key, name, statics, shared, nargs, avals, stk
                 in fleet_warm_specs(self.plan, g, lanes, stacked=stacked)
@@ -1071,8 +1073,9 @@ class KernelWarmer:
 
     def _compile_jobs(self, jobs) -> None:
         """Shared AOT loop: each job is (cache key, lower-fn resolver,
-        positional avals, static kwargs)."""
-        for key, lower_of, avals, statics in jobs:
+        positional avals, static kwargs, kernel label — the attribution
+        key the cost capture records under)."""
+        for key, lower_of, avals, statics, kernel_label in jobs:
             with self._lock:
                 if self._stop:
                     return
@@ -1106,4 +1109,9 @@ class KernelWarmer:
                 continue
             with _WARM_LOCK:
                 _WARM_COMPILED[key] = compiled
+            # Free cost probe: the AOT build holds the Compiled object,
+            # so XLA's cost/memory analysis is one method call away —
+            # this is where the attribution table's rows come from on
+            # warmed paths (kernel_call covers the lazy ones).
+            _tattr.capture(kernel_label, compiled, avals, source="warmup")
             self.count("warm_compiled")

@@ -29,6 +29,19 @@ package is the real telemetry layer they migrate onto:
   circuit-breaker trips, replicated degradation, fault-injection
   crashes, and fatal exceptions, with ``dist``-aware rank tagging so
   per-rank dumps from one incident correlate.
+- :mod:`.attribution` — compile-time XLA cost/memory analysis keyed
+  ``(kernel, bucket)``, joined with the measured dispatch-latency
+  histograms into roofline rows (achieved FLOP/s and bytes/s,
+  arithmetic intensity, compute/memory/dispatch-bound placement per
+  backend) — the ``attribution`` section of ``metrics.json`` and the
+  payload of ``bench.py --roofline``.
+- :mod:`.status` — an opt-in read-only ``/status`` HTTP endpoint
+  (``--status-port``) serving a live JSON snapshot: counters,
+  histogram quantiles, search-space coverage with derived ETA,
+  warmup/breaker state, and the attribution table.
+- :mod:`.watch` — ``python -m sboxgates_tpu.telemetry.watch DIR``, a
+  ``top``-style follower of the heartbeat JSONL that works on runs it
+  didn't start and on dead runs.
 
 Import discipline: this package imports NOTHING from the rest of
 ``sboxgates_tpu`` (and never imports jax), so every engine layer —
@@ -45,6 +58,7 @@ from .metrics import (
     MetricsRegistry,
     bump,
 )
+from .status import StatusServer, build_status
 from .trace import Tracer, instant, set_rank, span, tracer
 
 __all__ = [
@@ -54,7 +68,9 @@ __all__ = [
     "Heartbeat",
     "METRICS",
     "MetricsRegistry",
+    "StatusServer",
     "Tracer",
+    "build_status",
     "bump",
     "flight_dump",
     "flight_recorder",

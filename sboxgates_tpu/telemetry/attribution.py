@@ -1,0 +1,260 @@
+"""Performance attribution: XLA cost capture joined with measured
+dispatch latencies into per-``(kernel, bucket)`` roofline rows.
+
+Every compile the engine already owns is a free cost probe: the
+``KernelWarmer``'s AOT builds hold the ``Compiled`` executable in hand,
+and a lazy compile observed at ``SearchContext.kernel_call`` can
+re-lower through the persistent compilation cache for the same object.
+:func:`capture` reads ``compiled.cost_analysis()`` /
+``compiled.memory_analysis()`` — FLOPs, bytes accessed, peak memory —
+and stores them keyed on ``(kernel, bucket)``, where ``bucket`` is the
+leading dimension of the first array operand (the padded table height
+on the per-thread dispatch path, the lane count on stacked fleet
+forms).
+
+:func:`table` then joins the store with the registry's measured
+``dispatch_latency_s[<kernel>]`` histograms to compute achieved FLOP/s,
+achieved bytes/s, arithmetic intensity, and a roofline placement per
+kernel against the per-backend :data:`PEAKS` table — the measured
+successor to ROOFLINE.md's hand-derived memo, covering every registered
+kernel instead of one.  ``metrics.json`` folds the result in as its
+``attribution`` section; ``bench.py --roofline`` writes it as
+BENCH_ROOFLINE.json; the ``/status`` endpoint serves it live.
+
+Everything here is observation-only: capture happens at compile time
+(never on the steady-state dispatch path), reads are dict lookups, and
+no call in this module ever touches a device — the ``compiled`` object
+is duck-typed, so the module keeps the telemetry package's no-jax
+import discipline.
+
+Caveat on the measured rates: ``dispatch_latency_s`` times the
+host-side issue of an async dispatch.  On a busy accelerator queue that
+is an underestimate of wall latency and the achieved rates are an upper
+bound; on the blocking paths (and CPU) it is the end-to-end time and
+the rates are honest.  The placement verdict additionally compares the
+measured latency against the roofline model time: a kernel whose
+dispatches take an order of magnitude longer than its model time is
+``dispatch-bound`` — the link/host overhead dominates, not the chip.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: Per-backend peak rates the roofline is drawn against.  The tpu row
+#: is the v5e the tunnel chip reports (ROOFLINE.md: ~394 int8-TOPS,
+#: ~800 GB/s HBM); the cpu row is a deliberately round single-socket
+#: envelope — CPU placements are for CI plumbing, not tuning calls.
+PEAKS: Dict[str, Dict[str, float]] = {
+    "tpu": {"flops_per_s": 3.94e14, "bytes_per_s": 8.0e11},
+    "gpu": {"flops_per_s": 1.0e14, "bytes_per_s": 1.5e12},
+    "cpu": {"flops_per_s": 1.0e11, "bytes_per_s": 5.0e10},
+}
+
+#: Measured mean latency beyond this multiple of the roofline model
+#: time classifies a kernel as dispatch-bound: the time is going to the
+#: link / host queue, not the chip's compute or memory system.
+DISPATCH_BOUND_FACTOR = 10.0
+
+_LOCK = threading.Lock()
+#: (kernel, bucket) -> cost record.  Values are replaced on re-capture
+#: (same shape recompiled), with a capture tally kept for diagnostics.
+_COSTS: Dict[Tuple[str, Optional[int]], dict] = {}
+_BACKEND: Optional[str] = None
+#: Lazy (re-lower at kernel_call) capture is enabled only when the
+#: persistent compilation cache makes the second lowering a cache
+#: deserialize, or when an operator/bench asks for it explicitly —
+#: never silently doubling a cold compile on the critical path.
+_LAZY = False
+
+
+def note_backend(name: Optional[str]) -> None:
+    """Pins the backend the peaks table is read for (called from
+    ``SearchContext.__init__``, the one layer that knows jax)."""
+    global _BACKEND
+    if name:
+        _BACKEND = str(name).lower()
+
+
+def backend() -> str:
+    """Pinned backend > ``JAX_PLATFORMS`` env prefix > ``cpu``."""
+    if _BACKEND is not None:
+        return _BACKEND
+    env = os.environ.get("JAX_PLATFORMS", "")
+    return (env.split(",")[0].strip() or "cpu").lower()
+
+
+def peaks(name: Optional[str] = None) -> Dict[str, float]:
+    b = (name or backend()).lower()
+    for key, row in PEAKS.items():
+        if b.startswith(key):
+            return dict(row, backend=b)  # type: ignore[arg-type]
+    return dict(PEAKS["cpu"], backend=b)  # type: ignore[arg-type]
+
+
+def set_lazy_capture(enabled: bool) -> None:
+    global _LAZY
+    _LAZY = bool(enabled)
+
+
+def lazy_capture_enabled() -> bool:
+    return _LAZY
+
+
+def derive_bucket(args: Sequence) -> Optional[int]:
+    """Bucket label for a dispatch: the leading dimension of the first
+    array operand (the padded table height for registry kernels, the
+    solve-row pad for the solvers, lanes for stacked fleet forms)."""
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape:
+            return int(shape[0])
+    return None
+
+
+def have(kernel: str, bucket: Optional[int]) -> bool:
+    return (kernel, bucket) in _COSTS
+
+
+def _cost_dict(compiled) -> dict:
+    """``cost_analysis()`` across jax versions: a dict on current
+    releases, a one-element list of dicts on older ones."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def capture(
+    kernel: str, compiled, args: Sequence = (),
+    bucket: Optional[int] = None, source: str = "aot",
+) -> bool:
+    """Reads XLA's cost/memory analysis off one compiled executable and
+    records it under ``(kernel, bucket)``.  Never raises — attribution
+    rides compile paths where a telemetry error must not fail the
+    search; returns False when the backend offers no analysis."""
+    try:
+        if bucket is None:
+            bucket = derive_bucket(args)
+        cost = _cost_dict(compiled)
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(cost.get("bytes accessed", 0.0) or 0.0)
+        peak = None
+        try:
+            mem = compiled.memory_analysis()
+            peak = sum(
+                float(getattr(mem, attr, 0) or 0)
+                for attr in (
+                    "argument_size_in_bytes",
+                    "output_size_in_bytes",
+                    "temp_size_in_bytes",
+                )
+            ) or None
+        except Exception as e:
+            # Some backends ship no memory analysis; the FLOP/byte row
+            # still stands without the peak column.
+            logger.debug("memory_analysis unavailable for %s: %r", kernel, e)
+        if flops <= 0.0 and bytes_accessed <= 0.0:
+            return False
+        with _LOCK:
+            prev = _COSTS.get((kernel, bucket))
+            _COSTS[(kernel, bucket)] = {
+                "kernel": kernel,
+                "bucket": bucket,
+                "flops": flops,
+                "bytes_accessed": bytes_accessed,
+                "peak_memory_bytes": peak,
+                "source": source,
+                "captures": (prev["captures"] + 1) if prev else 1,
+                "captured_unix": time.time(),
+            }
+        return True
+    except Exception as e:
+        logger.debug("cost capture for %s failed: %r", kernel, e)
+        return False
+
+
+def annotation(kernel: str, bucket: Optional[int]) -> Optional[dict]:
+    """Cheap per-dispatch cost args for the trace span (Perfetto
+    renders them): one dict lookup, no lock on the read path (CPython
+    dict reads are atomic; writers replace whole values)."""
+    rec = _COSTS.get((kernel, bucket))
+    if rec is None:
+        return None
+    return {"flops": rec["flops"], "bytes_accessed": rec["bytes_accessed"]}
+
+
+def _row(rec: dict, lat: Optional[dict], pk: Dict[str, float]) -> dict:
+    flops, nbytes = rec["flops"], rec["bytes_accessed"]
+    pk_f, pk_b = pk["flops_per_s"], pk["bytes_per_s"]
+    ai = (flops / nbytes) if nbytes > 0 else None
+    model_time = max(flops / pk_f, nbytes / pk_b)
+    row = dict(rec)
+    row["arithmetic_intensity"] = ai
+    row["model_time_s"] = model_time
+    row["dispatches"] = int(lat["count"]) if lat else 0
+    if lat and lat["count"]:
+        mean = lat["total"] / lat["count"]
+        row["mean_dispatch_latency_s"] = mean
+        row["p99_dispatch_latency_s"] = lat.get("p99")
+        if mean > 0:
+            row["achieved_flops_per_s"] = flops / mean
+            row["achieved_bytes_per_s"] = nbytes / mean
+            ridge = pk_f / pk_b
+            if mean > DISPATCH_BOUND_FACTOR * model_time:
+                row["roofline"] = "dispatch-bound"
+            elif ai is not None and ai >= ridge:
+                row["roofline"] = "compute-bound"
+            else:
+                row["roofline"] = "memory-bound"
+            bound = min(pk_f, (ai if ai is not None else 0.0) * pk_b) or pk_f
+            row["roofline_utilization"] = (flops / mean) / bound
+    return row
+
+
+def table(registry=None) -> List[dict]:
+    """The joined attribution rows, sorted by (kernel, bucket).
+    ``registry`` is a ``MetricsRegistry`` (or anything with
+    ``histograms()``); None produces cost-only rows."""
+    hists = registry.histograms() if registry is not None else {}
+    pk = peaks()
+    with _LOCK:
+        recs = [dict(v) for v in _COSTS.values()]
+    rows = []
+    for rec in recs:
+        # Preferred join: the (kernel, bucket)-keyed member kernel_call
+        # observes, so a kernel dispatched at two padded shapes never
+        # pools their latencies into one row.  Per-kernel fallback for
+        # callers that observe without a bucket.
+        lat = hists.get(
+            f"dispatch_latency_s[{rec['kernel']}/{rec['bucket']}]"
+        )
+        if lat is None:
+            lat = hists.get(f"dispatch_latency_s[{rec['kernel']}]")
+        rows.append(_row(rec, lat, pk))
+    rows.sort(key=lambda r: (r["kernel"], r["bucket"] or 0))
+    return rows
+
+
+def snapshot(registry=None) -> dict:
+    """The ``attribution`` section of ``metrics.json`` / ``/status``."""
+    return {
+        "backend": backend(),
+        "peaks": peaks(),
+        "dispatch_bound_factor": DISPATCH_BOUND_FACTOR,
+        "rows": table(registry),
+    }
+
+
+def reset() -> None:
+    """Drops every captured cost record (tests, bench arms)."""
+    global _BACKEND
+    with _LOCK:
+        _COSTS.clear()
+    _BACKEND = None

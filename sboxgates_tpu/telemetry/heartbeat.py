@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from . import attribution as _attribution
 from . import flight as _flight
 from .metrics import GLOBAL, MetricsRegistry
 
@@ -108,6 +109,17 @@ class Heartbeat:
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "counters": self.registry.scalars(),
             "process": GLOBAL.scalars(),
+            # Quantile summaries instead of raw bucket tallies: the
+            # operator-facing slice of each histogram (count + p50/90/99
+            # + mean), cheap enough to carry on every line.
+            "quantiles": {
+                name: {
+                    k: snap[k]
+                    for k in ("count", "mean", "p50", "p90", "p99")
+                    if k in snap
+                }
+                for name, snap in self.registry.histograms().items()
+            },
         }
         if kind == "start" and self.run_config is not None:
             rec["config"] = self.run_config
@@ -148,6 +160,10 @@ class Heartbeat:
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "heartbeat_lines": self._seq,
             "process": GLOBAL.scalars(),
+            # Per-(kernel, bucket) roofline rows: compile-time cost
+            # analysis joined with this run's measured dispatch
+            # latencies (telemetry/attribution.py).
+            "attribution": _attribution.snapshot(self.registry),
             **self.registry.snapshot(),
         }
         if self.run_config is not None:

@@ -117,6 +117,11 @@ METRICS: Dict[str, MetricDef] = {
     "batched_rows": MetricDef(COUNTER, "rows", "rendezvous-batched kernel rows"),
     # heartbeat bookkeeping
     "heartbeats": MetricDef(COUNTER, "lines", "telemetry.jsonl heartbeat lines written"),
+    # live introspection (telemetry/status.py)
+    "status_requests": MetricDef(
+        COUNTER, "requests",
+        "/status snapshots served by the live status endpoint",
+    ),
     # histograms (bracketed members inherit the base declaration)
     "device_wait_s": MetricDef(
         HISTOGRAM, "s",
@@ -125,8 +130,9 @@ METRICS: Dict[str, MetricDef] = {
     ),
     "dispatch_latency_s": MetricDef(
         HISTOGRAM, "s",
-        "host-side kernel dispatch issue latency (per-kernel members: "
-        "dispatch_latency_s[<kernel>])",
+        "host-side kernel dispatch issue latency (members keyed like "
+        "the attribution rows: dispatch_latency_s[<kernel>/<bucket>], "
+        "bucket-less dispatches as dispatch_latency_s[<kernel>])",
     ),
     "job_time_to_first_hit_s": MetricDef(
         HISTOGRAM, "s",
@@ -186,6 +192,33 @@ class Histogram:
         self.min = min(self.min, other.min)
         self.max = max(self.max, other.max)
 
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        ``histogram_quantile`` semantics, clamped to the observed
+        range).  The target rank ``q * count`` is located in its
+        bucket; the estimate interpolates linearly between the
+        bucket's edges (lower edge 0 for the first bucket).  Two exact
+        edge cases: a rank landing in the overflow bucket returns the
+        observed max (the bucket has no upper bound), and the clamp to
+        ``[min, max]`` keeps a one-bucket histogram from reporting
+        values outside what was ever observed."""
+        if not self.count:
+            return float("nan")
+        target = q * self.count
+        cum = 0.0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                if i == len(self.bounds):  # overflow bucket: unbounded
+                    return self.max
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                est = lo + (hi - lo) * (target - cum) / c
+                return min(max(est, self.min), self.max)
+            cum += c
+        return self.max
+
     def snapshot(self) -> dict:
         out = {
             "count": self.count,
@@ -197,6 +230,12 @@ class Histogram:
             out["min"] = self.min
             out["max"] = self.max
             out["mean"] = self.total / self.count
+            # Operator-facing summaries: bucket-interpolated quantiles
+            # instead of raw tallies (metrics.json, heartbeat lines,
+            # and the /status endpoint all read this snapshot).
+            out["p50"] = self.quantile(0.50)
+            out["p90"] = self.quantile(0.90)
+            out["p99"] = self.quantile(0.99)
         return out
 
 

@@ -1,0 +1,207 @@
+"""Live run introspection: a read-only stdlib ``http.server`` status
+endpoint serving a ``/status`` JSON snapshot of the run.
+
+Off by default; ``--status-port N`` / ``Options.status_port`` turns it
+on (``0`` binds an ephemeral port, reported back through the heartbeat
+start line's config so tooling can find it).  The snapshot is built
+entirely from state the engine already maintains — the metrics
+registry's counters and histogram quantiles, the per-phase search-space
+coverage derived from the candidate counters, the attribution table,
+and whatever extra provider callables the owner wires in (warmup /
+breaker / degradation state from the context) — so serving it makes
+zero device syncs and perturbs nothing: an operator refreshing
+``/status`` in a loop is invisible to the search.
+
+This is the operator window the serve-mode orchestrator will run
+behind; until the run ends and ``metrics.json`` lands, it is the only
+way to see p99 time-to-first-hit, coverage, or roofline placement on a
+live run.
+
+Server shape: a plain single-threaded ``HTTPServer`` driven by one
+daemon thread (:meth:`StatusServer._serve`, pinned in ``[tool.jaxlint]
+thread_roots``).  Requests are serialized — fine for a human/poller
+endpoint — and :meth:`shutdown` joins the thread, so a stopped run
+leaves no dangling socket or thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Callable, Dict, Optional
+
+from . import attribution as _attribution
+
+logger = logging.getLogger(__name__)
+
+#: /status schema version (additive growth keeps the version; key
+#: removals/renames bump it — the endpoint test pins the key set).
+STATUS_SCHEMA = 1
+
+#: Candidate counters -> the k of the |C(g,k)| space they sweep.
+COVERAGE_PHASES: Dict[str, int] = {
+    "pair_candidates": 2,
+    "triple_candidates": 3,
+    "lut3_candidates": 3,
+    "lut5_candidates": 5,
+    "lut7_candidates": 7,
+}
+
+
+def coverage(
+    scalars: dict, uptime_s: float, g: Optional[int] = None
+) -> dict:
+    """Per-phase search-space coverage from the candidate counters the
+    drivers already maintain: cumulative candidates examined, the
+    CURRENT node's |C(g, k)| (``g`` = the owner's latest node sweep
+    gate count, ``SearchContext.last_dispatch_gates``), the observed
+    sweep rate, and the derived ETA for one full sweep of the current
+    node's space at that rate.  The examined totals accumulate across
+    nodes, so the ratio is a rate/ETA source, not a progress bar — the
+    ETA is "how long one whole current-node sweep takes at the
+    measured rate", the number an operator sizing a run wants."""
+    out: dict = {}
+    for name, k in COVERAGE_PHASES.items():
+        examined = scalars.get(name)
+        if not examined:
+            continue
+        row = {"examined": int(examined), "k": k}
+        if uptime_s > 0:
+            rate = examined / uptime_s
+            row["rate_per_s"] = rate
+        if g is not None and g >= k:
+            space = math.comb(int(g), k)
+            row["current_space"] = space
+            if uptime_s > 0 and examined > 0:
+                row["eta_current_space_s"] = space / (examined / uptime_s)
+        out[name] = row
+    return out
+
+
+def build_status(
+    registry,
+    t0_monotonic: float,
+    extra: Optional[Dict[str, Callable[[], object]]] = None,
+    gates_fn: Optional[Callable[[], Optional[int]]] = None,
+) -> dict:
+    """The /status payload; also reused verbatim by tests asserting
+    parity with the final ``metrics.json`` (both read the same
+    registry snapshot).  ``gates_fn`` supplies the current node's gate
+    count for the coverage denominators (the CLI wires
+    ``SearchContext.last_dispatch_gates``); None degrades coverage to
+    examined-and-rate rows."""
+    uptime = time.monotonic() - t0_monotonic
+    scalars = registry.scalars()
+    hists = registry.histograms()
+    g = None
+    if gates_fn is not None:
+        try:
+            g = gates_fn()
+        except Exception as e:
+            logger.warning("status gates provider failed: %r", e)
+    doc = {
+        "schema": STATUS_SCHEMA,
+        "time_unix": time.time(),
+        "uptime_s": round(uptime, 3),
+        "counters": scalars,
+        "histograms": hists,
+        "coverage": coverage(scalars, uptime, g),
+        "attribution": _attribution.snapshot(registry),
+    }
+    for key, provider in (extra or {}).items():
+        try:
+            doc[key] = provider()
+        except Exception as e:
+            # A status provider failing must degrade to an error note,
+            # never take the endpoint (or the run) down with it.
+            logger.warning("status provider %r failed: %r", key, e)
+            doc[key] = {"error": repr(e)}
+    return doc
+
+
+class StatusServer:
+    """The /status endpoint; see the module docstring."""
+
+    def __init__(
+        self,
+        registry,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        extra: Optional[Dict[str, Callable[[], object]]] = None,
+        gates_fn: Optional[Callable[[], Optional[int]]] = None,
+    ):
+        self.registry = registry
+        self.extra = extra
+        self.gates_fn = gates_fn
+        self._t0 = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+                if self.path.split("?", 1)[0] not in ("/status", "/"):
+                    self.send_error(404, "try /status")
+                    return
+                try:
+                    body = json.dumps(
+                        outer.snapshot(), sort_keys=True
+                    ).encode("utf-8")
+                except Exception as e:
+                    logger.warning("/status snapshot failed: %r", e)
+                    self.send_error(500, "snapshot failed")
+                    return
+                outer.registry.inc("status_requests")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:
+                # Request logging belongs to `logging`, never stderr
+                # (the CLI's stdout/stderr are the search's).
+                logger.debug("status: " + fmt, *args)
+
+        self._server = HTTPServer((host, int(port)), Handler)
+        self._server.timeout = 1.0
+
+    @property
+    def port(self) -> int:
+        """The bound port (meaningful after construction; with
+        ``port=0`` this is the ephemeral port the heartbeat config
+        reports)."""
+        return int(self._server.server_address[1])
+
+    def snapshot(self) -> dict:
+        return build_status(
+            self.registry, self._t0, self.extra, gates_fn=self.gates_fn
+        )
+
+    def start(self) -> "StatusServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve, name="sbg-status", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        try:
+            self._server.serve_forever(poll_interval=0.2)
+        except Exception as e:
+            logger.warning("status server exited: %r", e)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stops serving, closes the socket, and joins the thread —
+        idempotent, and bounded so teardown can never hang an exit."""
+        t = self._thread
+        if t is None:
+            return
+        self._thread = None
+        self._server.shutdown()
+        self._server.server_close()
+        t.join(timeout)

@@ -408,9 +408,16 @@ def test_lut_step_native_bitwise_matches_kernel(randomize):
     assert {1, 4, 5}.issubset(steps_seen), steps_seen
 
 
+@pytest.mark.slow
 def test_lut_step_native_full_search_identical():
     """End-to-end: a LUT-mode search must produce the identical circuit
-    whichever path executes the head sweeps (fixed seed, both modes)."""
+    whichever path executes the head sweeps (fixed seed, both modes).
+
+    Marked slow (~40 s: four full DES searches): the per-verdict parity
+    of the same routing is tier-1-covered by
+    test_lut_step_native_bitwise_matches_kernel, and the gate-mode
+    full-search twin stays tier-1 — see the ROADMAP tier-1 budget
+    note."""
     from sboxgates_tpu.core.ttable import mask_table
     from sboxgates_tpu.graph.xmlio import state_fingerprint
     from sboxgates_tpu.search import make_targets
@@ -808,11 +815,17 @@ def test_lut_engine_continuation_services_pivot_states():
     assert ctx_e.stats["engine_nodes"] >= 1
 
 
+@pytest.mark.slow
 def test_lut_engine_continuation_services_staged_lut7():
     """A state whose 7-LUT space exceeds the single-chunk limit routes
     the staged search through the continuation service; the engine
     materializes the serviced decomposition bit-identically to the
-    Python engine's."""
+    Python engine's.
+
+    Marked slow (~50 s: two full staged-lut7 walks): the continuation
+    service machinery stays tier-1-covered by the pivot-states twin
+    (seconds, same service path) — see the ROADMAP tier-1 budget
+    note."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))
@@ -827,13 +840,17 @@ def test_lut_engine_continuation_services_staged_lut7():
     assert ctx_e.stats.get("python_nodes", 0) == 0
 
 
+@pytest.mark.slow
 def test_lut_engine_service_binds_per_context_views():
     """A RestartContext view inherits the base context's __dict__ —
     including any cached engine device-work service.  A devcall from the
     view's engine (host-only node whose 7-LUT phase is staged) must be
     serviced against the VIEW (its stats, its rng), not the base the
     cached closure was built for: the view counts the serviced work and
-    the base's counters stay untouched until an explicit merge."""
+    the base's counters stay untouched until an explicit merge.
+
+    Marked slow (~30 s: a planted-lut5 priming walk plus a staged-lut7
+    walk through the view) — see the ROADMAP tier-1 budget note."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))

@@ -1,0 +1,359 @@
+"""Live introspection + perf-drift gates (ISSUE 12): the /status
+endpoint, the heartbeat watcher, preemption observability, and
+``bench.py --check``.
+
+Coverage map:
+
+- StatusServer in-process: schema-stable JSON snapshot, coverage/ETA
+  derivation, request counting, clean shutdown with no dangling thread
+  (``test_status_server_*``).
+- The acceptance shape: a subprocess CLI run with ``--status-port 0``
+  reports the bound port via the heartbeat start line, serves /status
+  mid-search, and the polled counters reconcile (monotone) with the
+  final ``metrics.json`` written at teardown
+  (``test_status_endpoint_subprocess``).
+- Preemption: a SIGTERM'd run leaves a flight-recorder dump AND a
+  terminal heartbeat record + metrics.json — the managed-pod grace
+  window artifact (``test_sigterm_dumps_flight_and_final_heartbeat``).
+- Watcher: ``python -m sboxgates_tpu.telemetry.watch DIR --once``
+  renders a dead run's last record from the heartbeat JSONL alone
+  (``test_watch_renders_dead_run``).
+- Drift gate: ``bench.py --check multiround`` re-runs the cheapest
+  bench section and exits 0 against the committed baseline — the gate
+  itself is exercised on every tier-1 run
+  (``test_bench_check_multiround_gate``).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sboxgates_tpu.telemetry import metrics as tmetrics
+from sboxgates_tpu.telemetry import status as tstatus
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SBOXES = os.path.join(REPO, "sboxes")
+
+#: Top-level /status keys (schema stability: additions bump this test
+#: AND tstatus.STATUS_SCHEMA consciously, never by accident).
+STATUS_KEYS = {
+    "schema", "time_unix", "uptime_s", "counters", "histograms",
+    "coverage", "attribution",
+}
+
+
+def _get_status(port, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/status", timeout=timeout
+    ) as resp:
+        return json.load(resp)
+
+
+# -------------------------------------------------------------------------
+# in-process server
+# -------------------------------------------------------------------------
+
+
+def test_status_server_snapshot_schema_and_shutdown():
+    reg = tmetrics.context_registry()
+    reg.inc("device_dispatches", 3)
+    reg.inc("lut5_candidates", 1000)
+    reg.observe("dispatch_latency_s[lut5_stream]", 0.01)
+    srv = tstatus.StatusServer(
+        reg, port=0, extra={"engine": lambda: {"fleet": False}},
+        gates_fn=lambda: 24,
+    ).start()
+    try:
+        doc = _get_status(srv.port)
+        assert set(doc) == STATUS_KEYS | {"engine"}
+        assert doc["schema"] == tstatus.STATUS_SCHEMA
+        assert doc["counters"]["device_dispatches"] == 3
+        # histogram quantiles ride the registry snapshot
+        h = doc["histograms"]["dispatch_latency_s[lut5_stream]"]
+        assert {"p50", "p90", "p99"} <= set(h)
+        # coverage: examined vs |C(g, k)| with derived ETA
+        cov = doc["coverage"]["lut5_candidates"]
+        assert cov["examined"] == 1000
+        assert cov["current_space"] == 42504  # C(24, 5)
+        assert cov["eta_current_space_s"] > 0
+        assert doc["engine"] == {"fleet": False}
+        # requests are counted through the declared registry
+        assert reg["status_requests"] == 1
+        assert reg.undeclared() == set()
+        # 404 off-path
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5
+            )
+    finally:
+        srv.shutdown()
+    assert not any(
+        t.name == "sbg-status" for t in threading.enumerate()
+    ), "status server thread survived shutdown"
+    # idempotent
+    srv.shutdown()
+
+
+def test_status_provider_failure_degrades_to_error_note():
+    reg = tmetrics.MetricsRegistry(declared=None)
+
+    def boom():
+        raise RuntimeError("provider died")
+
+    srv = tstatus.StatusServer(reg, port=0, extra={"bad": boom}).start()
+    try:
+        doc = _get_status(srv.port)
+        assert "error" in doc["bad"]
+        assert "counters" in doc  # rest of the snapshot intact
+    finally:
+        srv.shutdown()
+
+
+def test_coverage_derivation_edge_cases():
+    # No gate count -> examined/rate only; g below k -> no space row.
+    cov = tstatus.coverage({"lut5_candidates": 10}, uptime_s=2.0)
+    assert cov["lut5_candidates"]["examined"] == 10
+    assert cov["lut5_candidates"]["rate_per_s"] == 5.0
+    assert "current_space" not in cov["lut5_candidates"]
+    cov = tstatus.coverage({"lut7_candidates": 5}, uptime_s=1.0, g=4)
+    assert "current_space" not in cov["lut7_candidates"]  # g < k
+    cov = tstatus.coverage({}, uptime_s=1.0)
+    assert cov == {}
+
+
+# -------------------------------------------------------------------------
+# subprocess acceptance shapes (status endpoint, SIGTERM)
+# -------------------------------------------------------------------------
+
+
+def _spawn_search(outdir, extra_args=()):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SBG_WARMUP="0")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "sboxgates_tpu",
+            os.path.join(SBOXES, "crypto1_fa.txt"),
+            "--seed", "7", "-o", "0",
+            # Effectively unbounded: the test decides when the run ends
+            # (poll + SIGTERM); each restart iteration returns to Python
+            # so signals are handled promptly.
+            "-i", "1000000",
+            "--output-dir", str(outdir),
+            "--metrics-interval", "300",
+            *extra_args,
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _wait_for_start_line(outdir, proc, timeout=180):
+    """The heartbeat start line (carries the run config)."""
+    path = os.path.join(outdir, "telemetry.jsonl")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"search exited early rc={proc.returncode}: "
+                f"{proc.stderr.read()[-2000:]}"
+            )
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "start":
+                        return rec
+        time.sleep(0.2)
+    raise AssertionError("no heartbeat start line within timeout")
+
+
+def _read_jsonl(path):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+@pytest.fixture(scope="module")
+def sigterm_run(tmp_path_factory):
+    """ONE subprocess search serving both acceptance shapes (suite-time
+    budget: the tier-1 window is tight, and the status poll and the
+    SIGTERM artifacts are observations of the same run): spawn with
+    --status-port 0, poll /status mid-search, SIGTERM, collect."""
+    outdir = tmp_path_factory.mktemp("status") / "run"
+    proc = _spawn_search(outdir, ("--status-port", "0"))
+    doc = None
+    try:
+        start = _wait_for_start_line(outdir, proc)
+        port = start["config"]["status_port"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                doc = _get_status(port, timeout=5)
+                break
+            except OSError:
+                time.sleep(0.2)
+        # Give the search a beat so the final snapshot strictly
+        # dominates the polled one on at least one counter.
+        time.sleep(0.3)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=120)
+    return {
+        "outdir": outdir,
+        "start": start,
+        "status": doc,
+        "returncode": proc.returncode,
+    }
+
+
+def test_status_endpoint_subprocess(sigterm_run):
+    """--status-port 0: the bound port rides the heartbeat start
+    config; /status serves mid-search; polled counters reconcile
+    (monotone) with the final metrics.json written at teardown."""
+    port = sigterm_run["start"]["config"]["status_port"]
+    assert isinstance(port, int) and port > 0
+    doc = sigterm_run["status"]
+    assert doc is not None, "endpoint never answered"
+    assert STATUS_KEYS | {"engine"} <= set(doc)
+    assert doc["schema"] == tstatus.STATUS_SCHEMA
+    assert doc["engine"]["lut_graph"] is False
+    outdir = sigterm_run["outdir"]
+    snap_path = outdir / "metrics.json"
+    assert snap_path.exists(), os.listdir(outdir)
+    final = json.load(open(snap_path))
+    # Counter parity: every counter the live snapshot showed exists in
+    # the final snapshot at an equal-or-later value (counters are
+    # monotone).
+    for name, v in doc["counters"].items():
+        assert final["counters"].get(name, 0) >= v, name
+    assert "attribution" in final
+
+
+def test_sigterm_dumps_flight_and_final_heartbeat(sigterm_run):
+    """The preemption satellite: managed pods deliver SIGTERM before
+    the kill; the grace-window handler must leave a flight dump and a
+    terminal heartbeat record (plus the metrics.json snapshot), then
+    exit with the conventional killed-by-SIGTERM status."""
+    assert sigterm_run["returncode"] == -signal.SIGTERM
+    outdir = sigterm_run["outdir"]
+    dumps = list(outdir.glob("flight-rank00-*.json"))
+    assert dumps, os.listdir(outdir)
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "signal:SIGTERM"
+    lines = _read_jsonl(outdir / "telemetry.jsonl")
+    kinds = [ln["kind"] for ln in lines]
+    assert kinds[0] == "start"
+    assert "incident:signal:SIGTERM" in kinds
+    assert kinds[-1] == "final"  # the forced final line made it out
+    assert (outdir / "metrics.json").exists()
+
+
+# -------------------------------------------------------------------------
+# watcher
+# -------------------------------------------------------------------------
+
+
+def test_watch_renders_dead_run(tmp_path):
+    """The watcher works on runs it didn't start and on dead runs: it
+    reads only the heartbeat JSONL."""
+    from sboxgates_tpu.telemetry.heartbeat import Heartbeat
+
+    reg = tmetrics.context_registry()
+    reg.inc("device_dispatches", 42)
+    reg.observe("dispatch_latency_s[lut5_stream]", 0.02)
+    hb = Heartbeat(reg, str(tmp_path), interval_s=0, rank=0).start()
+    hb.stop(snapshot=False)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "sboxgates_tpu.telemetry.watch",
+            str(tmp_path), "--once",
+        ],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "terminal record" in proc.stdout
+    assert "device_dispatches" in proc.stdout
+    assert "42" in proc.stdout
+    assert "dispatch_latency_s" in proc.stdout  # quantile row rendered
+
+
+def test_watch_tail_follows_appends(tmp_path):
+    from sboxgates_tpu.telemetry import watch as twatch
+
+    path = tmp_path / "telemetry.jsonl"
+    path.write_text(json.dumps({"kind": "start", "seq": 0}) + "\n")
+    tail = twatch.Tail(str(path), poll_s=0.05).start()
+    try:
+        first = tail.records.get(timeout=5)
+        assert first["kind"] == "start"
+        with open(path, "a") as f:
+            f.write(json.dumps({"kind": "beat", "seq": 1}) + "\n")
+        second = tail.records.get(timeout=5)
+        assert second["seq"] == 1
+    finally:
+        tail.stop()
+    assert not any(
+        t.name == "sbg-watch-tail" for t in threading.enumerate()
+    )
+
+
+def test_watch_missing_dir_fails_cleanly(tmp_path):
+    from sboxgates_tpu.telemetry import watch as twatch
+
+    assert twatch.main([str(tmp_path / "nope"), "--once"]) == 1
+
+
+# -------------------------------------------------------------------------
+# perf-drift gate
+# -------------------------------------------------------------------------
+
+
+def test_bench_check_multiround_gate():
+    """The drift gate gating itself: the cheapest bench section re-runs
+    against its committed baseline on every tier-1 pass, so a change
+    that breaks the 1/N dispatch ratio (or the comparator) fails here."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SBG_BENCH_SMOKE="1", SBG_WARMUP="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--check", "multiround"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert doc["regressions"] == 0
+    gated = {(g["metric"], g["field"]) for g in doc["gates"]}
+    assert ("device_rounds_dispatch_ratio", "value") in gated
+
+
+def test_bench_check_unknown_section_errors(capsys):
+    # In-process (bench is already importable in the test process): the
+    # comparator refuses unknown sections with exit code 2.
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    assert bench.bench_check(["nonesuch"]) == 2
+    out = json.loads(capsys.readouterr().out)
+    assert "unknown section" in out["error"]
+    assert "multiround" in out["known"]
