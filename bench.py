@@ -2461,6 +2461,199 @@ def bench_pallas_exec(best) -> dict:
     }
 
 
+def _serve_standalone_digests(tmp_dir, sbox_path, output, seed):
+    """Bit-identity reference for one serve job: the same one-output
+    search on a FRESH context with the same seed (mirrors the chaos
+    matrix in tests/test_serve.py; bench must not import tests/)."""
+    import hashlib
+
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import (
+        Options,
+        SearchContext,
+        generate_graph_one_output,
+        make_targets,
+    )
+    from sboxgates_tpu.utils.sbox import load_sbox
+
+    ctx = SearchContext(Options(seed=seed))
+    sbox, num_inputs = load_sbox(sbox_path, 0)
+    st = State.init_inputs(num_inputs)
+    os.makedirs(tmp_dir, exist_ok=True)
+    generate_graph_one_output(
+        ctx, st, make_targets(sbox), output, save_dir=tmp_dir,
+        log=lambda s: None, journal=None,
+    )
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(tmp_dir, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(tmp_dir)) if f.endswith(".xml")
+    }
+
+
+def _serve_job_set(n_jobs):
+    des = os.path.join(HERE, "sboxes", "des_s1.txt")
+    fa = os.path.join(HERE, "sboxes", "crypto1_fa.txt")
+    jobs = []
+    for i in range(n_jobs):
+        path, output = (des, i % 4) if i % 3 else (fa, 0)
+        jobs.append((f"j{i:02d}", path, output, f"tenant{i % 3}"))
+    return jobs
+
+
+def _run_serve_arm(root, jobs, lanes, seed=9, retries=2):
+    """One serve-orchestrator run over the job set; returns (wall_s,
+    final status view, base-context registry)."""
+    from sboxgates_tpu.resilience.deadline import DeadlineConfig
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.serve import ServeJob, ServeOrchestrator
+
+    ctx = SearchContext(Options(seed=seed))
+    orch = ServeOrchestrator(
+        ctx, root, lanes=lanes,
+        deadline=DeadlineConfig(retries=retries, backoff_s=0.05),
+        log=lambda s: None,
+    )
+    for job_id, path, output, tenant in jobs:
+        orch.submit(ServeJob(
+            job_id=job_id, sbox_path=path, output=output, tenant=tenant,
+        ))
+    t0 = time.perf_counter()
+    orch.start()
+    view = orch.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+    wall = time.perf_counter() - t0
+    orch.stop()
+    return wall, view, ctx.stats, orch
+
+
+def bench_serve(n_jobs: int = None) -> list:
+    """``bench.py --serve``: the serve-mode load generator
+    (BENCH_SERVE.json).
+
+    Three arms over one synthetic multi-tenant job mix (DES S1 outputs
+    + the Crypto-1 fa filter, three tenants):
+
+    1. ``serve_serial_t1`` — the same job set on ONE lane, measured in
+       the same window: the t1 baseline (the serial loop an operator
+       would run without the orchestrator).
+    2. ``serve_load`` — the multi-lane queue: jobs/hour, p99
+       time-to-first-hit and queue-wait quantiles read STRAIGHT from
+       the telemetry registry snapshot (no bespoke accounting), plus
+       the serve counters.  CPU caveat (same as the fleet ladder): the
+       lanes are host threads contending for the GIL, so multi-lane
+       jobs/hour can trail t1 on CPU CI — the structural gates
+       (everything completes, nothing quarantined) are the
+       hardware-independent half; the lane win needs network-attached
+       silicon where jobs are dispatch-latency-bound.
+    3. ``serve_chaos`` — an 8-job run under a deterministic
+       preempt/kill/requeue fault schedule plus one poison tenant:
+       gates that every surviving job's final circuits are
+       bit-identical to standalone runs and the poison job is
+       quarantined without collateral damage.
+    """
+    import shutil
+    import tempfile
+
+    from sboxgates_tpu.resilience import faults
+    from sboxgates_tpu.search.serve import DONE, QUARANTINED
+
+    n_jobs = n_jobs or (8 if SMOKE else 16)
+    lanes = 4
+    work = tempfile.mkdtemp(prefix="sbg-serve-bench-")
+    out = []
+    try:
+        jobs = _serve_job_set(n_jobs)
+        # Arm 1: t1 = one lane, same window.
+        t1_wall, t1_view, _, _ = _run_serve_arm(
+            os.path.join(work, "t1"), jobs, lanes=1
+        )
+        t1_done = t1_view["counts"][DONE]
+        out.append({
+            "metric": "serve_serial_t1", "jobs": n_jobs, "lanes": 1,
+            "completed": t1_done, "wall_s": round(t1_wall, 3),
+            "value": round(3600.0 * t1_done / t1_wall, 1),
+            "unit": "jobs/hour (1 lane, t1 baseline)",
+        })
+        # Arm 2: the multi-lane load run.
+        wall, view, stats, _ = _run_serve_arm(
+            os.path.join(work, "load"), jobs, lanes=lanes
+        )
+        done = view["counts"][DONE]
+        hists = stats.histograms()
+        ttfh = hists.get("job_time_to_first_hit_s", {})
+        qwait = hists.get("serve_queue_wait_s", {})
+        out.append({
+            "metric": "serve_load", "jobs": n_jobs, "lanes": lanes,
+            "completed": done, "all_completed": done == n_jobs,
+            "quarantined": view["counts"][QUARANTINED],
+            "wall_s": round(wall, 3),
+            "value": round(3600.0 * done / wall, 1),
+            "unit": "jobs/hour",
+            "vs_t1": round(t1_wall / wall, 3),
+            "p50_ttfh_s": ttfh.get("p50"),
+            "p99_ttfh_s": ttfh.get("p99"),
+            "p50_queue_wait_s": qwait.get("p50"),
+            "p99_queue_wait_s": qwait.get("p99"),
+            "serve_jobs_admitted": stats.get("serve_jobs_admitted", 0),
+            "serve_preemptions": stats.get("serve_preemptions", 0),
+        })
+        # Arm 3: chaos + poison isolation, bit-identity gated.
+        cjobs = _serve_job_set(8)
+        faults.disarm()
+        # One-output jobs have ONE progress record per attempt, so the
+        # preempt schedules fire on the first boundary (a requeued
+        # attempt re-reaches it, exercising resume-under-preemption).
+        for victim, when in (("j01", "1"), ("j03", "1")):
+            faults.arm(f"serve.preempt@job:{victim}", "raise", when)
+        faults.arm("search.node@job:j05", "raise", "2")
+        faults.arm("search.node@job:poison", "raise", "1+")
+        try:
+            croot = os.path.join(work, "chaos")
+            cwall, cview, cstats, orch = _run_serve_arm(
+                croot,
+                cjobs + [("poison", _serve_job_set(1)[0][1], 0, "evil")],
+                lanes=3, retries=2,
+            )
+        finally:
+            faults.disarm()
+        healthy_done = all(
+            cview["jobs"][j[0]]["state"] == DONE for j in cjobs
+        )
+        quarantined = cview["jobs"]["poison"]["state"] == QUARANTINED
+        identical = True
+        if healthy_done:
+            import hashlib as _hl
+
+            for job_id, path, output, _tenant in cjobs:
+                seed = int(orch._jobs[job_id].seed)
+                ref = _serve_standalone_digests(
+                    os.path.join(work, f"ref-{job_id}"), path, output,
+                    seed,
+                )
+                got = {
+                    f: _hl.sha256(open(
+                        os.path.join(croot, job_id, f), "rb"
+                    ).read()).hexdigest()
+                    for f in sorted(os.listdir(
+                        os.path.join(croot, job_id)
+                    )) if f.endswith(".xml")
+                }
+                identical = identical and got == ref
+        out.append({
+            "metric": "serve_chaos", "jobs": len(cjobs) + 1,
+            "lanes": 3, "wall_s": round(cwall, 3),
+            "value": int(cstats.get("serve_preemptions", 0)),
+            "unit": "preemptions (chaos schedule)",
+            "bit_identical": bool(healthy_done and identical),
+            "quarantine_isolated": bool(quarantined and healthy_done),
+            "serve_quarantined": cstats.get("serve_quarantined", 0),
+        })
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def bench_roofline() -> list:
     """Measured roofline placement for EVERY kernel in the ``KERNELS``
     registry (BENCH_ROOFLINE.json) — the maintained successor to
@@ -2688,6 +2881,19 @@ BENCH_CHECKS = {
              0.0, "exact"),
         ],
     ),
+    "serve": (
+        # Small fixed job set: the gated fields are structural (did
+        # everything complete; did chaos recovery stay bit-identical;
+        # did the poison job quarantine cleanly) — machine-independent
+        # by construction, like the multiround dispatch ratios.
+        lambda: bench_serve(8),
+        "BENCH_SERVE.json",
+        [
+            ("serve_load", "all_completed", 0.0, "exact"),
+            ("serve_chaos", "bit_identical", 0.0, "exact"),
+            ("serve_chaos", "quarantine_isolated", 0.0, "exact"),
+        ],
+    ),
     "hoststream": (
         bench_host_stream_pipeline,
         "BENCH_PIPELINE.json",
@@ -2837,6 +3043,21 @@ def main() -> None:
         with open(os.path.join(HERE, "BENCH_FLEET.json"), "w") as f:
             json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[-1]))
+        return
+    if "--serve" in sys.argv:
+        # Standalone mode: the serve-mode load generator (multi-tenant
+        # queue jobs/hour + p99 time-to-first-hit from the registry
+        # snapshot, t1 = same jobs on one lane, chaos arm bit-identity
+        # gated), written to BENCH_SERVE.json.  CPU-safe.
+        if SMOKE or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_serve()
+        with open(os.path.join(HERE, "BENCH_SERVE.json"), "w") as f:
+            json.dump(with_meta(detail), f, indent=1)
+        print(json.dumps(detail[1]))
         return
     if "--device-rounds" in sys.argv:
         # Standalone mode: fused multi-round driver vs the per-round

@@ -104,6 +104,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "with --fleet, each process runs its slice as a "
                         "LOCAL fleet over its own devices (automatic "
                         "multi-host fleet composition)")
+    p.add_argument("--serve", action="store_true",
+                   help="long-running multi-tenant serve mode: each "
+                        "input S-box file becomes one job in a "
+                        "fault-tolerant queue over one shared warm "
+                        "context (search/serve.py) — bin-packed "
+                        "admission onto fleet-lane buckets, priority "
+                        "preemption via journal snapshot + requeue "
+                        "(bit-exact resume), per-job retry/timeout/"
+                        "backoff with quarantine for poison jobs, and "
+                        "graceful SIGTERM drain; requires an explicit "
+                        "--output-dir (per-job journals/artifacts live "
+                        "under DIR/<job-id>/)")
+    p.add_argument("--serve-lanes", type=int, default=4, metavar="N",
+                   help="concurrent serve-mode job lanes (default 4, "
+                        "used exactly; the status view also reports "
+                        "the fleet jobs-bucket the lane count maps "
+                        "onto — the warm-kernel shape group)")
+    p.add_argument("--serve-retries", type=int, default=2, metavar="N",
+                   help="failed attempts a serve job may retry (with "
+                        "exponential backoff) before it is "
+                        "quarantined (default 2)")
+    p.add_argument("--serve-timeout", type=float, default=None,
+                   metavar="S",
+                   help="per-attempt wall budget for one serve job in "
+                        "seconds (default: unbounded); a breach is "
+                        "raised at the job's next journal boundary "
+                        "and consumes one retry")
     p.add_argument("--pipeline-depth", type=int, default=2, metavar="N",
                    help="in-flight dispatches / prefetched chunks for the "
                         "streaming sweep drivers (default 2; 1 = serial "
@@ -219,6 +246,14 @@ JOURNAL_CONFIG_KEYS = (
     "fleet_max_wave",
     "shard_sweep",
     "pipeline_depth",
+    # Serve mode: recorded so a journal unambiguously identifies a
+    # serve-mode run (its resume path is per-job, via re-running
+    # --serve — an explicit --resume-run against it is rejected) and so
+    # the orchestrator policy survives in the run record.
+    "serve",
+    "serve_lanes",
+    "serve_retries",
+    "serve_timeout",
 )
 
 #: Keys added to JOURNAL_CONFIG_KEYS after a journal version shipped:
@@ -229,6 +264,10 @@ JOURNAL_CONFIG_KEYS = (
 JOURNAL_KEY_DEFAULTS = {
     "fleet_candidates": 1,
     "fleet_max_wave": 256,
+    "serve": False,
+    "serve_lanes": 4,
+    "serve_retries": 2,
+    "serve_timeout": None,
 }
 
 
@@ -253,6 +292,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             SearchJournal,
         )
 
+        if args.serve:
+            return _err(
+                "Error: --serve cannot be combined with --resume-run; a "
+                "killed serve run resumes by re-running --serve with "
+                "the same inputs and --output-dir (each job continues "
+                "from its per-job journal)."
+            )
         # The journaled configuration decides whether this is a sharded
         # resume; an explicit --shard-sweep only cross-checks it (below).
         shard_requested = args.shard_sweep
@@ -289,6 +335,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "non-sharded run, but --shard-sweep was given; resume "
                 "without it (the journaled configuration decides the "
                 "execution mode)."
+            )
+        if args.serve:
+            # Restored from the journal: the run being resumed WAS a
+            # serve run — its resume path is per-job.
+            return _err(
+                f"Error: journal in {args.resume_run} records a "
+                "serve-mode run; re-run --serve with the same inputs "
+                "and --output-dir instead of --resume-run (each job "
+                "resumes bit-identically from its per-job journal)."
             )
         if journal.complete:
             print(
@@ -335,6 +390,39 @@ def main(argv: Optional[List[str]] = None) -> int:
             "--fleet and --serial-jobs are incompatible: the fleet's "
             "whole point is merging the jobs' dispatches."
         )
+    if args.serve:
+        # Serve mode owns scheduling and execution shape; every other
+        # mode flag either conflicts with that ownership or picks a
+        # driver the orchestrator replaces.
+        for flag, name in (
+            (args.convert_c, "-c"),
+            (args.convert_dot, "-d"),
+            (args.graph is not None, "-g"),
+            (args.permute_sweep, "--permute-sweep"),
+            (args.shard_sweep, "--shard-sweep"),
+            (args.mesh, "--mesh"),
+            (args.fleet, "--fleet"),
+            (args.batch_iterations, "--batch-iterations"),
+            (args.serial_jobs, "--serial-jobs"),
+        ):
+            if flag:
+                return _err(
+                    f"--serve cannot be combined with {name}; the serve "
+                    "orchestrator owns job scheduling over the shared "
+                    "warm context."
+                )
+        if args.output_dir is None:
+            return _err(
+                "--serve requires an explicit --output-dir: per-job "
+                "journals, checkpoints, and telemetry artifacts live "
+                "under DIR/<job-id>/."
+            )
+        if args.serve_lanes < 1:
+            return _err(f"Bad serve lanes value: {args.serve_lanes}")
+        if args.serve_retries < 0:
+            return _err(f"Bad serve retries value: {args.serve_retries}")
+        if args.serve_timeout is not None and args.serve_timeout <= 0:
+            return _err(f"Bad serve timeout value: {args.serve_timeout}")
     if args.fleet_candidates < 1:
         return _err(
             f"Bad fleet candidates value: {args.fleet_candidates}"
@@ -390,6 +478,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         or args.num_processes is not None
         or "JAX_COORDINATOR_ADDRESS" in os.environ
     )
+    if args.serve and multiprocess:
+        return _err(
+            "--serve is a single-process orchestrator over the local "
+            "warm device pool; drop the multi-host flags (shard tenants "
+            "across serve processes instead)."
+        )
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         jax.config.update("jax_platforms", plat)
@@ -848,6 +942,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     import signal
 
     prev_handlers = {}
+    #: Pre-teardown signal hooks (the serve orchestrator's drain rides
+    #: here); run on the signal-dump worker, bounded by its join.
+    drain_hooks: List = []
     #: Bounded grace for the signal-dump worker; managed-pod
     #: SIGTERM->SIGKILL windows are typically 15-30 s.
     signal_dump_join_s = 15.0
@@ -856,6 +953,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         name = signal.Signals(signum).name
 
         def work() -> None:
+            # Serve mode registers its orchestrator here: the drain
+            # stops admission and preempts every running job at its
+            # next journal boundary (per-job snapshot + artifacts)
+            # BEFORE the run-level dump/teardown below.
+            for hook in list(drain_hooks):
+                try:
+                    hook()
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "signal drain hook failed: %r", e
+                    )
             path = _flight.flight_dump(
                 f"signal:{name}", registry=ctx.stats,
                 extra={"signal": name},
@@ -898,6 +1008,45 @@ def main(argv: Optional[List[str]] = None) -> int:
     # before the traceback kills the process — the crash itself becomes
     # an artifact, like the deadline/breaker/fault triggers.
     try:
+        if args.serve:
+            # Multi-tenant serve mode: every input file is one job in
+            # the fault-tolerant queue (search/serve.py); the run-level
+            # journal above records the serve configuration, each job
+            # keeps its own journal/artifacts under DIR/<job-id>/.
+            from .resilience.deadline import DeadlineConfig
+            from .search.serve import ServeJob, ServeOrchestrator
+
+            orch = ServeOrchestrator(
+                ctx, args.output_dir, lanes=args.serve_lanes,
+                deadline=DeadlineConfig(
+                    budget_s=args.serve_timeout or 0.0,
+                    retries=args.serve_retries,
+                ),
+                log=log,
+            )
+            if status_server is not None:
+                status_server.add_provider("serve", orch.status_view)
+            if heartbeat is not None:
+                heartbeat.add_provider("serve", orch.status_view)
+            drain_hooks.append(lambda: orch.drain(timeout_s=10.0))
+            for i, path in enumerate(args.input):
+                stem = os.path.splitext(os.path.basename(path))[0]
+                orch.submit(ServeJob(
+                    job_id=f"job{i:02d}-{stem}", sbox_path=path,
+                    output=args.single_output, permute=args.permute,
+                ))
+            orch.start()
+            view = orch.run_until_idle()
+            orch.stop()
+            counts = view["counts"]
+            log("serve: " + "  ".join(
+                f"{k}={counts.get(k, 0)}"
+                for k in ("done", "quarantined", "preempted")
+            ))
+            if journal is not None and journal.writable:
+                journal.append("run_done", beam=[], serve=counts)
+            return _finish()
+
         if multibox or args.permute_sweep:
             # BASELINE configs 4-5: the sweep is the batch axis (multibox.py).
             from .search.multibox import (

@@ -19,6 +19,10 @@ the ones production code fires today):
 ``native.devcb``          servicing one native-engine device-work callback
 ``warmup.compile``        one background AOT kernel compile (KernelWarmer)
 ``dist.verdict``          entering one replicated breach-verdict barrier
+``serve.admit``           admitting one job into the serve-mode queue
+``serve.preempt``         a serve job's journal-boundary control point
+``serve.requeue``         requeuing a preempted/failed serve job
+``serve.drain``           entering a serve-mode graceful drain
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -43,6 +47,16 @@ or kills exactly one rank of a pod to exercise the replicated abort
 protocol deterministically — every process can share one ``SBG_FAULTS``
 value.  Hit counting for a rank-targeted site happens only on the
 matching rank.
+
+Job targeting — a site name may carry an ``@job:ID`` suffix
+(``serve.preempt@job:j03:raise@2``): the fault then fires only on a
+thread currently running serve-mode job ``ID`` (:func:`set_job`, called
+by the serve orchestrator's worker threads around each job attempt;
+overridable via ``SBG_FAULT_JOB`` for single-job tests).  This is how
+the serve-mode chaos matrix preempts, kills, or poisons exactly one
+tenant's job on a deterministic schedule while its neighbors run
+undisturbed — the job-queue analog of ``@rank:N``.  Hit counting for a
+job-targeted site happens only on threads running the matching job.
 """
 
 from __future__ import annotations
@@ -70,6 +84,10 @@ KNOWN_SITES = (
     "native.devcb",
     "warmup.compile",
     "dist.verdict",
+    "serve.admit",
+    "serve.preempt",
+    "serve.requeue",
+    "serve.drain",
 )
 
 
@@ -89,23 +107,31 @@ class _Spec:
 
 _WHEN_RE = re.compile(r"^(\d+)(\+?)$")
 _RANK_RE = re.compile(r"@rank:(\d+)$")
+_JOB_RE = re.compile(r"@job:([A-Za-z0-9_.\-]+)$")
 
 _lock = threading.Lock()
 _specs: Dict[str, _Spec] = {}
 _hits: Dict[str, int] = {}
 _env_loaded = False
 _rank: Optional[int] = None
-#: True when any armed site is rank-targeted — recomputed under _lock by
-#: every _specs mutation, so fault_point's fast path reads ONE bool
-#: instead of iterating _specs (which background threads would race
-#: against a concurrent arm()/disarm() resize).
+#: Thread-local current serve-job id (set_job) for @job:ID matching —
+#: per-THREAD, not per-process: the serve orchestrator runs many
+#: tenants' jobs concurrently in one process, and a job-targeted fault
+#: must fire only on the thread actually running that job.
+_job_local = threading.local()
+#: True when any armed site is rank-/job-targeted — recomputed under
+#: _lock by every _specs mutation, so fault_point's fast path reads ONE
+#: bool per kind instead of iterating _specs (which background threads
+#: would race against a concurrent arm()/disarm() resize).
 _rank_targeted = False
+_job_targeted = False
 
 
 def _note_specs_changed() -> None:
-    """Caller holds _lock: refresh the rank-targeting flag."""
-    global _rank_targeted
+    """Caller holds _lock: refresh the rank-/job-targeting flags."""
+    global _rank_targeted, _job_targeted
     _rank_targeted = any("@rank:" in s for s in _specs)
+    _job_targeted = any("@job:" in s for s in _specs)
 
 
 def set_rank(rank: Optional[int]) -> None:
@@ -114,6 +140,24 @@ def set_rank(rank: Optional[int]) -> None:
     restores the environment-variable fallback (tests)."""
     global _rank
     _rank = None if rank is None else int(rank)
+
+
+def set_job(job_id: Optional[str]) -> None:
+    """Pins the CALLING THREAD's serve-job id for ``@job:ID``-targeted
+    sites (called by the serve orchestrator's worker threads around each
+    job attempt); ``None`` clears it.  Thread-local by design — see
+    :data:`_job_local`."""
+    _job_local.job = None if job_id is None else str(job_id)
+
+
+def _current_job() -> Optional[str]:
+    """Job id used for ``@job:ID`` matching: the thread's :func:`set_job`
+    value, else the ``SBG_FAULT_JOB`` environment fallback (single-job
+    subprocess tests), else None (no job-qualified lookup)."""
+    job = getattr(_job_local, "job", None)
+    if job is not None:
+        return job
+    return os.environ.get("SBG_FAULT_JOB")
 
 
 def _process_rank() -> int:
@@ -144,13 +188,15 @@ def parse_spec(text: str) -> Dict[str, _Spec]:
         if len(fields) != 2 or not fields[0]:
             raise ValueError(
                 f"bad fault spec {part!r}: expected "
-                "'site[@rank:N]:action[@when]'"
+                "'site[@rank:N|@job:ID]:action[@when]'"
             )
         site, action = fields
-        if ":" in site and not _RANK_RE.search(site):
+        if ":" in site and not (
+            _RANK_RE.search(site) or _JOB_RE.search(site)
+        ):
             raise ValueError(
                 f"bad fault site {site!r} in {part!r}: a ':' in a site "
-                "name is only valid as an '@rank:N' suffix"
+                "name is only valid as an '@rank:N' or '@job:ID' suffix"
             )
         when = "1+"
         if "@" in action:
@@ -219,15 +265,20 @@ def fault_point(site: str) -> None:
     if not _env_loaded and not _specs:
         with _lock:
             _load_env()
-    # Both the plain name and this process's rank-qualified variant are
-    # live when armed — arming "X" pod-wide AND "X@rank:N" for one rank
-    # honors both schedules (each keeps its own hit counter; the plain
-    # spec fires first on a tie).  The rank-qualified lookup happens
-    # only when some armed site is rank-targeted, so the common unarmed
-    # path stays at most two dict gets.
+    # The plain name and this process's rank-qualified / this thread's
+    # job-qualified variants are all live when armed — arming "X"
+    # pod-wide AND "X@rank:N" for one rank (or "X@job:ID" for one serve
+    # job) honors every schedule (each keeps its own hit counter; the
+    # plain spec fires first on a tie).  The qualified lookups happen
+    # only when some armed site carries that kind of target, so the
+    # common unarmed path stays a few dict gets.
     names = [site]
     if _rank_targeted:
         names.append(f"{site}@rank:{_process_rank()}")
+    if _job_targeted:
+        job = _current_job()
+        if job is not None:
+            names.append(f"{site}@job:{job}")
     if all(_specs.get(n) is None for n in names):
         return
     spec = None

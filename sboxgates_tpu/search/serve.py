@@ -1,0 +1,764 @@
+"""Fault-tolerant multi-tenant ``serve`` mode: the long-running search
+orchestrator (``--serve``).
+
+A :class:`ServeOrchestrator` admits many concurrent searches — one
+:class:`ServeJob` per tenant query — over ONE shared warm
+:class:`SearchContext`: every job view inherits the base context's
+derived tables, device-table caches, warmed kernel registry, and
+persistent compile cache (the "warm device pool"), so tenant N+1's
+sweeps dispatch against executables tenant N already built.  Admission
+is bin-packing onto fleet-lane jobs buckets (:func:`lane_bucket`, the
+``FLEET_BUCKETS``/``STACKED_BUCKETS`` ladder) and the scheduler groups
+runnable jobs by their gate-count bucket ACROSS tenants — same bucket =
+same kernel shapes = warm dispatches — with fair-share tenant rotation
+inside a bucket group so no tenant starves a lane-sized wave.
+
+Robustness is the spine:
+
+* **Isolation.**  Each job runs on a :class:`JobView` — its own PRNG
+  stream (seeded per job, so a job is reproducible standalone), its own
+  output directory (``root/<job_id>/`` holding checkpoints, the per-job
+  journal, per-job ``telemetry.jsonl``/``metrics.json``), and a forked
+  metrics registry merged into the base atomically at attempt end.
+* **Preemption = journal snapshot + requeue.**  Jobs journal through
+  the ordinary :class:`~sboxgates_tpu.resilience.journal.SearchJournal`
+  machinery (every progress record is already fsync'd — the snapshot is
+  free); a preemption lands exactly on a journal progress boundary (the
+  driver's atomic resume unit), so the requeued attempt resumes
+  bit-identically and the preempted job's FINAL circuit equals its
+  undisturbed run — the PR 3/7 exact-resume contract, applied live.
+* **Retry / timeout / backoff.**  Per-job policy rides the
+  ``resilience.deadline`` schedule shape (:class:`DeadlineConfig`:
+  per-attempt wall budget, retry count, exponential backoff); a breach
+  raises the same :class:`DispatchTimeout` the dispatch guards use.
+* **Quarantine.**  A job that exhausts its retry schedule is
+  quarantined — terminal, flight-dumped into its own directory, counted
+  in ``serve_quarantined`` — WITHOUT touching the shared context or the
+  pod-wide circuit breaker: a poison tenant never degrades its
+  neighbors.
+* **Graceful drain.**  ``drain()`` (wired to SIGTERM by the CLI) stops
+  admission, preempts every running job at its next journal boundary,
+  and leaves per-job artifacts: final heartbeat line, ``metrics.json``,
+  and a flight dump in each preempted job's directory.
+
+Chaos sites (``resilience.faults``, ``@job:ID``-targetable):
+``serve.admit`` on submission, ``serve.preempt`` at every job journal
+progress boundary (an armed ``raise`` there IS a chaos preemption),
+``serve.requeue`` on the requeue transition (an armed ``raise`` there
+consumes one retry — a lost requeue is a job failure, never a lost
+job), and ``serve.drain`` entering the drain.  The chaos matrix in
+tests/test_serve.py drives randomized preempt/kill/requeue schedules
+through these sites and asserts bit-identical final circuits.
+
+Threads: one scheduler (:meth:`ServeOrchestrator._work`) plus one
+worker per running job (:meth:`ServeOrchestrator._run_job`), both
+pinned in ``[tool.jaxlint] thread_roots``.  All shared orchestrator
+state is guarded by ONE condition variable (``_cv``), never held across
+a journal write, a driver call, or a blocking resolve — the R9
+lock-order gate verifies this statically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..graph.state import State
+from ..resilience import faults
+from ..resilience.deadline import DeadlineConfig, DispatchTimeout
+from ..resilience.journal import SearchJournal
+from ..telemetry import flight as _tflight
+from ..telemetry import trace as _ttrace
+from ..telemetry.heartbeat import Heartbeat
+from ..utils.sbox import SboxError, load_sbox
+from .context import SearchContext, bucket_size
+from .orchestrator import (
+    generate_graph,
+    generate_graph_one_output,
+    make_targets,
+)
+
+logger = logging.getLogger(__name__)
+
+# Job lifecycle states (the /status queue view vocabulary).
+QUEUED = "queued"
+RUNNING = "running"
+PREEMPTED = "preempted"    # transient: snapshot taken, requeue pending
+QUARANTINED = "quarantined"
+DONE = "done"
+
+#: Terminal states — run_until_idle() returns when every job is here.
+TERMINAL = (DONE, QUARANTINED)
+
+#: Journal record types that are driver progress units — the points a
+#: preemption/timeout may land on (resume is bit-exact exactly there).
+#: run_start/run_done are boundaries, not interruptible progress.
+PROGRESS_RECORDS = ("iter_done", "round_done", "mb_round_done",
+                    "job_done", "jobs_done", "chain_round")
+
+#: /serve status-view schema version.
+SERVE_SCHEMA = 1
+
+
+class JobPreempted(Exception):
+    """Raised at a job's journal boundary to snapshot + requeue it."""
+
+
+class ServeClosed(RuntimeError):
+    """submit() after drain(): admission is closed."""
+
+
+def lane_bucket(n: int) -> int:
+    """Rounds a requested lane count up to the fleet jobs-bucket ladder
+    (``FLEET_BUCKETS`` + ``STACKED_BUCKETS``): the orchestrator's wave
+    of concurrent jobs is shaped like a fleet jobs axis, so warm fleet
+    kernels keyed on ``(jobs_bucket, bucket)`` stay reusable when the
+    serving loop later merges same-bucket sweeps into fleet
+    dispatches."""
+    from .fleet import FLEET_LADDER
+
+    for b in FLEET_LADDER:
+        if n <= b:
+            return b
+    return FLEET_LADDER[-1]
+
+
+def job_seed(run_seed: int, job_id: str) -> int:
+    """Deterministic per-job PRNG seed: a job re-run standalone with
+    this seed reproduces its serve-mode circuit bit-for-bit (the chaos
+    matrix's comparison basis).  Stable across processes — a restarted
+    serve run derives the same seeds."""
+    h = hashlib.blake2b(
+        f"{run_seed}:{job_id}".encode(), digest_size=4
+    ).digest()
+    return int.from_bytes(h, "little")
+
+
+@dataclass
+class ServeJob:
+    """One tenant query: an S-box search job in the serve queue."""
+
+    job_id: str
+    sbox_path: str
+    #: Output bit to search (``-1`` = all outputs, the full-graph beam).
+    output: int = -1
+    tenant: str = "default"
+    #: Higher runs first; a strictly-higher queued priority may preempt
+    #: the lowest-priority running job when no lane is free.
+    priority: int = 0
+    #: Per-job PRNG seed; None = derived via :func:`job_seed`.
+    seed: Optional[int] = None
+    permute: int = 0
+
+    # -- runtime state (orchestrator-owned, mutated under _cv) -------------
+    state: str = QUEUED
+    #: Failed attempts so far (quarantine trips past the retry budget).
+    failures: int = 0
+    preemptions: int = 0
+    #: Submission order (FIFO tiebreak) — set by submit().
+    seq: int = 0
+    #: Warm-affinity group: the gate-count bucket the job last swept at
+    #: (its num_inputs bucket until the first preemption updates it).
+    bucket: int = 0
+    submitted_t: float = 0.0
+    enqueued_t: float = 0.0     # last (re)queue time, for queue-wait
+    not_before: float = 0.0     # backoff gate for requeued failures
+    started_t: Optional[float] = None
+    first_hit_t: Optional[float] = None
+    finished_t: Optional[float] = None
+    result_count: Optional[int] = None
+    error: Optional[str] = None
+    #: Latest attempt's forked registry (live per-job counters for the
+    #: /status queue view; merged into the base at attempt end).
+    registry: object = None
+    _preempt: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def job_dir_name(self) -> str:
+        return self.job_id
+
+
+class JobView(SearchContext):
+    """Per-job view of the shared serve context: the
+    :class:`~sboxgates_tpu.search.batched.RestartContext` shape (shared
+    derived tables / warm caches / compile cache, own PRNG stream and
+    forked registry) without the rendezvous coupling — serve tenants
+    are independent, so a job dispatches exactly like a standalone
+    single-job run with the same seed (``rdv`` mirrors what a fresh
+    context would build: ``None`` on CPU, a 1-thread rendezvous on
+    accelerator backends), which is what makes the chaos matrix's
+    serve-vs-standalone bit-identity comparison meaningful."""
+
+    def __init__(self, base: SearchContext, seed: int):
+        self.__dict__.update(base.__dict__)
+        self.rng = np.random.default_rng(seed)
+        self._seed_buf = (np.empty(0, dtype=np.int64), 0)
+        self.stats = base.stats.fork()
+        if base.rdv is not None:
+            from .batched import Rendezvous
+
+            self.rdv = Rendezvous(1)
+
+
+class _JobJournal(SearchJournal):
+    """Per-job journal whose appends double as the job's cooperative
+    control points: after each durable progress record the orchestrator
+    hook runs (chaos ``serve.preempt`` site, the scheduler's preempt
+    flag, the per-attempt deadline, first-hit detection).  A preemption
+    therefore lands exactly on the journal's atomic progress unit —
+    what makes snapshot + requeue resume bit-exact."""
+
+    _serve_ctl: Optional[Callable[[str, dict], None]] = None
+
+    def append(self, rtype: str, **payload):
+        rec = super().append(rtype, **payload)
+        ctl = self._serve_ctl
+        if ctl is not None and self.writable and rtype in PROGRESS_RECORDS:
+            ctl(rtype, rec)
+        return rec
+
+
+class ServeOrchestrator:
+    """The serve-mode job queue + scheduler; see the module docstring.
+
+    ``deadline`` shapes the per-job retry schedule exactly like the
+    dispatch guards': ``budget_s`` is one attempt's wall budget (0 =
+    unbounded), ``retries`` the requeue budget before quarantine, and
+    ``backoff_s`` the base of the deterministic exponential requeue
+    backoff."""
+
+    def __init__(
+        self,
+        ctx: SearchContext,
+        root: str,
+        lanes: int = 4,
+        deadline: Optional[DeadlineConfig] = None,
+        log: Callable[[str], None] = print,
+    ):
+        self.ctx = ctx
+        self.root = root
+        self.lanes = max(1, int(lanes))
+        self.lane_bucket = lane_bucket(self.lanes)
+        self.deadline = deadline if deadline is not None else DeadlineConfig(
+            budget_s=0.0, retries=2, backoff_s=0.25
+        )
+        self.log = log
+        self._cv = threading.Condition()
+        self._jobs: Dict[str, ServeJob] = {}
+        self._seq = 0
+        self._draining = False
+        self._stop = False
+        self._scheduler: Optional[threading.Thread] = None
+        self._workers: Dict[str, threading.Thread] = {}
+        os.makedirs(root, exist_ok=True)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, job: ServeJob) -> ServeJob:
+        """Admits one job; raises :class:`ServeClosed` after drain().
+        The ``serve.admit`` fault site fires BEFORE any state mutation,
+        so an injected admission failure is loud and loses nothing."""
+        faults.fault_point("serve.admit")
+        if job.seed is None:
+            job.seed = job_seed(self.ctx.opt.seed or 0, job.job_id)
+        if not job.bucket:
+            # Warm-affinity seed value: a fresh job sweeps at its input
+            # count; preemption updates this to the live gate bucket.
+            # An unreadable table only costs grouping quality here — the
+            # worker's own load_sbox surfaces the real error through the
+            # retry/quarantine path.
+            try:
+                _, num_inputs = load_sbox(job.sbox_path, job.permute)
+                job.bucket = bucket_size(num_inputs)
+            except (OSError, SboxError) as e:
+                logger.warning(
+                    "serve admit: cannot size job %s from %s (%r); "
+                    "defaulting its bucket", job.job_id, job.sbox_path, e,
+                )
+                job.bucket = bucket_size(8)
+        now = time.perf_counter()
+        with self._cv:
+            if self._draining:
+                raise ServeClosed(
+                    f"serve queue is draining; job {job.job_id!r} rejected"
+                )
+            if job.job_id in self._jobs:
+                raise ValueError(f"duplicate job id {job.job_id!r}")
+            self._seq += 1
+            job.seq = self._seq
+            job.state = QUEUED
+            job.submitted_t = now
+            job.enqueued_t = now
+            self._jobs[job.job_id] = job
+            self.ctx.stats.inc("serve_jobs_admitted")
+            self._cv.notify_all()
+        return job
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServeOrchestrator":
+        if self._scheduler is None:
+            self._scheduler = threading.Thread(
+                target=self._work, name="sbg-serve-sched", daemon=True
+            )
+            self._scheduler.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Stops the scheduler thread without touching job state —
+        the quiet shutdown for a caller whose jobs are already terminal
+        (the CLI after run_until_idle).  Use :meth:`drain` to preempt
+        in-flight work.  Idempotent."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        t = self._scheduler
+        if t is not None:
+            t.join(timeout_s)
+            self._scheduler = None
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful shutdown: admission closes, every running job is
+        preempted at its next journal boundary (snapshot + per-job
+        artifacts), and the scheduler stops.  Idempotent; returns the
+        final :meth:`status_view`."""
+        faults.fault_point("serve.drain")
+        with self._cv:
+            self._draining = True
+            running = [j for j in self._jobs.values() if j.state == RUNNING]
+            self._cv.notify_all()
+        for j in running:
+            j._preempt.set()
+        deadline = time.perf_counter() + timeout_s
+        with self._cv:
+            while any(
+                j.state == RUNNING for j in self._jobs.values()
+            ) and time.perf_counter() < deadline:
+                self._cv.wait(0.1)
+            self._stop = True
+            self._cv.notify_all()
+        t = self._scheduler
+        if t is not None:
+            t.join(timeout_s)
+            self._scheduler = None
+        for t in list(self._workers.values()):
+            t.join(max(0.0, deadline - time.perf_counter()) + 1.0)
+        return self.status_view()
+
+    def run_until_idle(self, timeout_s: Optional[float] = None) -> dict:
+        """Blocks until every admitted job is terminal (DONE or
+        QUARANTINED); returns :meth:`status_view`.  The CLI's serve
+        main loop — SIGTERM lands in :meth:`drain` via the signal
+        handler, which also unblocks this wait."""
+        deadline = (
+            None if timeout_s is None else time.perf_counter() + timeout_s
+        )
+        with self._cv:
+            while True:
+                jobs = list(self._jobs.values())
+                # Workers drained too: a job's terminal transition
+                # happens before its worker writes artifacts and merges
+                # its registry fork — idle means both are done.
+                if jobs and not self._workers and all(
+                    j.state in TERMINAL for j in jobs
+                ):
+                    break
+                if self._draining and not self._workers and not any(
+                    j.state == RUNNING for j in jobs
+                ):
+                    break
+                if deadline is not None and time.perf_counter() > deadline:
+                    break
+                self._cv.wait(0.1)
+        return self.status_view()
+
+    # -- scheduling --------------------------------------------------------
+
+    def _runnable_locked(self, now: float) -> List[ServeJob]:
+        # The _workers guard closes a re-admission race: _requeue()
+        # flips a job back to QUEUED from the worker's except block,
+        # BEFORE its finally stops the per-job heartbeat, merges the
+        # registry fork, and pops the worker entry — admitting the job
+        # again in that window would run two workers against one job
+        # directory (racing heartbeats, clobbered _workers bookkeeping,
+        # and a drain() that joins the wrong thread).  The entry is
+        # popped under _cv, so the job becomes runnable exactly when
+        # its previous attempt has fully landed.
+        return [
+            j for j in self._jobs.values()
+            if j.state == QUEUED and j.not_before <= now
+            and j.job_id not in self._workers
+        ]
+
+    def _admit_locked(self, now: float) -> List[ServeJob]:
+        """Bin-packing + fair-share pick under the lock: fill free lanes
+        from the ready queue, preferring (1) strictly higher priority,
+        (2) the warm bucket — the gate-count bucket most running jobs
+        occupy, so one wave shares kernel shapes across tenants, and
+        (3) fair-share tenant rotation (fewest running lanes first),
+        with FIFO submission order as the tiebreak."""
+        running = [j for j in self._jobs.values() if j.state == RUNNING]
+        free = self.lanes - len(running)
+        if free <= 0:
+            return []
+        ready = self._runnable_locked(now)
+        if not ready:
+            return []
+        by_tenant: Dict[str, int] = {}
+        for j in running:
+            by_tenant[j.tenant] = by_tenant.get(j.tenant, 0) + 1
+        bucket_votes: Dict[int, int] = {}
+        for j in running:
+            bucket_votes[j.bucket] = bucket_votes.get(j.bucket, 0) + 1
+        if not bucket_votes:
+            for j in ready:
+                bucket_votes[j.bucket] = bucket_votes.get(j.bucket, 0) + 1
+        warm = max(bucket_votes, key=lambda b: (bucket_votes[b], -b))
+        picks: List[ServeJob] = []
+        pool = list(ready)
+        while free > 0 and pool:
+            pool.sort(key=lambda j: (
+                -j.priority,
+                0 if j.bucket == warm else 1,
+                by_tenant.get(j.tenant, 0),
+                j.seq,
+            ))
+            j = pool.pop(0)
+            by_tenant[j.tenant] = by_tenant.get(j.tenant, 0) + 1
+            picks.append(j)
+            free -= 1
+        return picks
+
+    def _preempt_targets_locked(self, now: float) -> List[ServeJob]:
+        """Priority preemption: when no lane is free and a strictly
+        higher-priority job is ready, the lowest-priority running jobs
+        yield (snapshot + requeue), one per waiting higher-priority
+        job."""
+        running = sorted(
+            (j for j in self._jobs.values() if j.state == RUNNING),
+            key=lambda j: (j.priority, -j.seq),
+        )
+        if len(running) < self.lanes:
+            return []
+        waiting = sorted(
+            self._runnable_locked(now),
+            key=lambda j: -j.priority,
+        )
+        targets = []
+        ri = 0
+        for w in waiting:
+            # Skip victims already flagged (their lane frees at their
+            # next journal boundary) — an in-flight preemption must not
+            # shadow the next-lowest-priority lane from a second
+            # higher-priority waiter.
+            while ri < len(running) and running[ri]._preempt.is_set():
+                ri += 1
+            if ri >= len(running):
+                break
+            victim = running[ri]
+            if w.priority <= victim.priority:
+                # waiting is sorted by priority descending: if this
+                # waiter cannot preempt the cheapest remaining victim,
+                # no later waiter can.
+                break
+            targets.append(victim)
+            ri += 1
+        return targets
+
+    def _work(self) -> None:
+        """The scheduler thread: admit ready jobs onto free lanes, fire
+        priority preemptions, sleep on the condition variable between
+        events.  Spawns workers OUTSIDE the lock."""
+        while True:
+            now = time.perf_counter()
+            picks: List[ServeJob] = []
+            preempts: List[ServeJob] = []
+            with self._cv:
+                if self._stop:
+                    return
+                if not self._draining:
+                    picks = self._admit_locked(now)
+                    for j in picks:
+                        j.state = RUNNING
+                        j.started_t = now
+                        j._preempt = threading.Event()
+                        self.ctx.stats.observe(
+                            "serve_queue_wait_s", now - j.enqueued_t
+                        )
+                    preempts = self._preempt_targets_locked(now)
+                if not picks and not preempts:
+                    self._cv.wait(0.1)
+            for j in preempts:
+                j._preempt.set()
+            for j in picks:
+                t = threading.Thread(
+                    target=self._run_job, args=(j,),
+                    name=f"sbg-serve-{j.job_id}", daemon=True,
+                )
+                with self._cv:
+                    self._workers[j.job_id] = t
+                t.start()
+
+    # -- the worker --------------------------------------------------------
+
+    def _job_dir(self, job: ServeJob) -> str:
+        return os.path.join(self.root, job.job_dir_name)
+
+    def _progress_hook(
+        self, job: ServeJob, t0: float
+    ) -> Callable[[str, dict], None]:
+        """The per-attempt journal control point; see _JobJournal."""
+        cfg = self.deadline
+
+        def hook(rtype: str, rec: dict) -> None:
+            # First-hit detection: the first progress record carrying a
+            # result (an iteration's checkpoint, a round's beam) is the
+            # tenant's first hit; ttfh counts from SUBMISSION — queue
+            # wait and retries included, the latency the tenant sees.
+            hit = bool(rec.get("ckpt")) or bool(rec.get("beam"))
+            if hit and job.first_hit_t is None:
+                job.first_hit_t = time.perf_counter()
+                self.ctx.stats.observe(
+                    "job_time_to_first_hit_s",
+                    job.first_hit_t - job.submitted_t,
+                )
+            try:
+                faults.fault_point("serve.preempt")
+            except faults.InjectedFault as e:
+                # An injected raise at the preempt site IS a chaos
+                # preemption: snapshot (already durable) + requeue.
+                raise JobPreempted(str(e)) from None
+            if job._preempt.is_set():
+                raise JobPreempted("preempted by scheduler")
+            if cfg.budget_s > 0 and time.perf_counter() - t0 > cfg.budget_s:
+                raise DispatchTimeout(
+                    f"serve job {job.job_id!r} exceeded its "
+                    f"{cfg.budget_s:g}s attempt budget"
+                )
+
+        return hook
+
+    def _run_job(self, job: ServeJob) -> None:
+        """One attempt of one job on its own worker thread.  Never
+        raises: every outcome is a state transition (DONE, requeue, or
+        QUARANTINED) so a poison job can never take the scheduler — or
+        a neighbor tenant — down with it."""
+        faults.set_job(job.job_id)
+        t0 = time.perf_counter()
+        job_dir = self._job_dir(job)
+        view: Optional[JobView] = None
+        hb: Optional[Heartbeat] = None
+        try:
+            view = JobView(self.ctx, int(job.seed))
+            with self._cv:
+                job.registry = view.stats
+            journal = _JobJournal.for_job(
+                self.root, job.job_dir_name,
+                {"job": job.job_id, "sbox": os.path.abspath(job.sbox_path),
+                 "output": job.output, "seed": int(job.seed),
+                 "tenant": job.tenant,
+                 "iterations": self.ctx.opt.iterations},
+                resume=True,
+            )
+            journal._serve_ctl = self._progress_hook(job, t0)
+            hb = Heartbeat(
+                view.stats, job_dir, interval_s=0, rank=0,
+                resume=journal.resumed,
+                run_config={"job": job.job_id, "tenant": job.tenant,
+                            "seed": int(job.seed), "output": job.output,
+                            "attempt": job.failures + job.preemptions},
+                incident_hook=False,
+            ).start()
+            sbox, num_inputs = load_sbox(job.sbox_path, job.permute)
+            targets = make_targets(sbox)
+            st = State.init_inputs(num_inputs)
+
+            def jlog(s: str) -> None:
+                if self.ctx.opt.verbosity >= 1:
+                    self.log(f"[{job.job_id}] {s}")
+
+            if job.output >= 0:
+                results = generate_graph_one_output(
+                    view, st, targets, job.output, save_dir=job_dir,
+                    log=jlog, journal=journal,
+                )
+            else:
+                results = generate_graph(
+                    view, st, targets, save_dir=job_dir, log=jlog,
+                    journal=journal,
+                )
+            with self._cv:
+                job.state = DONE
+                job.finished_t = time.perf_counter()
+                job.result_count = len(results)
+            # job_seconds spans submission -> completion (queue wait and
+            # retries included — the latency the tenant sees); the ttfh
+            # histogram is observed ONCE, by the progress hook, at the
+            # first hit.
+            self.ctx.stats.observe(
+                "job_seconds", job.finished_t - job.submitted_t
+            )
+            _ttrace.tracer().record(
+                f"job[{job.job_id}]", "job", job.submitted_t,
+                job.finished_t, {"found": bool(results)},
+            )
+            self.log(
+                f"serve: job {job.job_id} done "
+                f"({len(results)} state{'s' if len(results) != 1 else ''})"
+            )
+        except JobPreempted as e:
+            with self._cv:
+                job.state = PREEMPTED
+                job.preemptions += 1
+                if view is not None and view.last_dispatch_gates:
+                    job.bucket = bucket_size(view.last_dispatch_gates)
+            self.ctx.stats.inc("serve_preemptions")
+            self.log(f"serve: job {job.job_id} preempted ({e})")
+            if self._draining and view is not None:
+                # Drain artifacts: the flight dump lands IN the job's
+                # directory (the heartbeat/metrics.json below do too).
+                _tflight.flight_dump(
+                    "serve_drain", registry=view.stats,
+                    directory=job_dir, extra={"job": job.job_id},
+                )
+            self._requeue(job)
+        except BaseException as e:  # the poison-job safety net
+            failures = None
+            with self._cv:
+                job.failures += 1
+                job.error = repr(e)
+                failures = job.failures
+            if failures > self.deadline.retries:
+                self._quarantine(job, view)
+            else:
+                backoff = self.deadline.backoff_s * (
+                    2 ** (failures - 1)
+                )
+                self.log(
+                    f"serve: job {job.job_id} failed ({e!r}); retry "
+                    f"{failures}/{self.deadline.retries} in "
+                    f"{backoff:.2f}s"
+                )
+                self._requeue(job, backoff_s=backoff)
+        finally:
+            faults.set_job(None)
+            if hb is not None:
+                try:
+                    hb.stop()
+                except Exception as e:
+                    # A failed per-job artifact write must not turn a
+                    # completed/requeued job into a worker crash.
+                    logger.warning(
+                        "serve: job %s heartbeat stop failed: %r",
+                        job.job_id, e,
+                    )
+            if view is not None:
+                self.ctx.stats.merge(view.stats)
+            with self._cv:
+                self._workers.pop(job.job_id, None)
+                self._cv.notify_all()
+
+    def _requeue(self, job: ServeJob, backoff_s: float = 0.0) -> None:
+        """Back onto the queue (preemption or retriable failure).  The
+        ``serve.requeue`` chaos site fires first; an injected raise
+        there consumes one retry and requeues anyway — a chaos-lost
+        requeue becomes a counted failure, never a vanished job."""
+        try:
+            faults.fault_point("serve.requeue")
+        except faults.InjectedFault as e:
+            with self._cv:
+                job.failures += 1
+                job.error = repr(e)
+                failures = job.failures
+            if failures > self.deadline.retries:
+                self._quarantine(job, None)
+                return
+            backoff_s = max(
+                backoff_s,
+                self.deadline.backoff_s * (2 ** (failures - 1)),
+            )
+        now = time.perf_counter()
+        with self._cv:
+            job.state = QUEUED
+            job.enqueued_t = now
+            job.not_before = now + backoff_s
+            job._preempt = threading.Event()
+            self._cv.notify_all()
+
+    def _quarantine(self, job: ServeJob, view: Optional[JobView]) -> None:
+        """Terminal isolation for a poison job: flight dump into the
+        job's own directory, counter, log line — and nothing else.  The
+        shared context, the device breaker, and every other tenant are
+        untouched."""
+        with self._cv:
+            job.state = QUARANTINED
+            job.finished_t = time.perf_counter()
+            self._cv.notify_all()
+        self.ctx.stats.inc("serve_quarantined")
+        _tflight.flight_dump(
+            "serve_quarantine",
+            registry=view.stats if view is not None else None,
+            directory=self._job_dir(job),
+            extra={"job": job.job_id, "error": job.error},
+        )
+        self.log(
+            f"serve: job {job.job_id} QUARANTINED after "
+            f"{job.failures} failed attempts ({job.error})"
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    def status_view(self) -> dict:
+        """The per-job queue view for ``/status`` and the heartbeat
+        lines: states, tenants, priorities, per-job ttfh-so-far, and a
+        small live-counter slice read from each job's registry FORK —
+        all host-side state, zero device syncs."""
+        now = time.perf_counter()
+        with self._cv:
+            jobs = {}
+            counts = dict.fromkeys(
+                (QUEUED, RUNNING, PREEMPTED, QUARANTINED, DONE), 0
+            )
+            for j in self._jobs.values():
+                counts[j.state] = counts.get(j.state, 0) + 1
+                row = {
+                    "state": j.state,
+                    "tenant": j.tenant,
+                    "priority": j.priority,
+                    "bucket": j.bucket,
+                    "failures": j.failures,
+                    "preemptions": j.preemptions,
+                }
+                if j.state == QUEUED:
+                    row["queue_wait_s"] = round(now - j.enqueued_t, 3)
+                if j.state == RUNNING and j.started_t is not None:
+                    row["running_s"] = round(now - j.started_t, 3)
+                if j.first_hit_t is not None:
+                    row["ttfh_s"] = round(j.first_hit_t - j.submitted_t, 3)
+                if j.result_count is not None:
+                    row["results"] = j.result_count
+                if j.error is not None:
+                    row["error"] = j.error
+                reg = j.registry
+                if reg is not None and j.state == RUNNING:
+                    # The fork's own lock serializes this read against
+                    # the job thread; no device sync, no ordering need.
+                    row["dispatches"] = int(
+                        reg.get("device_dispatches", 0)
+                    )
+                jobs[j.job_id] = row
+            return {
+                "schema": SERVE_SCHEMA,
+                "lanes": self.lanes,
+                "lane_bucket": self.lane_bucket,
+                "draining": self._draining,
+                "counts": counts,
+                "jobs": jobs,
+            }

@@ -22,6 +22,7 @@ is on disk even when the process dies before the next period.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -30,6 +31,8 @@ from typing import Optional
 from . import attribution as _attribution
 from . import flight as _flight
 from .metrics import GLOBAL, MetricsRegistry
+
+logger = logging.getLogger(__name__)
 
 JSONL_NAME = "telemetry.jsonl"
 SNAPSHOT_NAME = "metrics.json"
@@ -48,12 +51,24 @@ class Heartbeat:
         rank: int = 0,
         resume: bool = False,
         run_config: Optional[dict] = None,
+        extra: Optional[dict] = None,
+        incident_hook: bool = True,
     ):
         self.registry = registry
         self.directory = directory
         self.interval_s = float(interval_s)
         self.rank = int(rank)
         self.run_config = run_config
+        #: Extra per-line sections: name -> zero-arg provider, evaluated
+        #: at every emit (the serve-mode per-job queue view rides here).
+        #: A failing provider degrades to an error note, never takes the
+        #: heartbeat down.
+        self.extra = dict(extra or {})
+        #: Per-job serve heartbeats opt OUT of the flight-recorder
+        #: incident hook: the recorder is process-global, and a dump for
+        #: one tenant's incident must not append incident lines into
+        #: every concurrent job's telemetry.jsonl.
+        self.incident_hook = bool(incident_hook)
         self._seq = 0
         self._t0 = time.monotonic()
         self._stop = threading.Event()
@@ -68,13 +83,20 @@ class Heartbeat:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def add_provider(self, name: str, provider) -> None:
+        """Registers one extra per-line section (see ``extra``) after
+        construction — the CLI's serve branch wires the orchestrator's
+        queue view here once the orchestrator exists."""
+        self.extra[name] = provider
+
     def start(self) -> "Heartbeat":
         if self.interval_s > 0 and self._thread is None:
             self._thread = threading.Thread(
                 target=self._work, name="sbg-heartbeat", daemon=True
             )
             self._thread.start()
-        _flight.flight_recorder().on_dump(self._on_incident)
+        if self.incident_hook:
+            _flight.flight_recorder().on_dump(self._on_incident)
         self.emit(kind="start")
         return self
 
@@ -121,6 +143,16 @@ class Heartbeat:
                 for name, snap in self.registry.histograms().items()
             },
         }
+        for name, provider in self.extra.items():
+            try:
+                rec[name] = provider()
+            except Exception as e:
+                # Degrade to an error note (the status-endpoint
+                # provider contract): a failing provider must never
+                # take the heartbeat — or the run — down with it.
+                logger.warning("heartbeat provider %r failed: %r",
+                               name, e)
+                rec[name] = {"error": repr(e)}
         if kind == "start" and self.run_config is not None:
             rec["config"] = self.run_config
         return rec

@@ -122,6 +122,18 @@ METRICS: Dict[str, MetricDef] = {
         COUNTER, "requests",
         "/status snapshots served by the live status endpoint",
     ),
+    # serve-mode orchestrator (search/serve.py)
+    "serve_jobs_admitted": MetricDef(
+        COUNTER, "jobs", "jobs admitted into the serve queue"
+    ),
+    "serve_preemptions": MetricDef(
+        COUNTER, "events",
+        "serve jobs preempted at a journal boundary (snapshot + requeue)",
+    ),
+    "serve_quarantined": MetricDef(
+        COUNTER, "jobs",
+        "poison jobs quarantined after exhausting their retry schedule",
+    ),
     # histograms (bracketed members inherit the base declaration)
     "device_wait_s": MetricDef(
         HISTOGRAM, "s",
@@ -139,6 +151,11 @@ METRICS: Dict[str, MetricDef] = {
         "per-job wall time from job start to its first completed circuit",
     ),
     "job_seconds": MetricDef(HISTOGRAM, "s", "per-job total wall time"),
+    "serve_queue_wait_s": MetricDef(
+        HISTOGRAM, "s",
+        "serve-mode queue wait per admission grant (enqueue/requeue to "
+        "lane start)",
+    ),
     "rounds_per_dispatch": MetricDef(
         HISTOGRAM, "rounds",
         "search rounds completed per fused round-driver dispatch (1.0 "

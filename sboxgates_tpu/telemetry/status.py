@@ -169,6 +169,15 @@ class StatusServer:
         self._server = HTTPServer((host, int(port)), Handler)
         self._server.timeout = 1.0
 
+    def add_provider(self, name: str, provider) -> None:
+        """Registers one extra snapshot section after construction (the
+        CLI's serve branch wires the orchestrator's per-job queue view
+        here once the orchestrator exists).  Providers run under
+        build_status's existing degrade-to-error-note guard."""
+        if self.extra is None:
+            self.extra = {}
+        self.extra[name] = provider
+
     @property
     def port(self) -> int:
         """The bound port (meaningful after construction; with

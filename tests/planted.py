@@ -54,16 +54,21 @@ PLANT7_MIDDLE = 0xE8
 PLANT7_INNER = 0xCA
 
 
-def build_planted_lut7():
-    """(state, target, mask): 24 mixed-gate state (8 inputs) with a target
-    realizable as LUT(LUT(9,12,17), LUT(10,15,21), 19).  C(24,7) = 346k
-    exceeds the fused-head single-chunk limit, so the search takes the
-    staged path, and stage A collects ~1.5k feasible tuples — past every
-    host-solve threshold, forcing the sharded stage-B device solver."""
+def build_planted_lut7(gates: int = 24):
+    """(state, target, mask): ``gates`` mixed-gate state (8 inputs) with
+    a target realizable as LUT(LUT(9,12,17), LUT(10,15,21), 19).
+    C(gates, 7) exceeds the fused-head single-chunk limit (2^17) for
+    every ``gates`` >= 22, so the search takes the staged path, and
+    stage A collects enough feasible tuples to pass every host-solve
+    threshold, forcing the sharded stage-B device solver.  The default
+    24 (C(24,7) = 346k) is the historical shape; ``gates=22`` (C(22,7)
+    = 171k) halves stage-A work for the tier-1 walks that only need the
+    staged routing, not the bigger space."""
+    assert gates >= 22, "below 22 gates the 7-LUT space fits one chunk"
     rng = np.random.default_rng(3)
     st = State.init_inputs(8)
     funs = [bf.AND, bf.OR, bf.XOR, bf.A_AND_NOT_B]
-    while st.num_gates < 24:
+    while st.num_gates < gates:
         a, b = rng.choice(st.num_gates, size=2, replace=False)
         st.add_gate(funs[rng.integers(len(funs))], int(a), int(b), GATES)
     outer = tt.eval_lut(PLANT7_OUTER, st.table(9), st.table(12), st.table(17))

@@ -206,6 +206,98 @@ def test_cli_fleet_shard_sweep_composes(tmp_path, monkeypatch, capsys):
     assert cfg["fleet_candidates"] == 1
 
 
+def test_serve_incoherent_flag_combos_rejected(tmp_path, monkeypatch,
+                                               capsys):
+    """--serve owns scheduling: the conflicting mode flags, a missing
+    --output-dir, and bad policy values are each a one-line error with
+    no traceback and no stranded journal files."""
+    monkeypatch.chdir(tmp_path)
+    d = str(tmp_path / "out")
+    for argv in (
+        ["--serve", DES],                                # no output dir
+        ["--serve", "--fleet", DES, "--output-dir", d],
+        ["--serve", "--mesh", DES, "--output-dir", d],
+        ["--serve", "--shard-sweep", DES, FA, "--output-dir", d],
+        ["--serve", "--batch-iterations", DES, "--output-dir", d],
+        ["--serve", "--permute-sweep", DES, "--output-dir", d],
+        ["--serve", "--serial-jobs", DES, "--output-dir", d],
+        ["--serve", "--serve-lanes", "0", DES, "--output-dir", d],
+        ["--serve", "--serve-retries", "-1", DES, "--output-dir", d],
+        ["--serve", "--serve-timeout", "0", DES, "--output-dir", d],
+        ["--serve", "--resume-run", d],
+        ["--serve", "--coordinator", "x:1", DES, "--output-dir", d],
+    ):
+        rc = main(argv)
+        assert rc != 0, argv
+        err = capsys.readouterr().err
+        assert err.strip().count("\n") == 0, (argv, err)
+        assert "Traceback" not in err
+        assert not list(tmp_path.glob("search.journal.*")), argv
+
+
+def test_cli_serve_end_to_end_and_resume_rejected(tmp_path, capsys):
+    """--serve runs each input as one queue job (per-job journals and
+    artifacts under DIR/<job-id>/), records the serve keys in the run
+    journal, and a later --resume-run DIR is a one-line error naming
+    the per-job resume path."""
+    import json
+
+    d = str(tmp_path)
+    rc = main([DES, FA, "-o", "0", "--serve", "--serve-lanes", "2",
+               "--seed", "5", "--output-dir", d])
+    assert rc == 0, capsys.readouterr().err
+    for jdir in ("job00-des_s1", "job01-crypto1_fa"):
+        names = os.listdir(os.path.join(d, jdir))
+        assert "search.journal.jsonl" in names
+        assert "metrics.json" in names
+        assert any(n.endswith(".xml") for n in names), names
+    recs = [
+        json.loads(line)
+        for line in open(os.path.join(d, "search.journal.jsonl"))
+    ]
+    cfg = recs[0]["config"]
+    assert cfg["serve"] is True
+    assert cfg["serve_lanes"] == 2
+    assert cfg["serve_retries"] == 2
+    assert cfg["serve_timeout"] is None
+    assert recs[-1]["type"] == "run_done"
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "serve" in err and "--resume-run" in err
+    assert err.strip().count("\n") == 0
+    assert "Traceback" not in err
+
+
+def test_resume_journal_without_serve_keys(tmp_path, capsys):
+    """A version-2 journal written before the serve keys existed
+    resumes with their defaults (serve off — the value every earlier
+    build effectively ran with) instead of being rejected as an
+    incompatible build."""
+    import json
+
+    d = str(tmp_path)
+    rc = main([FA, "-i", "1", "-o", "0", "-l", "--seed", "3",
+               "--output-dir", d])
+    assert rc == 0
+    jpath = os.path.join(d, "search.journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    for key in ("serve", "serve_lanes", "serve_retries",
+                "serve_timeout"):
+        assert key in recs[0]["config"]
+        del recs[0]["config"][key]
+    with open(jpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    os.unlink(os.path.join(d, "search.journal.json"))  # stale snapshot
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "incompatible build" not in out.err
+    assert "nothing to resume" in out.out
+
+
 def test_help_exits_zero():
     with pytest.raises(SystemExit) as e:
         main(["--help"])

@@ -408,23 +408,27 @@ def test_lut_step_native_bitwise_matches_kernel(randomize):
     assert {1, 4, 5}.issubset(steps_seen), steps_seen
 
 
-@pytest.mark.slow
 def test_lut_step_native_full_search_identical():
     """End-to-end: a LUT-mode search must produce the identical circuit
     whichever path executes the head sweeps (fixed seed, both modes).
 
-    Marked slow (~40 s: four full DES searches): the per-verdict parity
-    of the same routing is tier-1-covered by
-    test_lut_step_native_bitwise_matches_kernel, and the gate-mode
-    full-search twin stays tier-1 — see the ROADMAP tier-1 budget
-    note."""
+    Problem size: a 5-input random bijective S-box (PR 13 shrink — was
+    four full DES S1 searches at ~40 s, promoted to ``slow`` in PR 12).
+    The 5-input walk is a real multi-node mux recursion whose device
+    arm makes ~50 dispatches across the pair / 3-LUT / 5-LUT / staged
+    7-LUT heads, so the routing-equality claim keeps its end-to-end
+    teeth at ~1/4 the wall clock; the per-verdict parity of every head
+    at DES-and-larger sizes stays pinned by
+    test_lut_step_native_bitwise_matches_kernel."""
     from sboxgates_tpu.core.ttable import mask_table
     from sboxgates_tpu.graph.xmlio import state_fingerprint
     from sboxgates_tpu.search import make_targets
     from sboxgates_tpu.search.kwan import create_circuit
 
-    with open("sboxes/des_s1.txt") as f:
-        sbox, n = parse_sbox(f.read())
+    rng = np.random.default_rng(9)
+    sbox = np.zeros(256, dtype=np.uint8)
+    sbox[:32] = rng.permutation(32)
+    n = 5
     targets = make_targets(sbox)
     for randomize in (False, True):
         prints = []
@@ -444,6 +448,12 @@ def test_lut_step_native_full_search_identical():
             assert out != 0xFFFF
             st.outputs[0] = out
             prints.append(state_fingerprint(st))
+            if not host:
+                # The shrunk problem must still drive the device path:
+                # a search that never dispatched proves nothing about
+                # routing equality.
+                assert ctx.stats["device_dispatches"] > 0
+                assert ctx.stats["lut5_candidates"] > 0
         assert prints[0] == prints[1], f"randomize={randomize}"
 
 
@@ -815,24 +825,26 @@ def test_lut_engine_continuation_services_pivot_states():
     assert ctx_e.stats["engine_nodes"] >= 1
 
 
-@pytest.mark.slow
 def test_lut_engine_continuation_services_staged_lut7():
     """A state whose 7-LUT space exceeds the single-chunk limit routes
     the staged search through the continuation service; the engine
     materializes the serviced decomposition bit-identically to the
     Python engine's.
 
-    Marked slow (~50 s: two full staged-lut7 walks): the continuation
-    service machinery stays tier-1-covered by the pivot-states twin
-    (seconds, same service path) — see the ROADMAP tier-1 budget
-    note."""
+    Problem size: the 22-gate planted state (PR 13 shrink — C(22,7) =
+    171k still crosses the 2^17 single-chunk limit, so the staged
+    routing and the stage-B device solve are exercised identically at
+    half the stage-A work; the walk was the 24-gate shape at ~50 s,
+    promoted to ``slow`` in PR 12)."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))
     from planted import build_planted_lut7
 
-    out_e, gates_e, ctx_e = _run_lut_engine_case(build_planted_lut7, True)
-    out_p, gates_p, ctx_p = _run_lut_engine_case(build_planted_lut7, False)
+    out_e, gates_e, ctx_e = _run_lut_engine_case(
+        lambda: build_planted_lut7(22), True)
+    out_p, gates_p, ctx_p = _run_lut_engine_case(
+        lambda: build_planted_lut7(22), False)
     assert (out_e, gates_e) == (out_p, gates_p)
     assert ctx_e.stats["engine_devcalls"] >= 1
     assert ctx_e.stats["lut7_candidates"] == ctx_p.stats["lut7_candidates"] > 0
@@ -840,7 +852,6 @@ def test_lut_engine_continuation_services_staged_lut7():
     assert ctx_e.stats.get("python_nodes", 0) == 0
 
 
-@pytest.mark.slow
 def test_lut_engine_service_binds_per_context_views():
     """A RestartContext view inherits the base context's __dict__ —
     including any cached engine device-work service.  A devcall from the
@@ -849,12 +860,16 @@ def test_lut_engine_service_binds_per_context_views():
     cached closure was built for: the view counts the serviced work and
     the base's counters stay untouched until an explicit merge.
 
-    Marked slow (~30 s: a planted-lut5 priming walk plus a staged-lut7
-    walk through the view) — see the ROADMAP tier-1 budget note."""
+    Problem size: both walks use the 22-gate staged-lut7 planted state
+    (PR 13 shrink — the priming walk needs any real engine devcall to
+    cache a service closure, and the kind-3 staged service does that at
+    a third of the old planted-lut5 pivot walk's cost; the pivot kind-1
+    service keeps its own tier-1 coverage in
+    test_lut_engine_continuation_services_pivot_states)."""
     import sys
 
     sys.path.insert(0, os.path.dirname(__file__))
-    from planted import build_planted_lut5, build_planted_lut7
+    from planted import build_planted_lut7
 
     from sboxgates_tpu.search import Options, SearchContext
     from sboxgates_tpu.search.batched import Rendezvous, RestartContext
@@ -862,7 +877,7 @@ def test_lut_engine_service_binds_per_context_views():
 
     base = SearchContext(Options(seed=2, lut_graph=True, randomize=False))
     # Prime the base's service cache with a real engine+devcall run.
-    st0, t0, m0 = build_planted_lut5()
+    st0, t0, m0 = build_planted_lut7(22)
     assert create_circuit(base, st0, t0, m0, []) != 0xFFFF
     assert base._lut_engine_service_fn[0] is base
     base_counts = dict(base.stats)
@@ -870,7 +885,7 @@ def test_lut_engine_service_binds_per_context_views():
     view = RestartContext(base, 123, Rendezvous(1))
     # The inherited cache entry still names the base as its owner...
     assert view._lut_engine_service_fn[0] is base
-    st, target, mask = build_planted_lut7()  # host-only node, staged 7-LUT
+    st, target, mask = build_planted_lut7(22)  # host-only, staged 7-LUT
     out = create_circuit(view, st, target, mask, [])
     assert out != 0xFFFF
     st.verify_gate(out, target, mask)

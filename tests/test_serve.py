@@ -1,0 +1,521 @@
+"""Serve-mode orchestrator tests: multi-tenant scheduling over one
+shared warm context, chaos-verified bit-exact recovery, poison-job
+quarantine isolation, priority preemption, and graceful drain.
+
+The chaos matrix is the acceptance gate: a randomized (seeded)
+preempt/kill/requeue schedule over an 8-job serve run must yield final
+circuits bit-identical to each job run standalone with the same seed —
+the PR 3/7 exact-resume contract, exercised live through the serve
+sites.  All tests are in-process (no subprocess per case) and run on
+small one-output searches, so the file stays tier-1-cheap.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.graph.state import State
+from sboxgates_tpu.resilience import faults
+from sboxgates_tpu.resilience.deadline import DeadlineConfig
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search.orchestrator import (
+    generate_graph_one_output,
+    make_targets,
+)
+from sboxgates_tpu.search.serve import (
+    DONE,
+    QUARANTINED,
+    QUEUED,
+    RUNNING,
+    JobView,
+    ServeClosed,
+    ServeJob,
+    ServeOrchestrator,
+    job_seed,
+    lane_bucket,
+)
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+DES = os.path.join(DATA, "des_s1.txt")
+FA = os.path.join(DATA, "crypto1_fa.txt")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def xml_digests(d):
+    """{filename: sha256} of every checkpoint under a job directory."""
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(d, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(d))
+        if f.endswith(".xml")
+    }
+
+
+def standalone_digests(tmp_dir, sbox_path, output, seed, iterations=1):
+    """The bit-identity reference: the same job run on a FRESH context
+    with the same seed and options, no orchestrator anywhere near it."""
+    ctx = SearchContext(Options(seed=seed, iterations=iterations))
+    sbox, num_inputs = load_sbox(sbox_path, 0)
+    targets = make_targets(sbox)
+    st = State.init_inputs(num_inputs)
+    os.makedirs(tmp_dir, exist_ok=True)
+    generate_graph_one_output(
+        ctx, st, targets, output, save_dir=tmp_dir,
+        log=lambda s: None, journal=None,
+    )
+    return xml_digests(tmp_dir)
+
+
+def make_orch(tmp_path, iterations=1, lanes=2, retries=2, seed=11,
+              timeout_s=0.0, backoff_s=0.01):
+    ctx = SearchContext(Options(seed=seed, iterations=iterations))
+    root = str(tmp_path / "serve")
+    orch = ServeOrchestrator(
+        ctx, root, lanes=lanes,
+        deadline=DeadlineConfig(
+            budget_s=timeout_s, retries=retries, backoff_s=backoff_s
+        ),
+        log=lambda s: None,
+    )
+    return ctx, orch
+
+
+JOB_SET = [
+    # (job_id, sbox, output, tenant, priority)
+    ("j0", DES, 0, "acme", 0),
+    ("j1", DES, 1, "acme", 0),
+    ("j2", DES, 2, "blue", 0),
+    ("j3", DES, 3, "blue", 0),
+    ("j4", FA, 0, "core", 0),
+    ("j5", DES, 0, "core", 0),
+    ("j6", DES, 1, "blue", 0),
+    ("j7", FA, 0, "acme", 0),
+]
+
+
+def submit_all(orch, jobs=JOB_SET):
+    out = []
+    for job_id, path, output, tenant, prio in jobs:
+        out.append(orch.submit(ServeJob(
+            job_id=job_id, sbox_path=path, output=output,
+            tenant=tenant, priority=prio,
+        )))
+    return out
+
+
+def test_serve_runs_jobs_on_shared_context(tmp_path):
+    """Happy path: tenants share one warm context, every job lands DONE
+    with per-job artifacts, and the serving metrics fill in."""
+    ctx, orch = make_orch(tmp_path, lanes=2)
+    submit_all(orch, JOB_SET[:4])
+    orch.start()
+    view = orch.run_until_idle(timeout_s=120)
+    orch.stop()
+    assert view["counts"][DONE] == 4, view
+    for jid in ("j0", "j1", "j2", "j3"):
+        d = os.path.join(orch.root, jid)
+        names = os.listdir(d)
+        assert "metrics.json" in names
+        assert "telemetry.jsonl" in names
+        assert "search.journal.jsonl" in names
+        assert any(n.endswith(".xml") for n in names)
+        # Per-job metrics.json is the job's OWN fork snapshot.
+        snap = json.load(open(os.path.join(d, "metrics.json")))
+        assert snap["config"]["job"] == jid
+    s = ctx.stats
+    assert s["serve_jobs_admitted"] == 4
+    assert s.get("serve_quarantined", 0) == 0
+    hists = s.histograms()
+    assert hists["serve_queue_wait_s"]["count"] == 4
+    assert hists["job_time_to_first_hit_s"]["count"] == 4
+    assert hists["job_seconds"]["count"] == 4
+    assert s.undeclared() == set()
+    # The run journal the CLI writes is orthogonal; each job journaled.
+    rec = json.load(open(os.path.join(
+        orch.root, "j0", "search.journal.json")))
+    assert rec["records"][0]["type"] == "run_start"
+
+
+def test_chaos_matrix_bit_identical(tmp_path):
+    """THE acceptance gate: a randomized preempt/kill/requeue schedule
+    over an 8-job serve run yields final circuits bit-identical to each
+    job run standalone.  The schedule is seeded (reproducible) and
+    drives all three chaos shapes through the standard injection
+    machinery: ``serve.preempt@job:ID`` (preemption at a journal
+    boundary), ``search.node@job:ID`` (a mid-iteration kill whose retry
+    resumes from the journal), and a global ``serve.requeue`` raise (a
+    chaos-lost requeue that consumes a retry instead of losing the
+    job)."""
+    rng = np.random.default_rng(42)
+    ctx, orch = make_orch(tmp_path, iterations=2, lanes=3, retries=4)
+    jobs = submit_all(orch)
+    # Randomized schedule: 3 preempt victims, 2 kill victims (disjoint
+    # draws may overlap — a job may be both preempted AND killed).
+    victims = rng.choice([j.job_id for j in jobs], size=3, replace=False)
+    for v in victims:
+        faults.arm(f"serve.preempt@job:{v}", "raise",
+                   str(int(rng.integers(1, 3))))
+    kills = rng.choice([j.job_id for j in jobs], size=2, replace=False)
+    for v in kills:
+        faults.arm(f"search.node@job:{v}", "raise",
+                   str(int(rng.integers(1, 4))))
+    faults.arm("serve.requeue", "raise", "2")
+    orch.start()
+    view = orch.run_until_idle(timeout_s=240)
+    orch.stop()
+    assert view["counts"][DONE] == len(jobs), view
+    assert ctx.stats["serve_preemptions"] >= 1
+    # Bit-identity: every job's final checkpoints equal its standalone
+    # run's, chaos or no chaos.
+    for j in jobs:
+        ref = standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed), iterations=2,
+        )
+        got = xml_digests(os.path.join(orch.root, j.job_id))
+        assert got == ref, f"{j.job_id} diverged under chaos"
+    assert ctx.stats.undeclared() == set()
+
+
+def test_poison_job_quarantined_healthy_tenants_unaffected(tmp_path):
+    """A job that fails every attempt exhausts its retry schedule and
+    is quarantined — without tripping the shared device breaker,
+    stalling the queue, or perturbing its neighbors' results."""
+    ctx, orch = make_orch(tmp_path, lanes=2, retries=1)
+    jobs = submit_all(orch, JOB_SET[:3])
+    poison = orch.submit(ServeJob(
+        job_id="poison", sbox_path=DES, output=0, tenant="evil",
+    ))
+    faults.arm("search.node@job:poison", "raise", "1+")
+    orch.start()
+    view = orch.run_until_idle(timeout_s=120)
+    orch.stop()
+    assert view["jobs"]["poison"]["state"] == QUARANTINED
+    assert view["counts"][QUARANTINED] == 1
+    assert view["counts"][DONE] == 3
+    assert ctx.stats["serve_quarantined"] == 1
+    assert poison.failures == 2  # initial attempt + 1 retry
+    # Isolation: the shared context is untouched by the poison tenant.
+    assert ctx.device_degraded is False
+    # The quarantine left a post-mortem in the poison job's own dir.
+    pdir = os.path.join(orch.root, "poison")
+    assert any(n.startswith("flight-") for n in os.listdir(pdir)), (
+        os.listdir(pdir)
+    )
+    # Healthy tenants' circuits are bit-identical to standalone runs.
+    for j in jobs:
+        ref = standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed),
+        )
+        assert xml_digests(os.path.join(orch.root, j.job_id)) == ref
+
+
+def _wait_state(orch, job_id, state, timeout_s=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if orch.status_view()["jobs"][job_id]["state"] == state:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_priority_preemption_resumes_bit_identical(tmp_path):
+    """A higher-priority arrival preempts the lowest-priority running
+    job when no lane is free; the victim's snapshot+requeue resume is
+    bit-exact."""
+    ctx, orch = make_orch(tmp_path, iterations=4, lanes=1, retries=2)
+    low = orch.submit(ServeJob(
+        job_id="low", sbox_path=DES, output=0, tenant="t", priority=0,
+    ))
+    orch.start()
+    assert _wait_state(orch, "low", RUNNING)
+    high = orch.submit(ServeJob(
+        job_id="high", sbox_path=FA, output=0, tenant="t", priority=5,
+    ))
+    view = orch.run_until_idle(timeout_s=120)
+    orch.stop()
+    assert view["counts"][DONE] == 2, view
+    # The preemption actually happened (the victim has >= 1 iteration
+    # per attempt, so the boundary lands while high waits).
+    assert low.preemptions >= 1
+    assert ctx.stats["serve_preemptions"] >= 1
+    for j, iters in ((low, 4), (high, 4)):
+        ref = standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed), iterations=iters,
+        )
+        assert xml_digests(os.path.join(orch.root, j.job_id)) == ref
+
+
+def test_drain_snapshots_requeues_and_recovers(tmp_path):
+    """drain(): admission closes, running jobs preempt at their next
+    journal boundary with per-job artifacts (final heartbeat +
+    metrics.json + flight dump), and a NEW orchestrator over the same
+    root finishes every job bit-identically."""
+    ctx, orch = make_orch(tmp_path, iterations=3, lanes=2)
+    jobs = submit_all(orch, JOB_SET[:3])
+    orch.start()
+    assert _wait_state(orch, "j0", RUNNING)
+    view = orch.drain(timeout_s=30)
+    assert view["draining"]
+    assert all(
+        r["state"] in (QUEUED, DONE) for r in view["jobs"].values()
+    ), view
+    with pytest.raises(ServeClosed):
+        orch.submit(ServeJob(job_id="late", sbox_path=DES, output=0))
+    preempted = [
+        jid for jid, r in view["jobs"].items()
+        if r["state"] == QUEUED and r.get("preemptions", 0) > 0
+    ]
+    assert preempted, view  # at least one job was mid-flight
+    for jid in preempted:
+        d = os.path.join(orch.root, jid)
+        names = os.listdir(d)
+        assert "metrics.json" in names, names
+        assert any(n.startswith("flight-") for n in names), names
+        lines = [json.loads(line) for line in
+                 open(os.path.join(d, "telemetry.jsonl"))]
+        assert lines[-1]["kind"] == "final"
+    # Recovery: a fresh orchestrator (same root, same seeds) completes
+    # the preempted jobs from their journals.
+    ctx2 = SearchContext(Options(seed=11, iterations=3))
+    orch2 = ServeOrchestrator(
+        ctx2, orch.root, lanes=2,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.01),
+        log=lambda s: None,
+    )
+    for j in jobs:
+        orch2.submit(ServeJob(
+            job_id=j.job_id, sbox_path=j.sbox_path, output=j.output,
+            tenant=j.tenant, seed=j.seed,
+        ))
+    orch2.start()
+    view2 = orch2.run_until_idle(timeout_s=120)
+    orch2.stop()
+    assert view2["counts"][DONE] == 3, view2
+    for j in jobs:
+        ref = standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed), iterations=3,
+        )
+        assert xml_digests(os.path.join(orch.root, j.job_id)) == ref
+
+
+def test_job_timeout_rides_deadline_machinery(tmp_path):
+    """A per-attempt wall budget of ~0 breaches at the first journal
+    boundary (DispatchTimeout, the deadline machinery's exception),
+    consumes the retry schedule, and quarantines — all without touching
+    neighbors."""
+    ctx, orch = make_orch(
+        tmp_path, iterations=2, lanes=2, retries=1, timeout_s=1e-9
+    )
+    orch.submit(ServeJob(job_id="slow", sbox_path=DES, output=0))
+    orch.start()
+    view = orch.run_until_idle(timeout_s=60)
+    orch.stop()
+    assert view["jobs"]["slow"]["state"] == QUARANTINED
+    assert "DispatchTimeout" in view["jobs"]["slow"]["error"]
+
+
+def test_admission_fair_share_and_bucket_grouping(tmp_path):
+    """The bin-packing pick: priority first, warm-bucket affinity next,
+    then fair-share tenant rotation (fewest running lanes first) with
+    FIFO as the tiebreak."""
+    ctx, orch = make_orch(tmp_path, lanes=2)
+    # Not started: exercise the pick directly, under the lock protocol.
+    a0 = orch.submit(ServeJob(job_id="a0", sbox_path=DES, tenant="a"))
+    a1 = orch.submit(ServeJob(job_id="a1", sbox_path=DES, tenant="a"))
+    b0 = orch.submit(ServeJob(job_id="b0", sbox_path=DES, tenant="b"))
+    hi = orch.submit(ServeJob(
+        job_id="hi", sbox_path=DES, tenant="c", priority=9,
+    ))
+    now = time.perf_counter()
+    with orch._cv:
+        picks = orch._admit_locked(now)
+    # Priority wins lane 1; fair share gives lane 2 to the earliest
+    # job of a fresh tenant rather than a's second job.
+    assert [j.job_id for j in picks] == ["hi", "a0"]
+    del a1, b0
+    # Bucket affinity: with a wave running at bucket 64, a same-bucket
+    # later submission beats an earlier-submitted bigger-bucket job —
+    # warm-kernel grouping ACROSS tenants outranks tenant rotation.
+    ctx2 = SearchContext(Options(seed=1))
+    orch2 = ServeOrchestrator(
+        ctx2, str(tmp_path / "s2"), lanes=2,
+        deadline=DeadlineConfig(), log=lambda s: None,
+    )
+    r0 = orch2.submit(ServeJob(job_id="r0", sbox_path=DES, tenant="a"))
+    cold = orch2.submit(ServeJob(job_id="cold", sbox_path=DES,
+                                 tenant="b"))
+    warm = orch2.submit(ServeJob(job_id="warm", sbox_path=DES,
+                                 tenant="a"))
+    cold.bucket = 512
+    with orch2._cv:
+        r0.state = RUNNING  # one lane busy at bucket 64
+        more = orch2._admit_locked(time.perf_counter())
+    assert [j.job_id for j in more] == ["warm"]
+
+
+def test_requeued_job_not_readmitted_until_worker_lands(tmp_path):
+    """_requeue flips a job back to QUEUED from the worker's except
+    block, BEFORE its finally writes artifacts and pops the worker
+    entry — admission must skip the job while its previous worker is
+    still registered, or two workers race on one job directory."""
+    ctx, orch = make_orch(tmp_path, lanes=2)
+    j = orch.submit(ServeJob(job_id="jq", sbox_path=DES, output=0))
+    now = time.perf_counter()
+    with orch._cv:
+        orch._workers["jq"] = object()  # previous attempt still landing
+        assert orch._admit_locked(now) == []
+        orch._workers.pop("jq")
+        assert orch._admit_locked(now) == [j]
+
+
+def test_preempt_targets_skip_already_flagged_victims(tmp_path):
+    """A victim whose preemption is already in flight must not shadow
+    the next-lowest-priority lane from a second higher-priority
+    waiter."""
+    ctx, orch = make_orch(tmp_path, lanes=2)
+    a = orch.submit(ServeJob(job_id="a", sbox_path=DES, priority=0))
+    b = orch.submit(ServeJob(job_id="b", sbox_path=DES, priority=0))
+    x = orch.submit(ServeJob(job_id="x", sbox_path=DES, priority=5))
+    y = orch.submit(ServeJob(job_id="y", sbox_path=DES, priority=5))
+    now = time.perf_counter()
+    with orch._cv:
+        a.state = RUNNING
+        b.state = RUNNING
+        a._preempt.set()  # X's preemption of A already in flight
+        targets = orch._preempt_targets_locked(now)
+    assert targets == [b]
+    del x, y
+
+
+def test_serve_helpers_and_closed_queue(tmp_path):
+    """job_seed is deterministic and id-sensitive; lane_bucket rounds
+    up the fleet ladder; duplicate ids are rejected."""
+    assert job_seed(5, "a") == job_seed(5, "a")
+    assert job_seed(5, "a") != job_seed(5, "b")
+    assert job_seed(6, "a") != job_seed(5, "a")
+    assert lane_bucket(1) == 1
+    assert lane_bucket(3) == 4
+    assert lane_bucket(33) == 64
+    assert lane_bucket(10**6) == 4096
+    ctx, orch = make_orch(tmp_path)
+    orch.submit(ServeJob(job_id="dup", sbox_path=DES))
+    with pytest.raises(ValueError):
+        orch.submit(ServeJob(job_id="dup", sbox_path=DES))
+
+
+def test_job_targeted_fault_specs():
+    """``@job:ID`` parsing and thread-local targeting: the fault fires
+    only on the thread currently running the matching job, each
+    variant keeps its own hit counter, and a ':' in a site name stays
+    invalid outside the @rank/@job suffixes."""
+    import threading
+
+    spec = faults.parse_spec("serve.preempt@job:j-3:raise@2")
+    assert "serve.preempt@job:j-3" in spec
+    with pytest.raises(ValueError):
+        faults.parse_spec("serve:preempt:raise")
+    faults.arm("serve.preempt@job:j3", "raise", "1")
+    fired = {}
+
+    def run(job, n):
+        faults.set_job(job)
+        hits = 0
+        for _ in range(n):
+            try:
+                faults.fault_point("serve.preempt")
+            except faults.InjectedFault:
+                hits += 1
+        fired[job] = hits
+        faults.set_job(None)
+
+    threads = [
+        threading.Thread(target=run, args=(j, 2)) for j in ("j3", "j4")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fired == {"j3": 1, "j4": 0}
+    assert faults.hit_count("serve.preempt@job:j3") == 2
+    # No current job and no env fallback: the qualified lookup is
+    # skipped entirely (the unarmed plain site stays a no-op).
+    faults.fault_point("serve.preempt")
+
+
+def test_serve_admit_fault_site_is_loud(tmp_path):
+    """An injected admission failure raises out of submit() — the job
+    is rejected loudly, never half-admitted."""
+    ctx, orch = make_orch(tmp_path)
+    faults.arm("serve.admit", "raise", "1")
+    with pytest.raises(faults.InjectedFault):
+        orch.submit(ServeJob(job_id="x", sbox_path=DES))
+    assert "x" not in orch.status_view()["jobs"]
+    assert ctx.stats.get("serve_jobs_admitted", 0) == 0
+
+
+def test_status_view_watch_render_and_heartbeat_section(tmp_path):
+    """The per-job queue view: schema, counts, per-job ttfh — rendered
+    by telemetry.watch and carried on heartbeat lines via the extra
+    provider (read from registry forks; no device syncs)."""
+    from sboxgates_tpu.telemetry.heartbeat import Heartbeat
+    from sboxgates_tpu.telemetry.watch import render, render_serve
+
+    ctx, orch = make_orch(tmp_path, lanes=2)
+    submit_all(orch, JOB_SET[:2])
+    hb_dir = str(tmp_path / "hb")
+    hb = Heartbeat(
+        ctx.stats, hb_dir, interval_s=0,
+        extra={"serve": orch.status_view},
+    ).start()
+    orch.start()
+    view = orch.run_until_idle(timeout_s=120)
+    orch.stop()
+    hb.stop()
+    assert view["schema"] == 1
+    assert view["lane_bucket"] == 2
+    for row in view["jobs"].values():
+        assert row["state"] == DONE
+        assert "ttfh_s" in row
+    # watch renders the serve section from a heartbeat record.
+    lines = [json.loads(line) for line in
+             open(os.path.join(hb_dir, "telemetry.jsonl"))]
+    final = lines[-1]
+    assert final["serve"]["counts"][DONE] == 2
+    text = render(final)
+    assert "serve lanes=2" in text
+    assert "done=2" in text
+    block = "\n".join(render_serve(final["serve"]))
+    assert "j0" in block and "tenant=acme" in block
+
+
+def test_jobview_isolation(tmp_path):
+    """A JobView shares the base's derived tables and caches but owns
+    its PRNG and registry fork; its draws never move the base stream."""
+    ctx = SearchContext(Options(seed=3))
+    before = ctx.rng_snapshot()
+    v = JobView(ctx, 1234)
+    ref = np.random.default_rng(1234)
+    assert v.next_seed() == int(ref.integers(0, 2**31, size=256)[0])
+    assert ctx.rng_snapshot() == before
+    assert v.pair_table is ctx.pair_table
+    assert v._table_cache is ctx._table_cache
+    v.stats.inc("lut5_candidates", 7)
+    assert ctx.stats.get("lut5_candidates", 0) == 0
+    ctx.stats.merge(v.stats)
+    assert ctx.stats["lut5_candidates"] == 7
