@@ -2527,12 +2527,61 @@ def _run_serve_arm(root, jobs, lanes, seed=9, retries=2):
     return wall, view, ctx.stats, orch
 
 
+def _toy_serve_files(work, n=8):
+    """The fleet toy corpus written as S-box files: 3-input searches
+    whose node sweeps make REAL device dispatches under the
+    device-routed options (the workload the merged-wave dispatch ratio
+    is measured on — same generator as the fleet bench ladder)."""
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+    paths = []
+    for i, bj in enumerate(toy_fleet_boxes(n)):
+        p = os.path.join(work, f"toy{i}.txt")
+        with open(p, "w") as f:
+            f.write(" ".join("%02x" % v for v in bj.sbox[:8]))
+        paths.append(p)
+    return paths
+
+
+def _run_serve_dev_arm(root, paths, lanes, merge, seed=9, chain_rounds=0):
+    """One device-routed serve arm (node heads dispatch instead of
+    routing native, so wave merging is measurable); returns (wall_s,
+    view, stats)."""
+    from sboxgates_tpu.resilience.deadline import DeadlineConfig
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.serve import ServeJob, ServeOrchestrator
+
+    ctx = SearchContext(Options(
+        seed=seed, lut_graph=True, randomize=False,
+        host_small_steps=False, native_engine=False, warmup=False,
+        chain_rounds=chain_rounds,
+    ))
+    orch = ServeOrchestrator(
+        ctx, root, lanes=lanes,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.05),
+        log=lambda s: None, merge=merge,
+    )
+    output = -1 if chain_rounds else 0
+    for i, p in enumerate(paths):
+        orch.submit(ServeJob(
+            job_id=f"t{i:02d}", sbox_path=p, output=output,
+            tenant=f"ten{i % 3}",
+        ))
+    t0 = time.perf_counter()
+    orch.start()
+    view = orch.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+    wall = time.perf_counter() - t0
+    orch.stop()
+    return wall, view, ctx.stats
+
+
 def bench_serve(n_jobs: int = None) -> list:
     """``bench.py --serve``: the serve-mode load generator
     (BENCH_SERVE.json).
 
-    Three arms over one synthetic multi-tenant job mix (DES S1 outputs
-    + the Crypto-1 fa filter, three tenants):
+    Five arms over synthetic multi-tenant job mixes (DES S1 outputs +
+    the Crypto-1 fa filter for the scheduling arms; the device-routed
+    toy corpus for the dispatch-ratio arms):
 
     1. ``serve_serial_t1`` — the same job set on ONE lane, measured in
        the same window: the t1 baseline (the serial loop an operator
@@ -2551,6 +2600,16 @@ def bench_serve(n_jobs: int = None) -> list:
        gates that every surviving job's final circuits are
        bit-identical to standalone runs and the poison job is
        quarantined without collateral damage.
+    4. ``serve_merged`` — the fleet-merged wave ratio: the same
+       device-routed 8-job set as one merged wave vs per-thread lanes;
+       jobs/hour, p99 ttfh, and the per-wave device-dispatch ratio
+       (structurally gated — merging engaged and at least halved the
+       dispatches; in lockstep it reaches ~1/lanes).
+    5. ``serve_chained`` — round chains stacked on the wave
+       (``Options.chain_rounds``): merged chained all-outputs jobs vs
+       per-thread one-round chains; the combined ratio approaches
+       1 / (lanes x rounds_per_dispatch) and is gated at the lane
+       factor.
     """
     import shutil
     import tempfile
@@ -2648,6 +2707,105 @@ def bench_serve(n_jobs: int = None) -> list:
             "bit_identical": bool(healthy_done and identical),
             "quarantine_isolated": bool(quarantined and healthy_done),
             "serve_quarantined": cstats.get("serve_quarantined", 0),
+        })
+        # Arm 4: the fleet-merged wave ratio — same device-routed job
+        # set, per-thread lanes vs one merged wave.  The dispatch ratio
+        # is the hardware-independent half of the claim (the PR 8/11
+        # convention): an 8-tenant same-bucket wave's sweeps collapse
+        # toward ONE dispatch per round, ~1/lanes of the per-thread
+        # arm's device_dispatches, on CPU CI and silicon alike.
+        mpaths = _toy_serve_files(work, 8)
+        uwall, uview, ustats = _run_serve_dev_arm(
+            os.path.join(work, "unmerged"), mpaths, lanes=8, merge=False,
+        )
+        mwall, mview, mstats = _run_serve_dev_arm(
+            os.path.join(work, "merged"), mpaths, lanes=8, merge=True,
+        )
+        from sboxgates_tpu.search.serve import DONE as _DONE
+
+        m_done = mview["counts"][_DONE]
+        u_done = uview["counts"][_DONE]
+        ratio = (
+            mstats.get("device_dispatches", 0)
+            / max(1, ustats.get("device_dispatches", 0))
+        )
+        mhists = mstats.histograms()
+        mttfh = mhists.get("job_time_to_first_hit_s", {})
+        uttfh = ustats.histograms().get("job_time_to_first_hit_s", {})
+        out.append({
+            "metric": "serve_merged", "jobs": 8, "lanes": 8,
+            "value": round(ratio, 4),
+            "unit": "device-dispatch ratio, merged wave vs per-thread "
+                    "lanes (same job set)",
+            "all_completed": m_done == 8 and u_done == 8,
+            # The structural gate: merging engaged AND at least halved
+            # the dispatch count (in lockstep it reaches ~1/lanes; the
+            # band absorbs retirement-skew singletons).
+            "merged_dispatches_halved": bool(
+                mstats.get("serve_merged_dispatches", 0) > 0
+                and 2 * mstats.get("device_dispatches", 0)
+                <= ustats.get("device_dispatches", 0)
+            ),
+            "merged_wall_s": round(mwall, 3),
+            "per_thread_wall_s": round(uwall, 3),
+            "jobs_per_hour_merged": round(3600.0 * m_done / mwall, 1),
+            "jobs_per_hour_per_thread": round(3600.0 * u_done / uwall, 1),
+            "p99_ttfh_s_merged": mttfh.get("p99"),
+            "p99_ttfh_s_per_thread": uttfh.get("p99"),
+            "serve_merged_dispatches": mstats.get(
+                "serve_merged_dispatches", 0
+            ),
+            "device_dispatches_merged": mstats.get("device_dispatches", 0),
+            "device_dispatches_per_thread": ustats.get(
+                "device_dispatches", 0
+            ),
+            "wave_lanes_p50": mhists.get(
+                "serve_wave_lanes", {}
+            ).get("p50"),
+        })
+        # Arm 5: round chains stacked on the wave — chained all-outputs
+        # jobs (Options.chain_rounds) in a merged wave vs the same
+        # chains per-thread at one round per dispatch: the combined
+        # ratio approaches 1 / (lanes x rounds_per_dispatch).
+        cpaths = _toy_serve_files(work, 4)
+        c1wall, c1view, c1stats = _run_serve_dev_arm(
+            os.path.join(work, "chain1"), cpaths, lanes=4, merge=False,
+            chain_rounds=1,
+        )
+        c8wall, c8view, c8stats = _run_serve_dev_arm(
+            os.path.join(work, "chain8"), cpaths, lanes=4, merge=True,
+            chain_rounds=8,
+        )
+        cratio = (
+            c8stats.get("device_dispatches", 0)
+            / max(1, c1stats.get("device_dispatches", 0))
+        )
+        out.append({
+            "metric": "serve_chained", "jobs": 4, "lanes": 4,
+            "chain_rounds": 8,
+            "value": round(cratio, 4),
+            "unit": "device-dispatch ratio, merged chained wave vs "
+                    "per-thread one-round chains (same job set)",
+            "all_completed": (
+                c8view["counts"][_DONE] == 4
+                and c1view["counts"][_DONE] == 4
+            ),
+            # lanes x rounds compose: the merged chained run must beat
+            # the per-thread per-round run by at least the lane factor.
+            "combined_ratio_ok": bool(
+                c8stats.get("serve_merged_dispatches", 0) > 0
+                and 4 * c8stats.get("device_dispatches", 0)
+                <= c1stats.get("device_dispatches", 0)
+            ),
+            "device_dispatches_chained_merged": c8stats.get(
+                "device_dispatches", 0
+            ),
+            "device_dispatches_per_round": c1stats.get(
+                "device_dispatches", 0
+            ),
+            "round_driver_rounds": c8stats.get("round_driver_rounds", 0),
+            "wall_s_merged": round(c8wall, 3),
+            "wall_s_per_round": round(c1wall, 3),
         })
     finally:
         shutil.rmtree(work, ignore_errors=True)
@@ -2892,6 +3050,15 @@ BENCH_CHECKS = {
             ("serve_load", "all_completed", 0.0, "exact"),
             ("serve_chaos", "bit_identical", 0.0, "exact"),
             ("serve_chaos", "quarantine_isolated", 0.0, "exact"),
+            # Fleet-merged waves: merging engaged and the wave's
+            # device-dispatch count at most half the per-thread arm's
+            # (structural, machine-independent — it reaches ~1/lanes in
+            # lockstep; the boolean absorbs retirement-skew noise).
+            ("serve_merged", "all_completed", 0.0, "exact"),
+            ("serve_merged", "merged_dispatches_halved", 0.0, "exact"),
+            # Chained waves: lanes x rounds_per_dispatch compose.
+            ("serve_chained", "all_completed", 0.0, "exact"),
+            ("serve_chained", "combined_ratio_ok", 0.0, "exact"),
         ],
     ),
     "hoststream": (

@@ -131,6 +131,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "seconds (default: unbounded); a breach is "
                         "raised at the job's next journal boundary "
                         "and consumes one retry")
+    p.add_argument("--serve-no-merge", action="store_true",
+                   help="disable fleet-merged serve waves: same-bucket "
+                        "tenants admitted together then run as "
+                        "independent per-job dispatch streams instead "
+                        "of one jit(vmap) dispatch per round "
+                        "(SBG_SERVE_NO_MERGE=1 is the env equivalent; "
+                        "results are bit-identical either way)")
+    p.add_argument("--chain-rounds", type=int, default=0, metavar="N",
+                   help="greedy chained-outputs driver (LUT mode, "
+                        "--iterations 1): solve the missing outputs as "
+                        "one fused round chain, up to N rounds per "
+                        "device dispatch (search/rounds.py round_driver;"
+                        " 0 = off, the default beam search).  A round "
+                        "the kernel cannot finish falls back to the "
+                        "full recursive search; circuits are "
+                        "bit-identical for every N > 0")
     p.add_argument("--pipeline-depth", type=int, default=2, metavar="N",
                    help="in-flight dispatches / prefetched chunks for the "
                         "streaming sweep drivers (default 2; 1 = serial "
@@ -254,6 +270,10 @@ JOURNAL_CONFIG_KEYS = (
     "serve_lanes",
     "serve_retries",
     "serve_timeout",
+    # Chained-outputs driver: replaces the per-output create_circuit
+    # draws with per-round seed blocks, so it shapes the draw stream
+    # and must be restored on resume.
+    "chain_rounds",
 )
 
 #: Keys added to JOURNAL_CONFIG_KEYS after a journal version shipped:
@@ -268,6 +288,7 @@ JOURNAL_KEY_DEFAULTS = {
     "serve_lanes": 4,
     "serve_retries": 2,
     "serve_timeout": None,
+    "chain_rounds": 0,
 }
 
 
@@ -423,6 +444,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _err(f"Bad serve retries value: {args.serve_retries}")
         if args.serve_timeout is not None and args.serve_timeout <= 0:
             return _err(f"Bad serve timeout value: {args.serve_timeout}")
+    if args.serve_no_merge and not args.serve:
+        return _err("--serve-no-merge requires --serve.")
+    if args.chain_rounds < 0:
+        return _err(f"Bad chain rounds value: {args.chain_rounds}")
+    if args.chain_rounds > 0:
+        # The chained-outputs driver replaces the beam search, so the
+        # flag must never be silently ignored by an incompatible mode.
+        if not args.lut:
+            return _err(
+                "--chain-rounds requires -l/--lut: the round kernel "
+                "appends LUT gates."
+            )
+        if args.iterations != 1:
+            return _err(
+                "--chain-rounds requires --iterations 1: the chain is "
+                "one greedy pass, not a restarted beam."
+            )
+        if args.single_output != -1:
+            return _err(
+                "--chain-rounds drives the all-outputs graph search; "
+                "it cannot be combined with -o."
+            )
     if args.fleet_candidates < 1:
         return _err(
             f"Bad fleet candidates value: {args.fleet_candidates}"
@@ -662,6 +705,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet=args.fleet,
         fleet_candidates=args.fleet_candidates,
         fleet_max_wave=args.fleet_max_wave,
+        chain_rounds=args.chain_rounds,
         # jaxlint: ignore[R7] telemetry is observation-only (zero-sync counter-asserted)
         trace=args.trace is not None,
         # jaxlint: ignore[R7] live-introspection endpoint; observation-only, never shapes the draw stream
@@ -1023,6 +1067,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     retries=args.serve_retries,
                 ),
                 log=log,
+                merge=False if args.serve_no_merge else None,
             )
             if status_server is not None:
                 status_server.add_provider("serve", orch.status_view)

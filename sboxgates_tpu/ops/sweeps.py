@@ -2120,3 +2120,47 @@ def round_driver(
     r_f, g_f, _, _, hits = jax.lax.while_loop(cond, body, init)
     tail = jnp.concatenate([jnp.stack([r_f, g_f]), jnp.zeros(6, jnp.int32)])
     return jnp.concatenate([hits, tail[None]], axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk3", "chunk5", "has5", "max_rounds", "solve_rows"),
+)
+def fleet_round_driver(
+    tables, binom, g0s, targets, masks, excl, seeds, dc_draws, n_rounds,
+    total5_cap, splits, w_tab, m_tab,
+    *, chunk3, chunk5, has5, max_rounds, solve_rows=1024,
+):
+    """Stacked-fleet form of :func:`round_driver`: a whole wave's greedy
+    round chains advance in ONE dispatch, the jobs axis leading every
+    per-lane operand.  Each lane carries its own device-resident table
+    array, per-round targets/masks, pre-drawn seed/don't-care blocks,
+    and hit journal (the ``while_loop`` carries vmap per lane), so up to
+    ``max_rounds`` rounds advance for EVERY lane per dispatch — the PR 8
+    fleet jobs axis composed with the PR 11 round axis, multiplying the
+    two dispatch savings.  A lane that misses (or overflows the
+    in-kernel solver) freezes at its miss round — its hit-journal tail
+    reports where it fell out of the chain, and the host driver
+    (``search.rounds.run_fleet_round_chains``) runs that lane's
+    fallback while the other lanes keep chaining.  Retired lanes ride
+    with ``n_rounds = 0``: their loop body never executes, so the lane
+    is an inert masked row.
+
+    tables: [lanes, B, W]; g0s/n_rounds: [lanes] int32; targets/masks:
+    [lanes, max_rounds, W]; seeds/dc_draws: [lanes, max_rounds] int32;
+    binom/excl/total5_cap/splits/w_tab/m_tab shared across lanes.
+    Returns int32 [lanes, max_rounds + 1, 8] — per-lane
+    :func:`round_driver` hit journals, bit-identical lane by lane to
+    the single-job kernel (vmap changes the batching, not the integer
+    math)."""
+    fn = functools.partial(
+        round_driver, chunk3=chunk3, chunk5=chunk5, has5=has5,
+        max_rounds=max_rounds, solve_rows=solve_rows,
+    )
+    return jax.vmap(
+        fn,
+        in_axes=(0, None, 0, 0, 0, None, 0, 0, 0, None, None, None, None),
+    )(
+        tables, binom, g0s, targets, masks, excl, seeds, dc_draws,
+        n_rounds, total5_cap, splits, w_tab, m_tab,
+    )

@@ -38,7 +38,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 from ..telemetry import flight as _tflight
 from ..telemetry import metrics as _tmetrics
@@ -150,6 +150,8 @@ def dispatch_with_retry(
     label: str = "",
     on_retry: Optional[Callable[[], None]] = None,
     site: str = "dispatch.sweep",
+    lanes: Sequence[str] = (),
+    flight_reason: str = "deadline_exhausted",
 ):
     """One guarded device-sweep resolve: deadline, retry, backoff.
 
@@ -164,9 +166,13 @@ def dispatch_with_retry(
     final :class:`DispatchTimeout` propagates so the caller can degrade
     to its host-fallback path.
 
+    ``lanes`` (the :func:`wave_dispatch_with_retry` form) attributes the
+    window to a merged wave's lanes: breaches log/trace/flight-dump the
+    lane list and the final :class:`DispatchTimeout` names every lane.
     ``cfg=None`` (or a disabled config) short-circuits to an inline call
     — zero threads, zero overhead beyond the fault-site lookup.
     """
+    lane_tag = f" lanes={list(lanes)}" if lanes else ""
 
     def attempt():
         fault_point(site)
@@ -180,25 +186,57 @@ def dispatch_with_retry(
             return run_with_deadline(attempt, cfg.budget_s, label)
         except DispatchTimeout as e:
             _bump(stats, "deadline_breaches")
-            _ttrace.instant("deadline.breach", "deadline",
-                            label=label, attempt=k)
+            _ttrace.instant("deadline.breach", "deadline", label=label,
+                            attempt=k,
+                            **({"lanes": list(lanes)} if lanes else {}))
             if k == cfg.retries:
                 logger.warning(
-                    "%s; %d retr%s exhausted", e, cfg.retries,
+                    "%s;%s %d retr%s exhausted", e, lane_tag, cfg.retries,
                     "y" if cfg.retries == 1 else "ies",
                 )
                 _flight_exhausted(
-                    "deadline_exhausted", stats, label, cfg.retries + 1
+                    flight_reason, stats, f"{label}{lane_tag}",
+                    cfg.retries + 1,
                 )
+                if lanes:
+                    raise DispatchTimeout(f"{e}{lane_tag}") from None
                 raise
             _bump(stats, "dispatch_retries")
-            logger.warning("%s; retry %d/%d in %.2fs", e, k + 1,
-                           cfg.retries, delay)
+            logger.warning("%s;%s retry %d/%d in %.2fs", e, lane_tag,
+                           k + 1, cfg.retries, delay)
             time.sleep(delay)
             delay *= 2
             if on_retry is not None:
                 on_retry()
     raise AssertionError("unreachable")
+
+
+def wave_dispatch_with_retry(
+    fn: Callable,
+    cfg: Optional[DeadlineConfig],
+    stats: Optional[dict] = None,
+    label: str = "",
+    lanes: Sequence[str] = (),
+    on_retry: Optional[Callable[[], None]] = None,
+):
+    """One guarded window for a WHOLE merged fleet/serve wave dispatch.
+
+    A merged wave resolve carries every lane's sweep in one device call,
+    so guarding it lane-by-lane is impossible (there is one RPC) and
+    guarding it per-submitter would park one abandonable worker per lane
+    on the same corpse.  This is :func:`dispatch_with_retry`'s schedule
+    applied to the single merged resolve, with the breach attributed to
+    every lane riding the window: the raised :class:`DispatchTimeout`
+    names the lanes (the per-lane drivers receiving it degrade/fail
+    individually, which is where per-job retry/quarantine policy
+    applies), the ``deadline.breach`` trace instant carries the lane
+    list, and the exhaustion flight dump records it.  Counters: one
+    ``deadline_breaches`` per breached window (the window IS the
+    dispatch), ``dispatch_retries`` per re-issue."""
+    return dispatch_with_retry(
+        fn, cfg, stats=stats, label=label, on_retry=on_retry,
+        lanes=lanes, flight_reason="wave_deadline_exhausted",
+    )
 
 
 def verdict_transport_timeout(budget_s: float) -> float:

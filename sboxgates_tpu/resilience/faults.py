@@ -23,6 +23,7 @@ the ones production code fires today):
 ``serve.preempt``         a serve job's journal-boundary control point
 ``serve.requeue``         requeuing a preempted/failed serve job
 ``serve.drain``           entering a serve-mode graceful drain
+``serve.wave``            a lane entering its merged serve wave
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -88,6 +89,7 @@ KNOWN_SITES = (
     "serve.preempt",
     "serve.requeue",
     "serve.drain",
+    "serve.wave",
 )
 
 

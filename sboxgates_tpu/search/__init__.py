@@ -1,7 +1,7 @@
 from . import warmup  # noqa: F401
 from .context import Options, SearchContext  # noqa: F401
 from .kwan import create_circuit  # noqa: F401
-from .rounds import run_round_chain  # noqa: F401
+from .rounds import run_fleet_round_chains, run_round_chain  # noqa: F401
 from .lut import lut_search  # noqa: F401
 from .multibox import (  # noqa: F401
     BoxJob,

@@ -110,15 +110,18 @@ class Rendezvous:
             declared=None,
         )
 
-    def submit(self, key, kernel: Callable, args, shared=(), g=None) -> np.ndarray:
+    def submit(self, key, kernel: Callable, args, shared=(), g=None,
+               label=None) -> np.ndarray:
         """``shared``: indices of args that are identical across restarts
         for this key (match tables, combo grids, ...) — mapped with
         in_axes=None instead of being stacked R-way.  ``g`` is the
         submitting state's gate count (fleet warm-bucket detection; the
-        base rendezvous ignores it)."""
+        base rendezvous ignores it).  ``label`` names the submitting
+        lane (a serve job id) for wave-level breach attribution."""
         entry = {
             "key": key, "kernel": kernel, "args": args,
             "shared": tuple(shared), "done": False, "g": g,
+            "label": label,
         }
         with self.cv:
             self.stats.inc("submits")

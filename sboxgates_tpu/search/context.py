@@ -225,6 +225,20 @@ class Options:
     # this SHAPES THE DRAW STREAM: it is journaled and restored by
     # --resume-run, like the other execution-mode flags.
     fleet_max_wave: int = 256
+    # Greedy chained-outputs driver (--chain-rounds, search/rounds.py):
+    # when > 0 (LUT mode, iterations == 1), the multi-output search
+    # solves its missing outputs as ONE fused round chain over a single
+    # growing graph — up to this many rounds advance per round_driver
+    # dispatch, and rounds the kernel cannot finish fall back to the
+    # full recursive search.  This is a DIFFERENT driver from the beam
+    # search (greedy output order, no beam), so it is opt-in; it SHAPES
+    # THE DRAW STREAM (per-round seed blocks replace the per-output
+    # create_circuit draws) and is journaled like the other
+    # execution-mode flags.  Circuits are bit-identical for every value
+    # > 0 (the PR 11 window-split invariance), and under a merged serve
+    # wave the chain windows stack on the fleet jobs axis — dispatches
+    # per round drop toward 1/(lanes x chain_rounds).
+    chain_rounds: int = 0
     # Structured tracing (--trace, telemetry.trace): every dispatch,
     # compile, warmup build, rendezvous merge, deadline window, and
     # journal write becomes a span in the process tracer, exportable as
@@ -1309,7 +1323,8 @@ class SearchContext:
         if self.rdv is not None and self.rdv.live > 1:
             key = _warmup.warm_key(name, statics, args)
             return self.rdv.submit(
-                key, _warmup.kernel(name, statics), args, shared, g=g
+                key, _warmup.kernel(name, statics), args, shared, g=g,
+                label=getattr(self, "dispatch_label", None),
             )
         return np.asarray(self.kernel_call(name, statics, args, g=g))
 
@@ -1350,7 +1365,8 @@ class SearchContext:
         if self._merge_streams():
             key = _warmup.warm_key(name, statics, args)
             return self.rdv.submit(
-                key, _warmup.kernel(name, statics), args, shared, g=g
+                key, _warmup.kernel(name, statics), args, shared, g=g,
+                label=getattr(self, "dispatch_label", None),
             )
         return self.kernel_call(name, statics, args, g=g)
 

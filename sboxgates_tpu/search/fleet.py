@@ -186,10 +186,23 @@ class FleetRendezvous(Rendezvous):
     # node-head buckets.
     merges_streams = True
 
-    def __init__(self, n_threads: int, plan=None, warmer=None):
+    def __init__(self, n_threads: int, plan=None, warmer=None,
+                 deadline=None, deadline_stats=None):
+        """``deadline`` (a resilience.deadline.DeadlineConfig) arms the
+        WAVE guard: each merged group's blocking resolve runs under ONE
+        deadline window for the whole wave
+        (``wave_dispatch_with_retry``) — one abandonable worker per
+        wave dispatch instead of one per lane, with the breach
+        attributed to every lane riding the window (the serve
+        orchestrator's per-job failure policy then applies lane by
+        lane).  ``deadline_stats`` receives the breach/retry counters
+        (normally the base context's declared registry — the private
+        rendezvous registry is not folded for deadline keys)."""
         super().__init__(n_threads)
         self.plan = plan
         self.warmer = warmer
+        self.deadline = deadline
+        self.deadline_stats = deadline_stats
         self.stats.ensure(
             "fleet_dispatches",
             "fleet_singletons",
@@ -199,19 +212,51 @@ class FleetRendezvous(Rendezvous):
             "fleet_lanes",
         )
 
+    def _resolve_guarded(self, out, issue, label, lane_labels):
+        """Blocking resolve of one group's non-pytree output under the
+        WAVE deadline guard (one window per group dispatch, breach
+        attributed to every lane riding it) — or a bare sync when no
+        budget is armed.  Shared by the merged and singleton branches:
+        a hung RPC must breach either way, or one desynced lane's
+        singleton resolve could still hang the whole rendezvous."""
+        cfg = self.deadline
+        if cfg is None or not getattr(cfg, "enabled", False):
+            return np.asarray(out)
+        from ..resilience import deadline as _deadline
+
+        box = {"out": out}
+        return _deadline.wave_dispatch_with_retry(
+            lambda: np.asarray(box["out"]),
+            cfg,
+            stats=(self.deadline_stats
+                   if self.deadline_stats is not None else self.stats),
+            label=label,
+            lanes=lane_labels,
+            on_retry=lambda: box.update(out=issue()),
+        )
+
     def _run_group(self, key, entries) -> None:
         n = len(entries)
         if n == 1:
             e = entries[0]
-            # Fleet singletons ARE device dispatches (fleet_stats_into
-            # folds them into device_dispatches), so the span category
-            # is "dispatch" — span count and counter stay reconciled.
-            with _ttrace.span(f"fleet[{key[0]}]", "dispatch",
-                              lanes=1, g=e.get("g")):
-                out = e["kernel"](*e["args"])
-            e["result"] = (
-                out if isinstance(out, tuple) else np.asarray(out)
-            )
+
+            def issue():
+                # Fleet singletons ARE device dispatches
+                # (fleet_stats_into folds them into device_dispatches),
+                # so the span category is "dispatch" — span count and
+                # counter stay reconciled.
+                with _ttrace.span(f"fleet[{key[0]}]", "dispatch",
+                                  lanes=1, g=e.get("g")):
+                    return e["kernel"](*e["args"])
+
+            out = issue()
+            if isinstance(out, tuple):
+                e["result"] = out
+            else:
+                e["result"] = self._resolve_guarded(
+                    out, issue, f"fleet[{key[0]}]",
+                    [e.get("label") or "lane0"],
+                )
             self.stats.inc("fleet_singletons")
             return
         name, statics = key[0], dict(key[1])
@@ -265,29 +310,32 @@ class FleetRendezvous(Rendezvous):
                 if not hasattr(vals[0], "shape"):
                     vals = [np.int32(v) for v in vals]
                 flat.extend(vals)
-        compiled = None
-        if self.warmer is not None:
-            compiled = self.warmer.lookup_key(_warmup.fleet_warm_key(
-                name, statics, shared, lanes, flat, mesh, stacked=stacked
-            ))
-        out = None
-        # One merged fleet group = one device dispatch = one "dispatch"
-        # span (the trace makes the O(N)->O(1) merging visible: N
-        # submits collapse into this span's `merged` lanes).
-        with _ttrace.span(f"fleet[{name}]", "dispatch", lanes=lanes,
-                          merged=n, stacked=stacked, g=gmax) as sp:
-            if compiled is not None:
-                try:
-                    out = compiled(*flat)
-                    self.stats.inc("fleet_warm_hits")
-                    sp.set(warm="hit")
-                except (TypeError, ValueError):
-                    # Aval drift raises TypeError, a sharding mismatch
-                    # from the AOT Compiled call raises ValueError; the
-                    # lazy path below is always correct either way, and
-                    # the parity test keeps this at zero.
-                    self.warmer.count("warm_aval_mismatches")
-            if out is None:
+        def issue():
+            compiled = None
+            if self.warmer is not None:
+                compiled = self.warmer.lookup_key(_warmup.fleet_warm_key(
+                    name, statics, shared, lanes, flat, mesh,
+                    stacked=stacked,
+                ))
+            # One merged fleet group = one device dispatch = one
+            # "dispatch" span (the trace makes the O(N)->O(1) merging
+            # visible: N submits collapse into this span's `merged`
+            # lanes).
+            with _ttrace.span(f"fleet[{name}]", "dispatch", lanes=lanes,
+                              merged=n, stacked=stacked, g=gmax) as sp:
+                if compiled is not None:
+                    try:
+                        out = compiled(*flat)
+                        self.stats.inc("fleet_warm_hits")
+                        sp.set(warm="hit")
+                        return out
+                    except (TypeError, ValueError):
+                        # Aval drift raises TypeError, a sharding
+                        # mismatch from the AOT Compiled call raises
+                        # ValueError; the lazy path below is always
+                        # correct either way, and the parity test keeps
+                        # this at zero.
+                        self.warmer.count("warm_aval_mismatches")
                 fn = _warmup.fleet_kernel(
                     name, statics, shared, nargs, lanes, mesh,
                     stacked=stacked,
@@ -295,13 +343,25 @@ class FleetRendezvous(Rendezvous):
                 out = fn(*flat)
                 self.stats.inc("fleet_warm_misses")
                 sp.set(warm="miss")
+                return out
+
+        out = issue()
         if isinstance(out, tuple):
             # Pytree output: per-lane device slices (lazy; callers sync
             # their compact verdict element only).
             for r, e in enumerate(entries):
                 e["result"] = tuple(o[r] for o in out)
         else:
-            out = np.asarray(out)
+            # Wave guard: ONE deadline window for the whole merged
+            # resolve (the dispatch is one RPC however many lanes ride
+            # it); a breach re-issues the wave's dispatch, and
+            # exhaustion raises to EVERY lane with the lane list
+            # attributed in the message/trace/flight dump.
+            out = self._resolve_guarded(
+                out, issue, f"fleet[{name}]",
+                [e.get("label") or f"lane{r}"
+                 for r, e in enumerate(entries)],
+            )
             for r, e in enumerate(entries):
                 e["result"] = out[r]
         self.stats.inc("fleet_dispatches")
@@ -361,7 +421,12 @@ def _run_fleet_wave(ctx, jobs: List[tuple]) -> List[tuple]:
             "split into waves"
         )
     rdv = FleetRendezvous(
-        n, plan=ctx.fleet_plan, warmer=ctx.warmer
+        n, plan=ctx.fleet_plan, warmer=ctx.warmer,
+        # Merged resolves run under ONE wave deadline window (a hung
+        # RPC would otherwise block the resolving lane inside the
+        # rendezvous forever, with every other lane parked in submit —
+        # the per-job guards cannot see a merged resolve).
+        deadline=ctx.deadline_cfg, deadline_stats=ctx.stats,
     )
     seeds = [int(s) for s in ctx.rng.integers(0, 2**31, size=n)]
     results: List[Optional[tuple]] = [None] * n

@@ -168,6 +168,13 @@ def _create_circuit(
 
     if (
         ctx.rdv is not None
+        # A merged serve-wave JobView carries the wave rendezvous even
+        # where a fresh context would have none (CPU): such a view sets
+        # allow_mux_threads=False so the mux stays on the serial branch
+        # — ctx's own PRNG, standalone draw order — and bit-identity to
+        # the standalone run survives; the serial branches' sweeps still
+        # merge ACROSS wave lanes through the rendezvous.
+        and getattr(ctx, "allow_mux_threads", True)
         and len(bit_order) > 1
         and not ctx.node_host_only(st)
     ):

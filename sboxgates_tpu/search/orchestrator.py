@@ -190,6 +190,20 @@ def generate_graph(
     opt = ctx.opt
     num_outputs = sbox_num_outputs(targets)
     mask = tt.mask_table(st.num_inputs)
+    if opt.chain_rounds > 0 and opt.iterations == 1 and opt.lut_graph:
+        # Greedy chained-outputs driver (--chain-rounds): the remaining
+        # outputs solve as ONE fused round chain over a single growing
+        # graph — the leaf-heavy regime where most outputs need one
+        # gate, so up to chain_rounds outputs complete per device
+        # dispatch (and under a merged serve wave the windows stack on
+        # the fleet jobs axis too).  Different semantics from the beam
+        # search (greedy output order, width-1 "beam"), which is why it
+        # is opt-in; bit-identical for every chain_rounds value, and
+        # journal/resume ride run_round_chain's chain_round records.
+        return _generate_graph_chained(
+            ctx, st, targets, num_outputs, mask, save_dir=save_dir,
+            log=log, journal=journal,
+        )
     start_states = [st]
     rnd = 0
     if journal is not None:
@@ -285,6 +299,41 @@ def generate_graph(
             "run_done", beam=[state_filename(s) for s in start_states]
         )
     return start_states
+
+
+def _generate_graph_chained(
+    ctx, st, targets, num_outputs: int, mask,
+    save_dir: Optional[str] = ".",
+    log: Callable[[str], None] = print,
+    journal=None,
+) -> List[State]:
+    """The ``Options.chain_rounds`` driver behind :func:`generate_graph`:
+    every missing output, in output order, as one greedy fused round
+    chain (:func:`sboxgates_tpu.search.rounds.run_round_chain`) over ONE
+    growing graph.  Rounds the round kernel cannot finish fall back to
+    the full recursive search for that output only.  Returns the single
+    final state (the chain's "beam")."""
+    from .rounds import run_round_chain
+
+    missing = [o for o in range(num_outputs) if st.outputs[o] == NO_GATE]
+    log(
+        f"Chaining {len(missing)} output"
+        f"{'' if len(missing) == 1 else 's'} "
+        f"({ctx.opt.chain_rounds} rounds/dispatch)..."
+    )
+    rounds = [(targets[o], mask) for o in missing]
+    outs = run_round_chain(
+        ctx, st, rounds, rounds_per_dispatch=ctx.opt.chain_rounds,
+        journal=journal,
+    )
+    for o, gid in zip(missing, outs):
+        st.outputs[o] = gid
+    log(f"Chained graph complete: {st.num_gates - st.num_inputs} gates.")
+    if save_dir is not None:
+        save_state(st, save_dir)
+    if journal is not None:
+        journal.append("run_done", beam=[state_filename(st)])
+    return [st]
 
 
 def _round_checkpoint(ctx, journal, rnd: int, beam_states, save_dir) -> None:

@@ -41,6 +41,7 @@ from ..ops import combinatorics as comb
 from ..ops import sweeps
 from ..resilience.deadline import DispatchTimeout
 from ..telemetry import trace as _ttrace
+from . import warmup as _warmup
 from .context import (
     BUCKETS,
     LUT5_HEAD_SOLVE_ROWS,
@@ -137,6 +138,55 @@ def _default_fallback(ctx: SearchContext, st: State, target, mask) -> int:
     return create_circuit(ctx, st, target, mask, [])
 
 
+def _chain_resume(ctx: SearchContext, st: State, rounds, journal):
+    """The chain drivers' shared resume-or-init preamble: replays any
+    journaled ``chain_round`` records onto the state, restores the PRNG
+    position, and restores — or draws and journals — the per-round
+    seed/fill block.  ONE implementation for :func:`run_round_chain`
+    and :func:`run_fleet_round_chains`, because the semantics are
+    subtle (a run killed after the block draw but before any round
+    completed must resume from the post-draw position recorded WITH the
+    block — no chain_round record restored the PRNG, and a fresh rng
+    would shift every later draw) and a divergence between the two
+    drivers would silently break their per-lane bit-identity contract.
+
+    Returns ``(outs, r, base, seeds, dcs)``."""
+    outs: List[int] = []
+    r = 0
+    blk = None
+    if journal is not None:
+        blk = journal.last("chain_seeds")
+        recs = journal.of_type("chain_round")
+        for rec in recs:
+            tgt, msk = rounds[rec["round"]]
+            for t, i1, i2, i3, fn in rec["gates"]:
+                st.replay_gate(t, i1, i2, i3, fn)
+            st.verify_gate(rec["out"], tgt, msk)
+            outs.append(rec["out"])
+        if recs:
+            ctx.rng_restore(recs[-1]["rng"])
+            r = recs[-1]["round"] + 1
+    if blk is not None:
+        # Resume: the per-round seed/fill block was drawn — and consumed
+        # from the PRNG — by the original run; re-drawing from the
+        # restored position would shift every remaining round's stream.
+        base = int(blk["base"])
+        seeds = np.asarray(blk["seeds"], np.int32)
+        dcs = np.asarray(blk["dcs"], np.int32)
+        if not outs:
+            ctx.rng_restore(blk["rng"])
+    else:
+        base = r
+        seeds, dcs = _draw_round_block(ctx, len(rounds) - r)
+        if journal is not None:
+            journal.append(
+                "chain_seeds", base=base,
+                seeds=[int(x) for x in seeds], dcs=[int(x) for x in dcs],
+                rng=ctx.rng_snapshot(),
+            )
+    return outs, r, base, seeds, dcs
+
+
 def run_round_chain(
     ctx: SearchContext,
     st: State,
@@ -168,44 +218,13 @@ def run_round_chain(
     # heights pad to it, so a larger request would overrun the window
     # arrays (N is "configurable", not unbounded).
     n_per = max(1, min(int(rounds_per_dispatch), ROUND_BUCKETS[-1]))
-    outs: List[int] = []
-    r = 0
-    blk = None
-    if journal is not None:
-        blk = journal.last("chain_seeds")
-        recs = journal.of_type("chain_round")
-        for rec in recs:
-            tgt, msk = rounds[rec["round"]]
-            for t, i1, i2, i3, fn in rec["gates"]:
-                st.replay_gate(t, i1, i2, i3, fn)
-            st.verify_gate(rec["out"], tgt, msk)
-            outs.append(rec["out"])
-        if recs:
-            ctx.rng_restore(recs[-1]["rng"])
-            r = recs[-1]["round"] + 1
-
-    if blk is not None:
-        # Resume: the per-round seed/fill block was drawn — and consumed
-        # from the PRNG — by the original run; re-drawing from the
-        # restored position would shift every remaining round's stream.
-        base = int(blk["base"])
-        seeds = np.asarray(blk["seeds"], np.int32)
-        dcs = np.asarray(blk["dcs"], np.int32)
-        if not outs:
-            # Killed after the block draw but before any round
-            # completed: no chain_round record restored the PRNG, so the
-            # post-draw position recorded WITH the block is the resume
-            # point (a fresh rng here would shift every later draw).
-            ctx.rng_restore(blk["rng"])
-    else:
-        base = r
-        seeds, dcs = _draw_round_block(ctx, len(rounds) - r)
-        if journal is not None:
-            journal.append(
-                "chain_seeds", base=base,
-                seeds=[int(x) for x in seeds], dcs=[int(x) for x in dcs],
-                rng=ctx.rng_snapshot(),
-            )
+    # ONE per-job chain frame (_ChainLane) owns the journal records and
+    # the host-fallback protocol for BOTH drivers — run_fleet_round_chains
+    # drives many of these in lockstep, so the write side of the
+    # journal/bit-identity contract has a single implementation.
+    frame = _ChainLane(ctx, st, rounds, journal=journal, fallback=fallback)
+    (frame.outs, frame.r, frame.base, frame.seeds,
+     frame.dcs) = _chain_resume(ctx, st, rounds, journal)
     splits, w_tab, m_tab = sweeps.lut5_split_tables()
     jsplits = ctx.place_replicated(splits)
     jw = ctx.place_replicated(w_tab)
@@ -213,24 +232,8 @@ def run_round_chain(
     jexcl = ctx.place_replicated(SearchContext.excl_array([]))
     degraded = ctx.device_degraded
 
-    def record(rnd: int, out: int, g_from: int) -> None:
-        outs.append(out)
-        if journal is not None:
-            journal.append(
-                "chain_round", round=rnd, out=out,
-                gates=_gate_rows(st, g_from), rng=ctx.rng_snapshot(),
-            )
-
-    def host_round(rnd: int) -> None:
-        target, mask = rounds[rnd]
-        g_from = st.num_gates
-        ctx.stats.inc("round_driver_fallbacks")
-        out = (fallback or _default_fallback)(ctx, st, target, mask)
-        if out == NO_GATE:
-            raise RuntimeError(f"round {rnd}: no circuit found")
-        record(rnd, out, g_from)
-
-    while r < len(rounds):
+    while frame.remaining > 0:
+        r = frame.r
         if (
             degraded
             or ctx.device_degraded
@@ -239,11 +242,10 @@ def run_round_chain(
             # it can match an existing gate or add the one final row.
             or st.num_gates + 2 > BUCKETS[-1]
         ):
-            host_round(r)
-            r += 1
+            frame.host_round()
             continue
         g = st.num_gates
-        want = min(n_per, len(rounds) - r)
+        want = min(n_per, frame.remaining)
         b, n = _chain_bucket(g, want)
         rb = round_bucket(n)
         targets = np.zeros((rb, 8), np.uint32)
@@ -253,52 +255,73 @@ def run_round_chain(
             masks[i] = np.asarray(rounds[r + i][1], np.uint32)
         wseeds = np.zeros(rb, np.int32)
         wdcs = np.zeros(rb, np.int32)
-        wseeds[:n] = seeds[r - base : r - base + n]
-        wdcs[:n] = dcs[r - base : r - base + n]
+        lo = r - frame.base
+        wseeds[:n] = frame.seeds[lo : lo + n]
+        wdcs[:n] = frame.dcs[lo : lo + n]
         padded = np.zeros((b, 8), np.uint32)
         padded[:g] = st.live_tables()
         chunk3 = pick_chunk(comb.n_choose_k(b, 3), STREAM_CHUNK[3])
         chunk5 = pick_chunk(PIVOT_MIN_TOTAL, STREAM_CHUNK[5])
         ckey = threading.get_ident()
-
-        def issue():
-            return ctx.kernel_call(
-                "round_driver",
-                dict(
-                    chunk3=chunk3, chunk5=chunk5, has5=True, max_rounds=rb,
-                    solve_rows=LUT5_HEAD_SOLVE_ROWS,
-                ),
-                (
-                    ctx.place_replicated(padded), ctx.binom, g,
-                    ctx.place_replicated(targets),
-                    ctx.place_replicated(masks), jexcl,
-                    ctx.place_replicated(wseeds),
-                    ctx.place_replicated(wdcs), n, PIVOT_MIN_TOTAL,
-                    jsplits, jw, jm,
-                ),
-                g=g,
+        statics = dict(
+            chunk3=chunk3, chunk5=chunk5, has5=True, max_rounds=rb,
+            solve_rows=LUT5_HEAD_SOLVE_ROWS,
+        )
+        window_args = (
+            ctx.place_replicated(padded), ctx.binom, g,
+            ctx.place_replicated(targets),
+            ctx.place_replicated(masks), jexcl,
+            ctx.place_replicated(wseeds),
+            ctx.place_replicated(wdcs), n, PIVOT_MIN_TOTAL,
+            jsplits, jw, jm,
+        )
+        merged = ctx._merge_streams()
+        if ctx.warmer is not None:
+            ctx.warmer.note_chain(
+                g, ctx.rdv.live if merged else 1, n_per
             )
-
-        try:
-            with _ttrace.span("round_driver", "round", rounds=n, g=g):
-                pending = {"out": issue()}
-                hits = ctx.guarded_dispatch(
-                    # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused window — the sync this driver exists to amortize
-                    lambda: np.asarray(ctx.sync_verdict(
-                        "round_driver", pending["out"], consumer=ckey
-                    )),
-                    "round_driver",
-                    on_retry=lambda: pending.update(out=issue()),
+        if merged:
+            # Merged wave window: this chain's round_driver window
+            # rendezvouses with the other wave lanes' windows into ONE
+            # jit(vmap) dispatch (the fleet jobs axis composed with the
+            # round axis — dispatches per round drop toward
+            # 1/(lanes x rounds_per_dispatch)).  The lane slice comes
+            # back host-resident, so no separate verdict sync; per-lane
+            # results are bit-identical to the direct window
+            # (_merge_streams is off under a deadline budget, so the
+            # guarded path below still owns that configuration).
+            with _ttrace.span("round_driver", "round", rounds=n, g=g,
+                              merged=True):
+                hits = np.asarray(ctx.stream_dispatch(
+                    "round_driver", statics, window_args,
+                    shared=_warmup.FLEET_SHARED["round_driver"], g=g,
+                ))
+        else:
+            def issue():
+                return ctx.kernel_call(
+                    "round_driver", statics, window_args, g=g,
                 )
-        except DispatchTimeout as e:
-            import logging
 
-            logging.getLogger(__name__).warning(
-                "%s; degrading the round chain to the host fallback", e
-            )
-            ctx.trip_device_breaker()
-            degraded = True
-            continue
+            try:
+                with _ttrace.span("round_driver", "round", rounds=n, g=g):
+                    pending = {"out": issue()}
+                    hits = ctx.guarded_dispatch(
+                        # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused window — the sync this driver exists to amortize
+                        lambda: np.asarray(ctx.sync_verdict(
+                            "round_driver", pending["out"], consumer=ckey
+                        )),
+                        "round_driver",
+                        on_retry=lambda: pending.update(out=issue()),
+                    )
+            except DispatchTimeout as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s; degrading the round chain to the host fallback", e
+                )
+                ctx.trip_device_breaker()
+                degraded = True
+                continue
 
         rounds_done = int(hits[rb, 0])
         ctx.stats.inc("round_driver_rounds", rounds_done)
@@ -311,12 +334,236 @@ def run_round_chain(
             target, mask = rounds[r + i]
             g_from = st.num_gates
             out = _replay_round(ctx, st, hits[i], target, mask)
-            record(r + i, out, g_from)
-        r += rounds_done
+            frame.record(r + i, out, g_from)
+        frame.r += rounds_done
         if rounds_done < n:
-            # The kernel froze on round r: miss or in-kernel solver
-            # overflow — either way the full recursive search owns it.
-            host_round(r)
-            r += 1
+            # The kernel froze on the next round: miss or in-kernel
+            # solver overflow — either way the full recursive search
+            # owns it.
+            frame.host_round()
     assert st.num_gates == len(st.gates)
-    return outs
+    return frame.outs
+
+
+class _ChainLane:
+    """One per-job chain frame: the context view (PRNG + stats), the
+    growing state, the round list, and the journal, with the ONE
+    implementation of the ``chain_round`` record format and the
+    host-fallback protocol.  :func:`run_round_chain` drives a single
+    frame; :func:`run_fleet_round_chains` drives a wave of them in
+    lockstep — sharing the write side is what keeps a lane's circuit,
+    PRNG draws, and journal byte-identical between the two drivers."""
+
+    def __init__(self, ctx, st, rounds, journal=None, fallback=None):
+        self.ctx = ctx
+        self.st = st
+        self.rounds = list(rounds)
+        self.journal = journal
+        self.fallback = fallback
+        self.outs: List[int] = []
+        self.r = 0
+        self.base = 0
+        self.seeds = None
+        self.dcs = None
+
+    @property
+    def remaining(self) -> int:
+        return len(self.rounds) - self.r
+
+    def record(self, rnd: int, out: int, g_from: int) -> None:
+        self.outs.append(out)
+        if self.journal is not None:
+            self.journal.append(
+                "chain_round", round=rnd, out=out,
+                gates=_gate_rows(self.st, g_from),
+                rng=self.ctx.rng_snapshot(),
+            )
+
+    def host_round(self) -> None:
+        target, mask = self.rounds[self.r]
+        g_from = self.st.num_gates
+        self.ctx.stats.inc("round_driver_fallbacks")
+        out = (self.fallback or _default_fallback)(
+            self.ctx, self.st, target, mask
+        )
+        if out == NO_GATE:
+            raise RuntimeError(f"round {self.r}: no circuit found")
+        self.record(self.r, out, g_from)
+        self.r += 1
+
+
+def run_fleet_round_chains(
+    ctx: SearchContext,
+    lanes: Sequence[tuple],
+    *,
+    rounds_per_dispatch: int = 8,
+    journals: Optional[Sequence] = None,
+    fallback: Optional[Callable] = None,
+) -> List[List[int]]:
+    """Lockstep fleet form of :func:`run_round_chain`: a wave of
+    independent greedy chains advances through ONE
+    ``fleet_round_driver`` dispatch per window — up to
+    ``rounds_per_dispatch`` rounds for EVERY lane, so an L-lane wave's
+    per-round dispatches drop toward ``1 / (L x rounds_per_dispatch)``
+    (the PR 8 jobs axis multiplied by the PR 11 round axis).
+
+    ``lanes``: ``[(lane_ctx, state, rounds)]`` — each lane owns its
+    context view (PRNG stream, stats fork), its state, and its
+    ``[(target, mask), ...]`` chain; ``journals`` (optional, per lane)
+    follow :func:`run_round_chain`'s contract.  Per-lane circuits, PRNG
+    draws, and journals are byte-identical to running that lane through
+    :func:`run_round_chain` alone: per-lane seed/fill blocks are drawn
+    from the LANE's PRNG in one block per chain segment, the vmapped
+    kernel's per-lane integer math equals the single-job kernel's, and
+    window results are bucket/chunk/split independent (the PR 11
+    contract), so the shared lockstep window shapes cannot perturb a
+    lane.  A lane that misses falls out of the chain into ITS fallback
+    (default: the full recursive search on the lane's view) while the
+    other lanes keep chaining; retired lanes ride as inert
+    ``n_rounds = 0`` rows.  The window resolve runs under ONE guarded
+    deadline window for the whole wave; exhaustion trips the breaker
+    and every lane completes host-side.
+
+    Returns the per-lane output-gate-id lists, in lane order."""
+    from .fleet import fleet_bucket
+
+    n_per = max(1, min(int(rounds_per_dispatch), ROUND_BUCKETS[-1]))
+    frames: List[_ChainLane] = []
+    for i, (lctx, st, rounds) in enumerate(lanes):
+        jr = journals[i] if journals is not None else None
+        lane = _ChainLane(lctx, st, rounds, journal=jr, fallback=fallback)
+        (lane.outs, lane.r, lane.base, lane.seeds,
+         lane.dcs) = _chain_resume(lctx, st, lane.rounds, jr)
+        frames.append(lane)
+
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    jsplits = ctx.place_replicated(splits)
+    jw = ctx.place_replicated(w_tab)
+    jm = ctx.place_replicated(m_tab)
+    jexcl = ctx.place_replicated(SearchContext.excl_array([]))
+    lanes_bucket = fleet_bucket(len(frames))
+    degraded = ctx.device_degraded
+
+    while True:
+        live = [f for f in frames if f.remaining > 0]
+        if not live:
+            break
+        if degraded or ctx.device_degraded:
+            for f in live:
+                while f.remaining > 0:
+                    f.host_round()
+            break
+        # Lanes past the append capacity complete host-side this round
+        # (the host search can still match an existing gate or add the
+        # one final row); the wave keeps chaining without them.
+        capped = [
+            f for f in live if f.st.num_gates + 2 > BUCKETS[-1]
+        ]
+        for f in capped:
+            f.host_round()
+        live = [f for f in live if f not in capped]
+        if not live:
+            continue
+        gmax = max(f.st.num_gates for f in live)
+        want = min(n_per, max(f.remaining for f in live))
+        b, n = _chain_bucket(gmax, want)
+        rb = round_bucket(n)
+        tables_s = np.zeros((lanes_bucket, b, 8), np.uint32)
+        g0s = np.zeros(lanes_bucket, np.int32)
+        n_rounds = np.zeros(lanes_bucket, np.int32)
+        targets = np.zeros((lanes_bucket, rb, 8), np.uint32)
+        masks = np.zeros((lanes_bucket, rb, 8), np.uint32)
+        wseeds = np.zeros((lanes_bucket, rb), np.int32)
+        wdcs = np.zeros((lanes_bucket, rb), np.int32)
+        window: List[Tuple[int, _ChainLane, int]] = []
+        for f in live:
+            i = frames.index(f)
+            g_i = f.st.num_gates
+            n_i = min(n, f.remaining)
+            tables_s[i, :g_i] = f.st.live_tables()
+            g0s[i] = g_i
+            n_rounds[i] = n_i
+            for k in range(n_i):
+                targets[i, k] = np.asarray(
+                    f.rounds[f.r + k][0], np.uint32
+                )
+                masks[i, k] = np.asarray(f.rounds[f.r + k][1], np.uint32)
+            lo = f.r - f.base
+            wseeds[i, :n_i] = f.seeds[lo : lo + n_i]
+            wdcs[i, :n_i] = f.dcs[lo : lo + n_i]
+            window.append((i, f, n_i))
+        statics = dict(
+            chunk3=pick_chunk(comb.n_choose_k(b, 3), STREAM_CHUNK[3]),
+            chunk5=pick_chunk(PIVOT_MIN_TOTAL, STREAM_CHUNK[5]),
+            has5=True, max_rounds=rb,
+            solve_rows=LUT5_HEAD_SOLVE_ROWS,
+        )
+        if ctx.warmer is not None:
+            ctx.warmer.note_chain(gmax, len(frames), n_per)
+        args = (
+            ctx.place_replicated(tables_s), ctx.binom,
+            ctx.place_replicated(g0s),
+            ctx.place_replicated(targets), ctx.place_replicated(masks),
+            jexcl, ctx.place_replicated(wseeds),
+            ctx.place_replicated(wdcs), ctx.place_replicated(n_rounds),
+            PIVOT_MIN_TOTAL, jsplits, jw, jm,
+        )
+        ckey = threading.get_ident()
+
+        def issue():
+            return ctx.kernel_call(
+                "fleet_round_driver", statics, args, g=gmax,
+            )
+
+        try:
+            with _ttrace.span("fleet_round_driver", "round",
+                              lanes=len(window), rounds=n, g=gmax):
+                pending = {"out": issue()}
+                hits = ctx.guarded_dispatch(
+                    # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused WAVE window — lanes x rounds of search per sync
+                    lambda: np.asarray(ctx.sync_verdict(
+                        "fleet_round_driver", pending["out"],
+                        consumer=ckey,
+                    )),
+                    "fleet_round_driver",
+                    on_retry=lambda: pending.update(out=issue()),
+                )
+        except DispatchTimeout as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s; degrading the fleet round chains to the host "
+                "fallback", e
+            )
+            ctx.trip_device_breaker()
+            degraded = True
+            continue
+
+        for i, f, n_i in window:
+            lane_hits = hits[i]
+            rounds_done = int(lane_hits[rb, 0])
+            f.ctx.stats.inc("round_driver_rounds", rounds_done)
+            f.ctx.stats.observe(
+                "rounds_per_dispatch", float(rounds_done)
+            )
+            counted = rounds_done + (1 if rounds_done < n_i else 0)
+            for k in range(counted):
+                f.ctx.stats.inc("lut3_candidates", int(lane_hits[k, 5]))
+                f.ctx.stats.inc("lut5_candidates", int(lane_hits[k, 6]))
+            for k in range(rounds_done):
+                target, mask = f.rounds[f.r + k]
+                g_from = f.st.num_gates
+                out = _replay_round(
+                    f.ctx, f.st, lane_hits[k], target, mask
+                )
+                f.record(f.r + k, out, g_from)
+            f.r += rounds_done
+            if rounds_done < n_i:
+                # This lane missed (or overflowed the in-kernel
+                # solver): it falls out of the chain for this round —
+                # the full recursive search on ITS view — and rejoins
+                # the wave at the next window.
+                f.host_round()
+    for f in frames:
+        assert f.st.num_gates == len(f.st.gates)
+    return [f.outs for f in frames]

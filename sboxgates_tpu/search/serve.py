@@ -13,6 +13,30 @@ runnable jobs by their gate-count bucket ACROSS tenants — same bucket =
 same kernel shapes = warm dispatches — with fair-share tenant rotation
 inside a bucket group so no tenant starves a lane-sized wave.
 
+**Fleet-merged waves** (default on; ``merge=False`` /
+``--serve-no-merge`` / ``SBG_SERVE_NO_MERGE=1`` opt out): when an
+admission round starts two or more same-bucket jobs together, their
+lanes share ONE :class:`~sboxgates_tpu.search.fleet.FleetRendezvous`
+(:class:`_Wave`) — the wave's node sweeps, streaming dispatches, and
+fused round-chain windows rendezvous into single ``jit(vmap)``
+dispatches on the fleet jobs-bucket ladder, so per-round device
+dispatches drop O(tenants) -> O(1) (and, with ``Options.chain_rounds``,
+toward ``1 / (lanes x chain_rounds)``).  A lane that finishes,
+preempts, or fails mid-wave leaves the rendezvous pool and the
+survivors keep merging at the smaller lane set (the fleet axis'
+done-lane masking); per-lane results are bit-identical to the direct
+dispatches and the PRNG stream is untouched, so every contract below —
+preemption at the journal boundary, quarantine isolation,
+serve-vs-standalone byte identity — holds unchanged with the fleet
+path underneath (chaos-matrix-gated in tests/test_serve.py).  A wave
+requeue records its membership in the ``waves.jsonl`` sidecar (never
+the per-job search journal, which must stay byte-identical to a
+standalone run) so a resumed orchestrator re-groups the wave
+deterministically; under a dispatch deadline budget the wave's merged
+resolve runs in ONE guarded window
+(``resilience.deadline.wave_dispatch_with_retry``) with the breach
+attributed to every lane riding it.
+
 Robustness is the spine:
 
 * **Isolation.**  Each job runs on a :class:`JobView` — its own PRNG
@@ -61,6 +85,7 @@ lock-order gate verifies this statically.
 from __future__ import annotations
 
 import hashlib
+import json as _json
 import logging
 import os
 import threading
@@ -178,6 +203,15 @@ class ServeJob:
     #: Latest attempt's forked registry (live per-job counters for the
     #: /status queue view; merged into the base at attempt end).
     registry: object = None
+    #: Live merged wave this job is a lane of (a _Wave, orchestrator-
+    #: owned) and its id for the status view; None outside a wave.
+    wave: object = None
+    wave_id: Optional[int] = None
+    #: Wave-affinity key: the sorted member list of the last merged wave
+    #: this job rode (set on a wave requeue, restored from the waves
+    #: sidecar on resume) — the scheduler clusters jobs sharing it so a
+    #: drained wave re-groups deterministically.
+    last_wave: str = ""
     _preempt: threading.Event = field(default_factory=threading.Event)
 
     @property
@@ -196,12 +230,34 @@ class JobView(SearchContext):
     accelerator backends), which is what makes the chaos matrix's
     serve-vs-standalone bit-identity comparison meaningful."""
 
-    def __init__(self, base: SearchContext, seed: int):
+    def __init__(self, base: SearchContext, seed: int, rdv=None,
+                 label: Optional[str] = None):
         self.__dict__.update(base.__dict__)
         self.rng = np.random.default_rng(seed)
         self._seed_buf = (np.empty(0, dtype=np.int64), 0)
         self.stats = base.stats.fork()
-        if base.rdv is not None:
+        # Lane label carried onto rendezvous submissions (wave-level
+        # breach attribution names the job, not a thread id).
+        self.dispatch_label = label
+        if rdv is not None:
+            # Merged serve wave: this job is one lane of a shared
+            # FleetRendezvous — its node sweeps (and round-chain
+            # windows) rendezvous with the other wave lanes into ONE
+            # jit(vmap) dispatch on the fleet jobs-bucket ladder.
+            # Per-lane results are bit-identical to the direct
+            # dispatches (the fleet parity contract), and the PRNG
+            # stream is untouched by the routing, so the job's circuit
+            # and journal stay byte-identical to its standalone run.
+            # The one shape difference a wave rendezvous could
+            # introduce is kwan's step-5 mux concurrency (run_mux_jobs
+            # draws per-branch seed blocks the serial mux does not):
+            # allow_mux_threads pins that choice to what a FRESH
+            # context with this seed would do, so the draw order — and
+            # the bit-identity contract — is independent of wave
+            # membership.
+            self.rdv = rdv
+            self.allow_mux_threads = base.rdv is not None
+        elif base.rdv is not None:
             from .batched import Rendezvous
 
             self.rdv = Rendezvous(1)
@@ -225,6 +281,37 @@ class _JobJournal(SearchJournal):
         return rec
 
 
+class _Wave:
+    """One merged serve wave: the same-bucket jobs admitted together,
+    sharing ONE :class:`~sboxgates_tpu.search.fleet.FleetRendezvous` so
+    their node sweeps (and fused round-chain windows) execute as one
+    jit(vmap) dispatch per round on the fleet jobs-bucket ladder.  A
+    lane that finishes, preempts, or fails mid-wave simply leaves the
+    rendezvous pool (``rdv.finish``) — the survivors' merges continue
+    with the shrunk lane set, the done-lane masking the fleet axis was
+    built around.  When the last lane leaves, the wave's fleet counters
+    fold into the run registry and the wave span is recorded."""
+
+    def __init__(self, wave_id: int, jobs, ctx: SearchContext):
+        from .fleet import FleetRendezvous
+
+        self.wave_id = wave_id
+        self.job_ids = tuple(j.job_id for j in jobs)
+        self.bucket = jobs[0].bucket
+        self.t0 = time.perf_counter()
+        self._live = len(jobs)
+        self.rdv = FleetRendezvous(
+            len(jobs), plan=ctx.fleet_plan, warmer=ctx.warmer,
+            deadline=ctx.deadline_cfg, deadline_stats=ctx.stats,
+        )
+
+    @property
+    def key(self) -> str:
+        """Content-based membership key (the re-group affinity value the
+        requeue records): stable across orchestrator restarts."""
+        return ",".join(sorted(self.job_ids))
+
+
 class ServeOrchestrator:
     """The serve-mode job queue + scheduler; see the module docstring.
 
@@ -232,7 +319,13 @@ class ServeOrchestrator:
     dispatch guards': ``budget_s`` is one attempt's wall budget (0 =
     unbounded), ``retries`` the requeue budget before quarantine, and
     ``backoff_s`` the base of the deterministic exponential requeue
-    backoff."""
+    backoff.
+
+    ``merge`` controls fleet-merged waves: when two or more same-bucket
+    jobs are admitted together, their lanes share one fleet rendezvous
+    and their per-round device dispatches collapse O(lanes) -> O(1)
+    (None = on unless ``SBG_SERVE_NO_MERGE=1``; the CLI's
+    ``--serve-no-merge`` maps here)."""
 
     def __init__(
         self,
@@ -241,6 +334,7 @@ class ServeOrchestrator:
         lanes: int = 4,
         deadline: Optional[DeadlineConfig] = None,
         log: Callable[[str], None] = print,
+        merge: Optional[bool] = None,
     ):
         self.ctx = ctx
         self.root = root
@@ -250,14 +344,39 @@ class ServeOrchestrator:
             budget_s=0.0, retries=2, backoff_s=0.25
         )
         self.log = log
+        if merge is None:
+            merge = os.environ.get("SBG_SERVE_NO_MERGE", "0") != "1"
+        self.merge = bool(merge) and self.lanes >= 2
         self._cv = threading.Condition()
         self._jobs: Dict[str, ServeJob] = {}
         self._seq = 0
+        self._wave_seq = 0
+        self._waves: Dict[int, _Wave] = {}
         self._draining = False
         self._stop = False
         self._scheduler: Optional[threading.Thread] = None
         self._workers: Dict[str, threading.Thread] = {}
         os.makedirs(root, exist_ok=True)
+        # Wave-membership sidecar (NOT the per-job search journal — that
+        # must stay byte-identical to a standalone run): each wave
+        # requeue appends the membership row a resuming orchestrator
+        # reads back as re-group affinity.
+        self._waves_path = os.path.join(root, "waves.jsonl")
+        self._prior_waves: Dict[str, str] = {}
+        try:
+            with open(self._waves_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = _json.loads(line)
+                    for jid in rec.get("jobs", ()):
+                        self._prior_waves[jid] = rec.get("key", "")
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as e:
+            logger.warning("serve: unreadable waves sidecar (%r); "
+                           "re-grouping affinity starts fresh", e)
 
     # -- admission ---------------------------------------------------------
 
@@ -296,6 +415,10 @@ class ServeOrchestrator:
             job.state = QUEUED
             job.submitted_t = now
             job.enqueued_t = now
+            if not job.last_wave:
+                # Resume affinity: a prior run's drained wave re-groups
+                # deterministically (the waves sidecar is the record).
+                job.last_wave = self._prior_waves.get(job.job_id, "")
             self._jobs[job.job_id] = job
             self.ctx.stats.inc("serve_jobs_admitted")
             self._cv.notify_all()
@@ -423,15 +546,27 @@ class ServeOrchestrator:
         warm = max(bucket_votes, key=lambda b: (bucket_votes[b], -b))
         picks: List[ServeJob] = []
         pool = list(ready)
+        picked_waves: set = set()
         while free > 0 and pool:
             pool.sort(key=lambda j: (
                 -j.priority,
                 0 if j.bucket == warm else 1,
+                # Wave re-group affinity: once one member of a recorded
+                # wave is picked ON MERIT, its former wave-mates follow
+                # into the same admission round, so a drained merged
+                # wave re-forms deterministically on resume.  The pull
+                # only ever activates for already-picked waves — a job
+                # with (or without) a recorded wave keeps its ordinary
+                # priority/bucket/tenant/FIFO position otherwise, so
+                # wave history can never starve anyone.
+                0 if j.last_wave and j.last_wave in picked_waves else 1,
                 by_tenant.get(j.tenant, 0),
                 j.seq,
             ))
             j = pool.pop(0)
             by_tenant[j.tenant] = by_tenant.get(j.tenant, 0) + 1
+            if j.last_wave:
+                picked_waves.add(j.last_wave)
             picks.append(j)
             free -= 1
         return picks
@@ -488,10 +623,13 @@ class ServeOrchestrator:
                     for j in picks:
                         j.state = RUNNING
                         j.started_t = now
+                        j.wave = None
+                        j.wave_id = None
                         j._preempt = threading.Event()
                         self.ctx.stats.observe(
                             "serve_queue_wait_s", now - j.enqueued_t
                         )
+                    self._form_waves_locked(picks)
                     preempts = self._preempt_targets_locked(now)
                 if not picks and not preempts:
                     self._cv.wait(0.1)
@@ -506,6 +644,84 @@ class ServeOrchestrator:
                     self._workers[j.job_id] = t
                 t.start()
 
+    # -- merged waves ------------------------------------------------------
+
+    def _form_waves_locked(self, picks: List[ServeJob]) -> None:
+        """Groups this admission round's picks into merged waves (caller
+        holds the lock): every bucket group of two or more jobs becomes
+        one wave whose lanes share a fleet rendezvous — one jit(vmap)
+        dispatch per round for the whole group, instead of one dispatch
+        stream per tenant thread.  Solo picks keep the per-job path."""
+        if not self.merge:
+            return
+        groups: Dict[int, List[ServeJob]] = {}
+        for j in picks:
+            groups.setdefault(j.bucket, []).append(j)
+        for group in groups.values():
+            if len(group) < 2:
+                continue
+            self._wave_seq += 1
+            wave = _Wave(self._wave_seq, group, self.ctx)
+            self._waves[wave.wave_id] = wave
+            for j in group:
+                j.wave = wave
+                j.wave_id = wave.wave_id
+            self.ctx.stats.observe(
+                "serve_wave_lanes", float(len(group))
+            )
+
+    def _leave_wave(self, wave: _Wave, job: ServeJob) -> None:
+        """One lane leaves its merged wave (job done, preempted, or
+        failed): the rendezvous pool shrinks (rdv.finish — the survivors
+        keep merging at the smaller lane set) and the LAST lane folds
+        the wave's fleet counters into the run registry and records the
+        wave span."""
+        wave.rdv.finish()
+        with self._cv:
+            job.wave = None
+            job.wave_id = None
+            wave._live -= 1
+            last = wave._live == 0
+            if last:
+                self._waves.pop(wave.wave_id, None)
+        if last:
+            from .fleet import fleet_stats_into
+
+            fleet_stats_into(self.ctx, wave.rdv)
+            self.ctx.stats.inc(
+                "serve_merged_dispatches",
+                wave.rdv.stats["fleet_dispatches"],
+            )
+            _ttrace.tracer().record(
+                f"serve.wave[{wave.wave_id}]", "wave", wave.t0,
+                time.perf_counter(),
+                {"lanes": len(wave.job_ids),
+                 "merged_dispatches": int(
+                     wave.rdv.stats["fleet_dispatches"]
+                 ),
+                 "submits": int(wave.rdv.stats["submits"])},
+            )
+
+    def _record_wave_requeue(self, job: ServeJob, wave: _Wave) -> None:
+        """Durable wave-membership row for a requeued lane (drain or
+        preemption mid-wave): the sidecar — NOT the per-job search
+        journal, which must stay byte-identical to a standalone run —
+        is what lets a resuming orchestrator re-group the wave
+        deterministically."""
+        job.last_wave = wave.key
+        try:
+            with open(self._waves_path, "a", encoding="utf-8") as f:
+                f.write(_json.dumps({
+                    "wave": wave.wave_id, "key": wave.key,
+                    "jobs": list(wave.job_ids),
+                    "requeued": job.job_id,
+                }) + "\n")
+        except OSError as e:
+            logger.warning(
+                "serve: cannot record wave membership for %s (%r); "
+                "resume re-grouping degrades to FIFO", job.job_id, e,
+            )
+
     # -- the worker --------------------------------------------------------
 
     def _job_dir(self, job: ServeJob) -> str:
@@ -519,10 +735,14 @@ class ServeOrchestrator:
 
         def hook(rtype: str, rec: dict) -> None:
             # First-hit detection: the first progress record carrying a
-            # result (an iteration's checkpoint, a round's beam) is the
-            # tenant's first hit; ttfh counts from SUBMISSION — queue
-            # wait and retries included, the latency the tenant sees.
-            hit = bool(rec.get("ckpt")) or bool(rec.get("beam"))
+            # result (an iteration's checkpoint, a round's beam, a
+            # chained output's completed round) is the tenant's first
+            # hit; ttfh counts from SUBMISSION — queue wait and retries
+            # included, the latency the tenant sees.
+            hit = (
+                bool(rec.get("ckpt")) or bool(rec.get("beam"))
+                or rtype == "chain_round"
+            )
             if hit and job.first_hit_t is None:
                 job.first_hit_t = time.perf_counter()
                 self.ctx.stats.observe(
@@ -555,8 +775,20 @@ class ServeOrchestrator:
         job_dir = self._job_dir(job)
         view: Optional[JobView] = None
         hb: Optional[Heartbeat] = None
+        with self._cv:
+            wave = job.wave
         try:
-            view = JobView(self.ctx, int(job.seed))
+            if wave is not None:
+                # Chaos site for the merged-wave path: an injected raise
+                # here is a lane failure AT WAVE ENTRY — the finally
+                # below still leaves the wave, so an injected poison
+                # lane can never strand its wave-mates' rendezvous.
+                faults.fault_point("serve.wave")
+            view = JobView(
+                self.ctx, int(job.seed),
+                rdv=wave.rdv if wave is not None else None,
+                label=job.job_id,
+            )
             with self._cv:
                 job.registry = view.stats
             journal = _JobJournal.for_job(
@@ -619,6 +851,12 @@ class ServeOrchestrator:
                 job.preemptions += 1
                 if view is not None and view.last_dispatch_gates:
                     job.bucket = bucket_size(view.last_dispatch_gates)
+            if wave is not None:
+                # Snapshot landed at the journal boundary; the requeue
+                # records wave membership so resume re-groups the wave
+                # deterministically (the non-preempted lanes keep
+                # merging — the finally's leave shrinks the pool).
+                self._record_wave_requeue(job, wave)
             self.ctx.stats.inc("serve_preemptions")
             self.log(f"serve: job {job.job_id} preempted ({e})")
             if self._draining and view is not None:
@@ -646,9 +884,13 @@ class ServeOrchestrator:
                     f"{failures}/{self.deadline.retries} in "
                     f"{backoff:.2f}s"
                 )
+                if wave is not None:
+                    self._record_wave_requeue(job, wave)
                 self._requeue(job, backoff_s=backoff)
         finally:
             faults.set_job(None)
+            if wave is not None:
+                self._leave_wave(wave, job)
             if hb is not None:
                 try:
                     hb.stop()
@@ -736,6 +978,8 @@ class ServeOrchestrator:
                     "failures": j.failures,
                     "preemptions": j.preemptions,
                 }
+                if j.wave_id is not None:
+                    row["wave"] = j.wave_id
                 if j.state == QUEUED:
                     row["queue_wait_s"] = round(now - j.enqueued_t, 3)
                 if j.state == RUNNING and j.started_t is not None:
@@ -758,6 +1002,8 @@ class ServeOrchestrator:
                 "schema": SERVE_SCHEMA,
                 "lanes": self.lanes,
                 "lane_bucket": self.lane_bucket,
+                "merge": self.merge,
+                "waves": len(self._waves),
                 "draining": self._draining,
                 "counts": counts,
                 "jobs": jobs,

@@ -184,6 +184,18 @@ KERNELS: Dict[str, KernelDef] = {
             ("chunk3", "chunk5", "has5", "max_rounds", "solve_rows"),
             warmable=False,
         ),
+        # Stacked-fleet round chains (search/rounds.py
+        # run_fleet_round_chains): a whole wave's chains advance in ONE
+        # dispatch.  Not warmable by the bucket enumerator for the same
+        # reason as round_driver; the chain-shape warm specs
+        # (chain_warm_specs / KernelWarmer.note_chain) AOT-build the
+        # (jobs_bucket, gate_bucket, chain-length) cross product the
+        # live wave drivers dispatch.
+        KernelDef(
+            "fleet_round_driver",
+            ("chunk3", "chunk5", "has5", "max_rounds", "solve_rows"),
+            warmable=False,
+        ),
         # 64-bit-rank device enumeration (search/lut.py big-space
         # streams) and the 5-LUT filter head with the pallas backend:
         # dispatched on g-exact shapes / env-levered backends, so they
@@ -222,6 +234,12 @@ FLEET_SHARED: Dict[str, Tuple[int, ...]] = {
     "lut5_pivot_stream": (9, 10),
     "lut5_pivot_tile": (),
     "pivot_pair_cells": (),
+    # Fused round-chain windows (search/rounds.py): binomial table,
+    # empty exclusion array, the pivot-size cap, and the 5-LUT split
+    # tables are job-invariant; tables/g/targets/masks/seeds/dcs/n gain
+    # the jobs axis.  This is how a serve wave's concurrent chains merge
+    # into one vmapped round_driver dispatch.
+    "round_driver": (1, 5, 9, 10, 11, 12),
 }
 
 
@@ -730,6 +748,92 @@ def mesh_warm_specs(plan: WarmPlan, g: int) -> List[tuple]:
     return specs
 
 
+def chain_warm_specs(
+    plan: WarmPlan, g: int, lanes: int, rounds: int,
+) -> List[tuple]:
+    """AOT-compile targets for the merged round-chain windows, keyed on
+    (jobs_bucket, gate_bucket, chain length): the shapes
+    ``search.rounds`` dispatches for a window of up to ``rounds`` fused
+    rounds starting at gate count ``g``, across ``lanes`` wave lanes.
+
+    Two dispatch forms exist and both are covered: ``lanes >= 2`` waves
+    merge through the fleet rendezvous (flat/stacked-wrapped
+    ``round_driver``, the serve merged-wave path) AND through the
+    explicit pre-stacked ``fleet_round_driver`` kernel (the lockstep
+    ``run_fleet_round_chains`` path); ``lanes == 1`` is the direct
+    per-job ``round_driver`` window.  Returns the ``_compile_jobs``
+    tuple format (cache key, lower resolver, avals, statics, label)."""
+    from . import context as C
+    from .rounds import ROUND_BUCKETS, _chain_bucket, round_bucket
+
+    want = max(1, min(int(rounds), ROUND_BUCKETS[-1]))
+    b, n = _chain_bucket(g, want)
+    rb = round_bucket(n)
+    statics = dict(
+        chunk3=C.pick_chunk(comb.n_choose_k(b, 3), C.STREAM_CHUNK[3]),
+        chunk5=C.pick_chunk(C.PIVOT_MIN_TOTAL, C.STREAM_CHUNK[5]),
+        has5=True, max_rounds=rb, solve_rows=C.LUT5_HEAD_SOLVE_ROWS,
+    )
+    splits, w_tab, m_tab = sweeps.lut5_split_tables()
+    bt = sweeps.binom_table()
+    gi = 0  # python-int scalars, weak-typed like the live operands
+    per_job = (
+        _sds((b, _N_WORDS), np.uint32),          # tables
+        _sds(bt.shape, bt.dtype),                # binom (shared)
+        gi,                                      # g0
+        _sds((rb, _N_WORDS), np.uint32),         # targets
+        _sds((rb, _N_WORDS), np.uint32),         # masks
+        _sds((8,), np.int32),                    # excl (shared)
+        _sds((rb,), np.int32),                   # seeds
+        _sds((rb,), np.int32),                   # dc draws
+        gi,                                      # n_rounds
+        gi,                                      # total5_cap (shared)
+        _sds(splits.shape, splits.dtype),        # splits (shared)
+        _sds(w_tab.shape, w_tab.dtype),          # w_tab (shared)
+        _sds(m_tab.shape, m_tab.dtype),          # m_tab (shared)
+    )
+    spec = WarmSpec(
+        "round_driver", tuple(sorted(statics.items())), per_job
+    )
+    jobs: List[tuple] = []
+    if lanes < 2:
+        jobs.append((
+            spec.key,
+            (lambda: KERNELS["round_driver"].fn.lower),
+            per_job, statics, "round_driver",
+        ))
+        return jobs
+    shared = FLEET_SHARED["round_driver"]
+    from .fleet import FLEET_BUCKETS
+
+    stacked = lanes > FLEET_BUCKETS[-1]
+    avals = (
+        fleet_stacked_avals(spec, shared, lanes) if stacked
+        else fleet_flat_avals(spec, shared, lanes)
+    )
+    jobs.append((
+        fleet_warm_key(
+            "round_driver", statics, shared, lanes, avals,
+            plan.fleet_mesh, stacked=stacked,
+        ),
+        (lambda st=stacked: fleet_kernel(
+            "round_driver", statics, shared, len(per_job), lanes,
+            plan.fleet_mesh, stacked=st,
+        ).lower),
+        avals, {}, "round_driver",
+    ))
+    # The lockstep driver's pre-stacked kernel: per-lane scalar operands
+    # arrive as int32[lanes] vectors, exactly as run_fleet_round_chains
+    # builds them.
+    stacked_avals = fleet_stacked_avals(spec, shared, lanes)
+    jobs.append((
+        warm_key("fleet_round_driver", statics, stacked_avals),
+        (lambda: KERNELS["fleet_round_driver"].fn.lower),
+        stacked_avals, statics, "fleet_round_driver",
+    ))
+    return jobs
+
+
 def mesh_warm_lookup(kind: tuple, mesh, statics: dict, args: Sequence):
     """Warmed sharded executable for one live mesh dispatch, or None."""
     key = (
@@ -906,6 +1010,32 @@ class KernelWarmer:
                 ("fleet", gg, ll, form),
             )
 
+    def note_chain(
+        self, g: Optional[int], lanes: int, rounds: int,
+    ) -> None:
+        """Round-chain dispatch hook (search.rounds): schedules the
+        merged-window executables for a chain at gate count ``g`` across
+        ``lanes`` wave lanes — the (jobs_bucket, gate_bucket,
+        chain-length) wave shapes — plus the NEXT window's set (a fused
+        window grows the graph by up to two gates per round, so the next
+        window can start in the next gate bucket)."""
+        if not self.enabled or g is None:
+            return
+        from .fleet import fleet_bucket
+        from .rounds import ROUND_BUCKETS, _chain_bucket, round_bucket
+
+        want = max(1, min(int(rounds), ROUND_BUCKETS[-1]))
+        ll = fleet_bucket(max(1, lanes))
+        for gg in (g, g + 2 * want):
+            try:
+                b, n = _chain_bucket(gg, want)
+            except ValueError:  # no append capacity at the gate cap
+                continue
+            self._schedule(
+                ("chain", b, round_bucket(n), ll),
+                ("chain", gg, ll, want),
+            )
+
     def _fleet_shape_key(self, g: int) -> tuple:
         """Dedup key for one fleet warm set's shapes at gate count g:
         the table bucket, plus the pivot g-bucket when the plan has
@@ -1009,6 +1139,8 @@ class KernelWarmer:
             try:
                 if item[0] == "fleet":
                     self._warm_fleet(item[1], item[2], item[3])
+                elif item[0] == "chain":
+                    self._warm_chain(item[1], item[2], item[3])
                 else:
                     self._warm_bucket(item[1])
             finally:
@@ -1066,6 +1198,18 @@ class KernelWarmer:
             logger.warning(
                 "fleet warm-spec enumeration for g=%d lanes=%d failed "
                 "(%s); skipping this warm set", g, lanes, e
+            )
+            self.count("warm_failed")
+            return
+        self._compile_jobs(jobs)
+
+    def _warm_chain(self, g: int, lanes: int, rounds: int) -> None:
+        try:
+            jobs = chain_warm_specs(self.plan, g, lanes, rounds)
+        except Exception as e:
+            logger.warning(
+                "chain warm-spec enumeration for g=%d lanes=%d rounds=%d "
+                "failed (%s); skipping this warm set", g, lanes, rounds, e
             )
             self.count("warm_failed")
             return

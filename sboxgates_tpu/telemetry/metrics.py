@@ -134,6 +134,11 @@ METRICS: Dict[str, MetricDef] = {
         COUNTER, "jobs",
         "poison jobs quarantined after exhausting their retry schedule",
     ),
+    "serve_merged_dispatches": MetricDef(
+        COUNTER, "dispatches",
+        "merged fleet dispatches issued by serve waves (each one device "
+        "dispatch serving a whole same-bucket tenant wave's sweeps)",
+    ),
     # histograms (bracketed members inherit the base declaration)
     "device_wait_s": MetricDef(
         HISTOGRAM, "s",
@@ -155,6 +160,11 @@ METRICS: Dict[str, MetricDef] = {
         HISTOGRAM, "s",
         "serve-mode queue wait per admission grant (enqueue/requeue to "
         "lane start)",
+    ),
+    "serve_wave_lanes": MetricDef(
+        HISTOGRAM, "lanes",
+        "lanes per merged serve wave at formation (how much of the "
+        "fleet jobs axis each admission round actually engaged)",
     ),
     "rounds_per_dispatch": MetricDef(
         HISTOGRAM, "rounds",

@@ -197,6 +197,10 @@ def render_serve(serve: dict) -> List[str]:
     head = (
         f"  serve lanes={serve.get('lanes', '?')}"
         f" bucket={serve.get('lane_bucket', '?')}"
+        + (
+            f" waves={serve['waves']}"
+            if serve.get("merge") and serve.get("waves") else ""
+        )
         + (" DRAINING" if serve.get("draining") else "")
     )
     lines = [head, "    " + "  ".join(
@@ -217,6 +221,8 @@ def render_serve(serve: dict) -> List[str]:
             f"prio={row.get('priority', 0)}",
             f"bucket={row.get('bucket', '?')}",
         ]
+        if "wave" in row:
+            bits.append(f"wave={row['wave']}")
         if row.get("failures"):
             bits.append(f"fail={row['failures']}")
         if row.get("preemptions"):

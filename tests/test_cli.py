@@ -226,6 +226,11 @@ def test_serve_incoherent_flag_combos_rejected(tmp_path, monkeypatch,
         ["--serve", "--serve-timeout", "0", DES, "--output-dir", d],
         ["--serve", "--resume-run", d],
         ["--serve", "--coordinator", "x:1", DES, "--output-dir", d],
+        ["--serve-no-merge", DES],                       # needs --serve
+        ["--chain-rounds", "-1", DES],
+        ["--chain-rounds", "4", DES],                    # needs -l
+        ["-l", "--chain-rounds", "4", "-i", "2", DES],   # needs -i 1
+        ["-l", "--chain-rounds", "4", "-o", "0", DES],   # all-outputs only
     ):
         rc = main(argv)
         assert rc != 0, argv

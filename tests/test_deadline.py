@@ -18,6 +18,7 @@ from sboxgates_tpu.resilience.deadline import (
     dispatch_with_retry,
     replicated_dispatch_with_retry,
     run_with_deadline,
+    wave_dispatch_with_retry,
 )
 from sboxgates_tpu.resilience.faults import InjectedFault
 from sboxgates_tpu.search import Options, SearchContext
@@ -60,6 +61,49 @@ def test_dispatch_with_retry_recovers_after_transient_hang():
     assert stats["deadline_breaches"] == 1
     assert stats["dispatch_retries"] == 1
     assert calls == ["reissue"]
+
+
+def test_wave_dispatch_exhaustion_attributes_every_lane():
+    """The merged-wave guard: ONE window per wave dispatch, breach and
+    retry counters per window (not per lane), the re-issue hook fires
+    per retry, and the final DispatchTimeout NAMES every lane riding
+    the window so per-job failure policy can attribute it."""
+    cfg = DeadlineConfig(budget_s=0.02, retries=1, backoff_s=0.01)
+    reissues = []
+    stats = {}
+    with pytest.raises(DispatchTimeout) as ei:
+        wave_dispatch_with_retry(
+            lambda: time.sleep(5.0), cfg, stats=stats,
+            label="fleet[gate_step_stream]", lanes=["jobA", "jobB"],
+            on_retry=lambda: reissues.append(1),
+        )
+    assert "jobA" in str(ei.value) and "jobB" in str(ei.value)
+    assert stats["deadline_breaches"] == 2  # one per window, not lane
+    assert stats["dispatch_retries"] == 1
+    assert len(reissues) == 1
+
+
+def test_wave_dispatch_recovers_and_inline_when_disabled():
+    """A transient hang recovers within the wave's retry schedule, and
+    a disabled config short-circuits inline."""
+    cfg = DeadlineConfig(budget_s=0.05, retries=2, backoff_s=0.01)
+    state = {"calls": 0}
+
+    def resolve():
+        state["calls"] += 1
+        if state["calls"] == 1:
+            time.sleep(5.0)
+        return 42
+
+    stats = {}
+    assert wave_dispatch_with_retry(
+        resolve, cfg, stats=stats, lanes=["j0"],
+    ) == 42
+    assert stats["deadline_breaches"] == 1
+    assert wave_dispatch_with_retry(lambda: 7, None) == 7
+    assert wave_dispatch_with_retry(
+        lambda: 8, DeadlineConfig(budget_s=0.0)
+    ) == 8
 
 
 def test_dispatch_with_retry_backoff_and_exhaustion():

@@ -1,0 +1,234 @@
+"""Fleet-merged round chains: the PR 11 fused round driver stacked on
+the PR 8 fleet jobs axis.
+
+Two dispatch forms are gated bit-identical to the per-lane
+:func:`run_round_chain` reference: the explicit lockstep
+``fleet_round_driver`` kernel (:func:`run_fleet_round_chains`) and the
+rendezvous-merged path (concurrent lanes' ``round_driver`` windows
+submitting through one :class:`FleetRendezvous` — the serve merged-wave
+shape).  All tests run in-process on the planted-chain fixture
+(tests/planted.py), so the file stays tier-1-cheap.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from planted import build_round_chain
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search.fleet import FleetRendezvous, fleet_stats_into
+from sboxgates_tpu.search.rounds import (
+    run_fleet_round_chains,
+    run_round_chain,
+)
+from sboxgates_tpu.search.serve import JobView
+
+
+def _sig(st):
+    return (
+        st.tables.tobytes(),
+        tuple((g.type, g.in1, g.in2, g.in3, g.function) for g in st.gates),
+    )
+
+
+def _ctx(seed=5):
+    return SearchContext(Options(
+        lut_graph=True, randomize=True, seed=seed, warmup=False,
+        parallel_mux=False, native_engine=False,
+    ))
+
+
+def _lane_case(i):
+    """Lane i's planted chain: distinct seeds, one lane (i == 2) ending
+    in a target the round kernel cannot finish — the per-lane fallback
+    path.  The fallback lane uses the SMALLEST planted state (a 7-leaf
+    LUT tree over the bare 8 inputs): the host recursion it exists to
+    trigger then sweeps a C(~12, 7) space in ~1 s instead of tens of
+    seconds (the tier-1 budget discipline), while the other lanes share
+    one shape class so their window compiles amortize across tests."""
+    if i == 2:
+        return build_round_chain(
+            n_rounds=2, gates0=8, seed=22, deep_last=True,
+        )
+    return build_round_chain(n_rounds=6, gates0=12, seed=20 + i)
+
+
+def _reference(n_lanes, rounds_per_dispatch=4):
+    base = _ctx()
+    refs = []
+    for i in range(n_lanes):
+        st, rounds = _lane_case(i)
+        v = JobView(base, 1000 + i)
+        outs = run_round_chain(
+            v, st, rounds, rounds_per_dispatch=rounds_per_dispatch,
+        )
+        refs.append((tuple(outs), _sig(st), v.rng_snapshot()))
+    return refs
+
+
+def test_fleet_round_chains_bit_identical_with_fallback_lane():
+    """The lockstep driver: 4 lanes (one with a host-fallback round)
+    advance through fleet_round_driver dispatches — per-lane circuits,
+    output ids, and PRNG positions byte-identical to run_round_chain on
+    each lane alone, with the whole wave's windows collapsing to a
+    handful of dispatches."""
+    refs = _reference(4)
+    base = _ctx()
+    lanes = []
+    for i in range(4):
+        st, rounds = _lane_case(i)
+        lanes.append((JobView(base, 1000 + i), st, rounds))
+    outs = run_fleet_round_chains(base, lanes, rounds_per_dispatch=4)
+    for i, (v, st, _rounds) in enumerate(lanes):
+        assert (tuple(outs[i]), _sig(st), v.rng_snapshot()) == refs[i], (
+            f"lane {i} diverged from its standalone chain"
+        )
+        # The fallback lane's counter landed on ITS view.
+        if i == 2:
+            assert v.stats["round_driver_fallbacks"] == 1
+        else:
+            assert v.stats["round_driver_fallbacks"] == 0
+    # 4 lanes x 6-7 rounds at 4 rounds/dispatch: a couple of wave
+    # windows, not lanes x windows.
+    assert base.stats["device_dispatches"] <= 4
+
+
+def test_fleet_round_chains_dispatch_ratio():
+    """The combined-axis claim: L lanes x R rounds/dispatch means the
+    per-round reference loop's L x rounds dispatches collapse to
+    ceil(rounds / R) wave windows."""
+    n_lanes, n_rounds, rpd = 4, 8, 8
+    base = _ctx(seed=9)
+    lanes = []
+    for i in range(n_lanes):
+        st, rounds = build_round_chain(
+            n_rounds=n_rounds, gates0=12, seed=40 + i,
+        )
+        lanes.append((JobView(base, 2000 + i), st, rounds))
+    outs = run_fleet_round_chains(base, lanes, rounds_per_dispatch=rpd)
+    assert all(len(o) == n_rounds for o in outs)
+    # All 4 lanes' 8 rounds in ONE dispatch: ratio
+    # 1 / (lanes x rounds) vs the per-lane per-round loop.
+    assert base.stats["device_dispatches"] == 1
+    for v, _st, _r in lanes:
+        assert v.stats["round_driver_fallbacks"] == 0
+
+
+def test_rendezvous_merged_chain_windows_bit_identical():
+    """The serve merged-wave shape: concurrent lanes running plain
+    run_round_chain over ONE shared FleetRendezvous merge their
+    round_driver windows into single jit(vmap) dispatches — per-lane
+    results and PRNG streams identical to the direct windows."""
+    refs = _reference(3)
+    base = _ctx()
+    rdv = FleetRendezvous(3, warmer=None)
+    results = [None] * 3
+    errors = []
+
+    def worker(i):
+        try:
+            st, rounds = _lane_case(i)
+            v = JobView(base, 1000 + i, rdv=rdv)
+            outs = run_round_chain(v, st, rounds, rounds_per_dispatch=4)
+            results[i] = (tuple(outs), _sig(st), v.rng_snapshot())
+        except BaseException as e:  # surfaced after join
+            errors.append(e)
+        finally:
+            rdv.finish()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i in range(3):
+        assert results[i] == refs[i], f"lane {i} diverged when merged"
+    fleet_stats_into(base, rdv)
+    assert base.stats["fleet_submits"] >= 3
+    # Merging happened: fewer dispatches than submitted windows.
+    assert (
+        base.stats["fleet_dispatches"] + base.stats["fleet_singletons"]
+        < base.stats["fleet_submits"]
+    )
+
+
+def test_chain_warm_specs_match_live_dispatch(monkeypatch):
+    """note_chain's AOT builds must key exactly like the live merged
+    windows: after warming, a merged wave window is a fleet warm HIT
+    (the (jobs_bucket, gate_bucket, chain-length) wave-shape specs)."""
+    from sboxgates_tpu.search import warmup as W
+
+    monkeypatch.setenv("SBG_WARMUP", "1")  # conftest defaults it off
+    plan = W.WarmPlan.from_context(_ctx())
+    st, _rounds = _lane_case(0)
+    jobs = W.chain_warm_specs(plan, st.num_gates, 2, 4)
+    # Both merged forms enumerated: the rendezvous-wrapped round_driver
+    # and the pre-stacked fleet_round_driver.
+    labels = sorted(j[4] for j in jobs)
+    assert labels == ["fleet_round_driver", "round_driver"]
+    # Compile the rendezvous form and serve a live merged window warm.
+    warmer = W.KernelWarmer(plan, enabled=True)
+    try:
+        warmer.note_chain(st.num_gates, 2, 4)
+        assert warmer.wait_idle(120)
+        base = _ctx()
+        rdv = FleetRendezvous(2, warmer=warmer)
+        errors = []
+
+        def worker(i):
+            try:
+                stl, rounds = build_round_chain(
+                    n_rounds=4, gates0=st.num_gates, seed=60 + i,
+                )
+                v = JobView(base, 3000 + i, rdv=rdv)
+                run_round_chain(v, stl, rounds, rounds_per_dispatch=4)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                rdv.finish()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert rdv.stats["fleet_warm_hits"] >= 1, dict(rdv.stats)
+    finally:
+        warmer.shutdown()
+        W.drop_warm_cache()
+
+
+def test_chained_generate_graph_bit_identical_across_n():
+    """Options.chain_rounds: the greedy chained-outputs driver produces
+    byte-identical circuits for every rounds-per-dispatch value, and
+    fewer dispatches at higher values."""
+    from sboxgates_tpu.graph.state import State
+    from sboxgates_tpu.search import generate_graph, make_targets
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+    from sboxgates_tpu.utils.sbox import parse_sbox
+
+    bj = toy_fleet_boxes(1)[0]
+    text = " ".join("%02x" % v for v in bj.sbox[:8])
+    sbox, ni = parse_sbox(text)
+    sigs, disps = [], []
+    for cr in (1, 8):
+        ctx = SearchContext(Options(
+            lut_graph=True, randomize=False, seed=11, warmup=False,
+            host_small_steps=False, native_engine=False, chain_rounds=cr,
+        ))
+        res = generate_graph(
+            ctx, State.init_inputs(ni), make_targets(sbox),
+            save_dir=None, log=lambda s: None,
+        )
+        assert len(res) == 1
+        sigs.append(_sig(res[0]))
+        disps.append(int(ctx.stats["device_dispatches"]))
+    assert sigs[0] == sigs[1]
+    assert disps[1] <= disps[0]

@@ -369,6 +369,25 @@ def test_admission_fair_share_and_bucket_grouping(tmp_path):
     assert [j.job_id for j in more] == ["warm"]
 
 
+def test_wave_affinity_pulls_mates_without_starving_fifo(tmp_path):
+    """Wave re-group affinity is a PULL, not a penalty: a former wave
+    member keeps its FIFO position against fresh jobs, and once one
+    member is picked on merit its recorded wave-mates follow into the
+    same admission round ahead of later fresh submissions."""
+    ctx, orch = make_orch(tmp_path, lanes=3)
+    w1 = orch.submit(ServeJob(job_id="w1", sbox_path=DES, tenant="a"))
+    f1 = orch.submit(ServeJob(job_id="f1", sbox_path=DES, tenant="b"))
+    w2 = orch.submit(ServeJob(job_id="w2", sbox_path=DES, tenant="c"))
+    f2 = orch.submit(ServeJob(job_id="f2", sbox_path=DES, tenant="d"))
+    w1.last_wave = w2.last_wave = "w1,w2"
+    with orch._cv:
+        picks = orch._admit_locked(time.perf_counter())
+    # w1 leads by FIFO (its wave history is no handicap), w2 is pulled
+    # in by affinity ahead of the earlier-submitted f1.
+    assert [j.job_id for j in picks] == ["w1", "w2", "f1"]
+    del f2
+
+
 def test_requeued_job_not_readmitted_until_worker_lands(tmp_path):
     """_requeue flips a job back to QUEUED from the worker's except
     block, BEFORE its finally writes artifacts and pops the worker
@@ -502,6 +521,264 @@ def test_status_view_watch_render_and_heartbeat_section(tmp_path):
     assert "done=2" in text
     block = "\n".join(render_serve(final["serve"]))
     assert "j0" in block and "tenant=acme" in block
+
+
+# -------------------------------------------------------------------------
+# Fleet-merged serve waves
+# -------------------------------------------------------------------------
+
+#: Device-dispatch configuration (mirrors tests/test_fleet.py DEV): node
+#: heads dispatch to the (CPU) device instead of routing native, so a
+#: merged wave's rendezvous actually merges sweeps.
+DEVOPTS = dict(
+    seed=11, lut_graph=True, randomize=False, host_small_steps=False,
+    native_engine=False, warmup=False,
+)
+
+
+def _toy_sbox_files(tmp_path, n=8):
+    """The fleet fixture corpus written as S-box input files (3-input
+    searches whose node sweeps make real device dispatches under
+    DEVOPTS)."""
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+    d = tmp_path / "boxes"
+    os.makedirs(d, exist_ok=True)
+    paths = []
+    for i, bj in enumerate(toy_fleet_boxes(n)):
+        p = str(d / f"toy{i}.txt")
+        with open(p, "w") as f:
+            f.write(" ".join("%02x" % v for v in bj.sbox[:8]))
+        paths.append(p)
+    return paths
+
+
+def make_dev_orch(tmp_path, lanes, retries=2, merge=None, sub="serve",
+                  **opts):
+    ctx = SearchContext(Options(**{**DEVOPTS, **opts}))
+    orch = ServeOrchestrator(
+        ctx, str(tmp_path / sub), lanes=lanes,
+        deadline=DeadlineConfig(retries=retries, backoff_s=0.01),
+        log=lambda s: None, merge=merge,
+    )
+    return ctx, orch
+
+
+def dev_standalone_digests(tmp_dir, sbox_path, output, seed, **opts):
+    """Bit-identity reference under the device-dispatch configuration."""
+    from sboxgates_tpu.search.orchestrator import generate_graph
+
+    ctx = SearchContext(Options(**{**DEVOPTS, **opts, "seed": seed}))
+    sbox, num_inputs = load_sbox(sbox_path, 0)
+    targets = make_targets(sbox)
+    st = State.init_inputs(num_inputs)
+    os.makedirs(tmp_dir, exist_ok=True)
+    if output >= 0:
+        generate_graph_one_output(
+            ctx, st, targets, output, save_dir=tmp_dir,
+            log=lambda s: None, journal=None,
+        )
+    else:
+        generate_graph(
+            ctx, st, targets, save_dir=tmp_dir, log=lambda s: None,
+        )
+    return xml_digests(tmp_dir)
+
+
+def test_merged_wave_one_dispatch_per_round_bit_identical(tmp_path):
+    """THE tentpole gate: an 8-tenant same-bucket wave's node sweeps
+    merge into single fleet dispatches (per-round device dispatches
+    ~1 vs ~8 per-thread), and every job's circuits stay byte-identical
+    to its standalone run."""
+    paths = _toy_sbox_files(tmp_path)
+    # Per-thread reference arm (merge off): same jobs, own dispatches.
+    ctx_u, orch_u = make_dev_orch(tmp_path, lanes=8, merge=False,
+                                  sub="unmerged")
+    for i, p in enumerate(paths):
+        orch_u.submit(ServeJob(job_id=f"t{i}", sbox_path=p, output=0))
+    orch_u.start()
+    view_u = orch_u.run_until_idle(timeout_s=240)
+    orch_u.stop()
+    assert view_u["counts"][DONE] == 8, view_u
+    assert ctx_u.stats.get("serve_merged_dispatches", 0) == 0
+    unmerged = int(ctx_u.stats["device_dispatches"])
+
+    ctx, orch = make_dev_orch(tmp_path, lanes=8)
+    jobs = [
+        orch.submit(ServeJob(
+            job_id=f"t{i}", sbox_path=p, output=0, tenant=f"ten{i % 3}",
+        ))
+        for i, p in enumerate(paths)
+    ]
+    orch.start()
+    view = orch.run_until_idle(timeout_s=240)
+    orch.stop()
+    assert view["counts"][DONE] == 8, view
+    s = ctx.stats
+    assert s["serve_merged_dispatches"] >= 1
+    assert s.histograms()["serve_wave_lanes"]["count"] >= 1
+    assert s.histograms()["serve_wave_lanes"]["max"] == 8.0
+    # The wave merged: one dispatch serves many lanes' submissions, and
+    # the whole run costs at most half the per-thread arm's dispatches
+    # (~1/8 when the lanes stay in lockstep).
+    assert s["fleet_submits"] > s["serve_merged_dispatches"]
+    merged = int(
+        s["device_dispatches"]
+    )
+    assert merged * 2 <= unmerged, (merged, unmerged)
+    assert s.undeclared() == set()
+    for j in jobs:
+        ref = dev_standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed),
+        )
+        got = xml_digests(os.path.join(orch.root, j.job_id))
+        assert got == ref, f"{j.job_id} diverged in the merged wave"
+
+
+def test_merged_wave_randomized_draw_stream_matches_standalone(tmp_path):
+    """Randomized jobs are the draw-stream acid test: the wave
+    rendezvous must not change HOW a job consumes its PRNG (seed
+    blocks, mux-branch draws — JobView.allow_mux_threads pins the
+    standalone shape), so randomize=True merged-wave circuits stay
+    byte-identical to standalone runs."""
+    paths = _toy_sbox_files(tmp_path, n=4)
+    ctx, orch = make_dev_orch(tmp_path, lanes=4, randomize=True)
+    jobs = [
+        orch.submit(ServeJob(job_id=f"r{i}", sbox_path=p, output=0))
+        for i, p in enumerate(paths)
+    ]
+    orch.start()
+    view = orch.run_until_idle(timeout_s=240)
+    orch.stop()
+    assert view["counts"][DONE] == 4, view
+    for j in jobs:
+        ref = dev_standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed), randomize=True,
+        )
+        got = xml_digests(os.path.join(orch.root, j.job_id))
+        assert got == ref, f"{j.job_id}: randomized draws diverged"
+
+
+def test_merged_chaos_matrix_and_poison_lane(tmp_path):
+    """The PR 13 chaos gate with the fleet path underneath: randomized
+    preempt/kill schedules over an 8-job merged-wave run stay
+    bit-identical to standalone digests, and a poison lane quarantines
+    without poisoning its wave-mates."""
+    rng = np.random.default_rng(42)
+    paths = _toy_sbox_files(tmp_path)
+    ctx, orch = make_dev_orch(tmp_path, lanes=4, retries=4)
+    jobs = [
+        orch.submit(ServeJob(
+            job_id=f"t{i}", sbox_path=p, output=0, tenant=f"ten{i % 3}",
+        ))
+        for i, p in enumerate(paths)
+    ]
+    poison = orch.submit(ServeJob(
+        job_id="poison", sbox_path=paths[0], output=0, tenant="evil",
+    ))
+    victims = rng.choice([j.job_id for j in jobs], size=2, replace=False)
+    for v in victims:
+        faults.arm(f"serve.preempt@job:{v}", "raise", "1")
+    kill = rng.choice([j.job_id for j in jobs], size=1)[0]
+    faults.arm(f"search.node@job:{kill}", "raise", "1")
+    # The poison lane dies AT WAVE ENTRY on every attempt — the wave
+    # fault site itself — so its rendezvous slot must always be
+    # released without stranding wave-mates.  (When a scheduling round
+    # happens to admit it solo there IS no wave entry, so the
+    # search.node arm below keeps it poisonous either way.)
+    faults.arm("serve.wave@job:poison", "raise", "1+")
+    faults.arm("search.node@job:poison", "raise", "1+")
+    orch.start()
+    view = orch.run_until_idle(timeout_s=240)
+    orch.stop()
+    assert view["jobs"]["poison"]["state"] == QUARANTINED, view
+    assert view["counts"][DONE] == 8, view
+    assert ctx.stats["serve_preemptions"] >= 1
+    assert ctx.stats["serve_merged_dispatches"] >= 1
+    for j in jobs:
+        ref = dev_standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed),
+        )
+        got = xml_digests(os.path.join(orch.root, j.job_id))
+        assert got == ref, f"{j.job_id} diverged under merged chaos"
+
+
+def test_drain_mid_merged_wave_no_stranded_lanes(tmp_path):
+    """The drain regression gate: drain() during an in-flight merged
+    wave must not strand the non-preempted lanes — every lane lands
+    QUEUED (snapshot at its journal boundary) or DONE, the requeue
+    records wave membership in the sidecar, and a resuming orchestrator
+    re-groups deterministically and finishes bit-identically.  A chaos
+    ``serve.drain`` injection fires mid-wave first: the injected drain
+    failure is loud, and the retried drain still cleans up."""
+    paths = _toy_sbox_files(tmp_path, n=4)
+    ctx, orch = make_dev_orch(tmp_path, lanes=4, iterations=4)
+    jobs = [
+        orch.submit(ServeJob(job_id=f"t{i}", sbox_path=p, output=0))
+        for i, p in enumerate(paths)
+    ]
+    # One lane preempts at its first journal boundary mid-wave: a
+    # deterministic wave requeue (and sidecar row) regardless of how
+    # fast the other lanes run.
+    faults.arm("serve.preempt@job:t0", "raise", "1")
+    faults.arm("serve.drain", "raise", "1")
+    orch.start()
+    assert _wait_state(orch, "t1", RUNNING) or _wait_state(
+        orch, "t0", RUNNING
+    )
+    with pytest.raises(faults.InjectedFault):
+        orch.drain(timeout_s=30)  # chaos-injected drain: loud, no harm
+    view = orch.drain(timeout_s=60)
+    assert all(
+        r["state"] in (QUEUED, DONE) for r in view["jobs"].values()
+    ), view
+    # The preempted lane's wave membership is durable and carries the
+    # full member list.
+    waves_path = os.path.join(orch.root, "waves.jsonl")
+    assert os.path.exists(waves_path)
+    recs = [json.loads(line) for line in open(waves_path)]
+    assert any(r["requeued"] == "t0" for r in recs)
+    key = next(r["key"] for r in recs if r["requeued"] == "t0")
+    assert set(key.split(",")) == {f"t{i}" for i in range(4)}
+    # Recovery: a fresh orchestrator re-groups (affinity restored from
+    # the sidecar) and completes every job bit-identically.
+    ctx2, orch2 = make_dev_orch(
+        tmp_path, lanes=4, iterations=4, sub="serve",
+    )
+    assert orch2._prior_waves.get("t0") == key
+    for j in jobs:
+        orch2.submit(ServeJob(
+            job_id=j.job_id, sbox_path=j.sbox_path, output=j.output,
+            seed=j.seed,
+        ))
+    assert orch2._jobs["t0"].last_wave == key
+    orch2.start()
+    view2 = orch2.run_until_idle(timeout_s=240)
+    orch2.stop()
+    assert view2["counts"][DONE] == 4, view2
+    for j in jobs:
+        ref = dev_standalone_digests(
+            str(tmp_path / f"ref-{j.job_id}"), j.sbox_path, j.output,
+            int(j.seed), iterations=4,
+        )
+        got = xml_digests(os.path.join(orch.root, j.job_id))
+        assert got == ref, f"{j.job_id} diverged across the drain"
+
+
+def test_serve_no_merge_env_and_param(tmp_path, monkeypatch):
+    """The opt-out lever: merge=False (or SBG_SERVE_NO_MERGE=1) keeps
+    per-job dispatch streams — no waves form, results unchanged."""
+    ctx, orch = make_dev_orch(tmp_path, lanes=4, merge=False)
+    assert orch.merge is False
+    monkeypatch.setenv("SBG_SERVE_NO_MERGE", "1")
+    ctx2, orch2 = make_dev_orch(tmp_path, lanes=4, sub="s2")
+    assert orch2.merge is False
+    monkeypatch.delenv("SBG_SERVE_NO_MERGE")
+    ctx3, orch3 = make_dev_orch(tmp_path, lanes=1, sub="s3")
+    assert orch3.merge is False  # one lane can never form a wave
 
 
 def test_jobview_isolation(tmp_path):
