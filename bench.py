@@ -2812,6 +2812,173 @@ def bench_serve(n_jobs: int = None) -> list:
     return out
 
 
+def _run_store_serve_arm(root, store_dir, jobs, lanes=4, seed=9,
+                         dev=False, batches=1):
+    """One serve arm with a result store attached (``jobs`` is a list of
+    per-batch job lists when ``batches`` > 1 — later batches admit after
+    the earlier ones completed and their results flushed to the store).
+    Returns (wall_s, view, stats, orch)."""
+    from sboxgates_tpu.resilience.deadline import DeadlineConfig
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.serve import ServeJob, ServeOrchestrator
+
+    opts = dict(seed=seed, result_store=store_dir)
+    if dev:
+        opts.update(
+            lut_graph=True, randomize=False, host_small_steps=False,
+            native_engine=False, warmup=False,
+        )
+    ctx = SearchContext(Options(**opts))
+    orch = ServeOrchestrator(
+        ctx, root, lanes=lanes,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.05),
+        log=lambda s: None,
+    )
+    batched = jobs if batches > 1 else [jobs]
+    t0 = time.perf_counter()
+    view = None
+    for batch in batched:
+        for job_id, path, output, tenant, permute in batch:
+            orch.submit(ServeJob(
+                job_id=job_id, sbox_path=path, output=output,
+                tenant=tenant, permute=permute,
+            ))
+        orch.start()
+        view = orch.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+        ctx.result_store.flush()
+    wall = time.perf_counter() - t0
+    orch.stop()
+    return wall, view, ctx.stats, orch
+
+
+def bench_store() -> list:
+    """``bench.py --store``: the content-addressed result store
+    (BENCH_STORE.json).
+
+    1. ``store_cold_vs_warm`` — the device-routed toy job set COLD (all
+       misses: real searches, real dispatches) then WARM in a fresh
+       serve root against the now-populated store: warm-hit latency
+       (the ``store_get_s`` histogram: canonicalize + read + rewrite +
+       all-2^8-inputs re-verify) vs the cold search wall, and the p99
+       time-to-first-hit delta between the arms.  Structurally gated —
+       machine-independent by construction: every warm job hit, the
+       warm arm issued ZERO device dispatches, and every hit circuit is
+       bit-identical to the cold run's.
+    2. ``store_hit_ratio`` — a repeat-heavy tenant mix in two admission
+       batches: batch 2 re-submits six of batch 1's queries EXACTLY
+       from other tenants (different job ids, different seeds —
+       full-circuit hits don't depend on the seed), one in a different
+       CANONICAL frame (the same S-box bit under an input XOR-permute —
+       the cross-frame merge the canonical keys exist for), and one
+       truly novel S-box; gate: exactly the seven repeats hit.
+    """
+    import shutil
+    import tempfile
+
+    from sboxgates_tpu.search.serve import DONE
+
+    work = tempfile.mkdtemp(prefix="sbg-store-bench-")
+    out = []
+    try:
+        store_dir = os.path.join(work, "store")
+        n = 8
+        paths = _toy_serve_files(work, n)
+        tjobs = [
+            (f"t{i:02d}", p, 0, f"ten{i % 3}", 0)
+            for i, p in enumerate(paths)
+        ]
+        cwall, cview, cstats, corch = _run_store_serve_arm(
+            os.path.join(work, "cold"), store_dir, tjobs, dev=True,
+        )
+        wwall, wview, wstats, worch = _run_store_serve_arm(
+            os.path.join(work, "warm"), store_dir, tjobs, dev=True,
+        )
+        import hashlib as _hl
+
+        def _digests(root, job_id):
+            d = os.path.join(root, job_id)
+            return {
+                f: _hl.sha256(
+                    open(os.path.join(d, f), "rb").read()
+                ).hexdigest()
+                for f in sorted(os.listdir(d)) if f.endswith(".xml")
+            }
+
+        identical = all(
+            all(
+                _digests(corch.root, j[0]).get(f) == dg
+                for f, dg in _digests(worch.root, j[0]).items()
+            )
+            for j in tjobs
+        )
+        get_h = wstats.histograms().get("store_get_s", {})
+        cttfh = cstats.histograms().get("job_time_to_first_hit_s", {})
+        wttfh = wstats.histograms().get("job_time_to_first_hit_s", {})
+        out.append({
+            "metric": "store_cold_vs_warm", "jobs": n,
+            "value": round(cwall / max(wwall, 1e-9), 2),
+            "unit": "cold-search wall / warm-hit wall (same job set)",
+            "all_hits": int(wstats.get("store_hits", 0)) == n,
+            "zero_device_dispatches": int(
+                wstats.get("device_dispatches", 0)
+            ) == 0,
+            "bit_identical": bool(
+                identical
+                and cview["counts"][DONE] == n
+                and wview["counts"][DONE] == n
+            ),
+            "cold_wall_s": round(cwall, 3),
+            "warm_wall_s": round(wwall, 3),
+            "hit_p50_s": get_h.get("p50"),
+            "hit_p99_s": get_h.get("p99"),
+            "p99_ttfh_s_cold": cttfh.get("p99"),
+            "p99_ttfh_s_warm": wttfh.get("p99"),
+            "device_dispatches_cold": int(
+                cstats.get("device_dispatches", 0)
+            ),
+            "store_puts_cold": int(cstats.get("store_puts", 0)),
+        })
+        # Arm 2: the repeat-heavy mix.  Seven of batch 2's eight
+        # queries repeat batch 1: six exactly (other tenants/seeds) and
+        # one in a different CANONICAL frame — 'c0' asks for the same
+        # DES bit under an input XOR-permute, which the canonical keys
+        # merge by design.  'n0' (a different S-box) is the one true
+        # novelty.
+        des = os.path.join(HERE, "sboxes", "des_s1.txt")
+        fa = os.path.join(HERE, "sboxes", "crypto1_fa.txt")
+        batch1 = [
+            (f"a{i}", des, i, "acme", 0) for i in range(4)
+        ]
+        batch2 = [
+            ("b0", des, 0, "blue", 0), ("b1", des, 1, "blue", 0),
+            ("b2", des, 2, "core", 0), ("b3", des, 3, "core", 0),
+            ("b4", des, 0, "dine", 0), ("b5", des, 1, "dine", 0),
+            ("c0", des, 0, "core", 1), ("n0", fa, 0, "blue", 0),
+        ]
+        rwall, rview, rstats, rorch = _run_store_serve_arm(
+            os.path.join(work, "ratio"), os.path.join(work, "store2"),
+            [batch1, batch2], batches=2,
+        )
+        hits = int(rstats.get("store_hits", 0))
+        total = len(batch1) + len(batch2)
+        out.append({
+            "metric": "store_hit_ratio",
+            "jobs": total,
+            "value": round(hits / len(batch2), 3),
+            "unit": "hit ratio over the repeat batch (7 of 8 repeat: "
+                    "6 exact + 1 canonical-frame)",
+            "ratio_ok": bool(
+                hits == 7 and rview["counts"][DONE] == total
+            ),
+            "store_hits": hits,
+            "store_misses": int(rstats.get("store_misses", 0)),
+            "wall_s": round(rwall, 3),
+        })
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def bench_roofline() -> list:
     """Measured roofline placement for EVERY kernel in the ``KERNELS``
     registry (BENCH_ROOFLINE.json) — the maintained successor to
@@ -3061,6 +3228,21 @@ BENCH_CHECKS = {
             ("serve_chained", "combined_ratio_ok", 0.0, "exact"),
         ],
     ),
+    "store": (
+        # The result-store drift gate: structural, machine-independent
+        # fields only — every warm query hit, the hit path issued ZERO
+        # device dispatches, hit circuits byte-equal the cold search's,
+        # and the repeat-heavy mix hit exactly its repeats.
+        bench_store,
+        "BENCH_STORE.json",
+        [
+            ("store_cold_vs_warm", "all_hits", 0.0, "exact"),
+            ("store_cold_vs_warm", "zero_device_dispatches",
+             0.0, "exact"),
+            ("store_cold_vs_warm", "bit_identical", 0.0, "exact"),
+            ("store_hit_ratio", "ratio_ok", 0.0, "exact"),
+        ],
+    ),
     "hoststream": (
         bench_host_stream_pipeline,
         "BENCH_PIPELINE.json",
@@ -3225,6 +3407,21 @@ def main() -> None:
         with open(os.path.join(HERE, "BENCH_SERVE.json"), "w") as f:
             json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[1]))
+        return
+    if "--store" in sys.argv and "--check" not in sys.argv:
+        # Standalone mode: the content-addressed result store A/B
+        # (cold-miss search vs warm-hit lookup, hit latency quantiles,
+        # repeat-heavy hit ratio, p99 ttfh delta), written to
+        # BENCH_STORE.json.  CPU-safe.
+        if SMOKE or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_store()
+        with open(os.path.join(HERE, "BENCH_STORE.json"), "w") as f:
+            json.dump(with_meta(detail), f, indent=1)
+        print(json.dumps(detail[0]))
         return
     if "--device-rounds" in sys.argv:
         # Standalone mode: fused multi-round driver vs the per-round

@@ -160,6 +160,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "a killed run can continue with --resume-run, and "
                         "an explicitly-set DIR also hosts the persistent "
                         "XLA compile cache (DIR/xla_cache)")
+    p.add_argument("--result-store", default=None, metavar="DIR",
+                   help="content-addressed global result store "
+                        "(default: SBG_RESULT_STORE; empty string "
+                        "disables): finished circuits (and interrupted-"
+                        "search frontiers) are durably published to DIR "
+                        "keyed on the CANONICAL form of (target, mask, "
+                        "metric) — input permutation/negation and "
+                        "output complement — and serve-mode admission "
+                        "answers repeat queries from DIR in "
+                        "milliseconds with zero device dispatches (the "
+                        "stored circuit is re-verified against the "
+                        "original query over all 2^8 inputs first); an "
+                        "unwritable DIR degrades to read-only lookups "
+                        "with a logged note")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory "
                         "(default: SBG_COMPILE_CACHE, else xla_cache/ "
@@ -274,6 +288,10 @@ JOURNAL_CONFIG_KEYS = (
     # draws with per-round seed blocks, so it shapes the draw stream
     # and must be restored on resume.
     "chain_rounds",
+    # Result store: never shapes the draw stream of a search that runs
+    # (a store hit simply doesn't search), but a resumed run must keep
+    # publishing to — and consulting — the same store.
+    "result_store",
 )
 
 #: Keys added to JOURNAL_CONFIG_KEYS after a journal version shipped:
@@ -289,6 +307,7 @@ JOURNAL_KEY_DEFAULTS = {
     "serve_retries": 2,
     "serve_timeout": None,
     "chain_rounds": 0,
+    "result_store": None,
 }
 
 
@@ -301,6 +320,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     outdir_explicit = (
         args.output_dir is not None or args.resume_run is not None
     )
+
+    # Result store: the SBG_RESULT_STORE environment default applies
+    # only when the flag is absent (an explicit empty string disables);
+    # a --resume-run restores the journaled value below instead.
+    if args.result_store is None and args.resume_run is None:
+        args.result_store = os.environ.get("SBG_RESULT_STORE") or None
+    elif args.result_store == "":
+        args.result_store = None
 
     # Resume: restore the original run configuration from the journal
     # BEFORE validation — `--resume-run DIR` alone must suffice.
@@ -410,6 +437,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _err(
             "--fleet and --serial-jobs are incompatible: the fleet's "
             "whole point is merging the jobs' dispatches."
+        )
+    if args.result_store is not None and (
+        args.convert_c or args.convert_dot
+    ):
+        return _err(
+            "--result-store has no effect on -c/-d conversion; drop it."
+        )
+    if args.result_store is not None and args.serve and (
+        args.output_dir is None
+    ):
+        return _err(
+            "--result-store on a serve run requires an explicit "
+            "--output-dir: store hits land as per-job artifacts under "
+            "DIR/<job-id>/."
         )
     if args.serve:
         # Serve mode owns scheduling and execution shape; every other
@@ -706,6 +747,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet_candidates=args.fleet_candidates,
         fleet_max_wave=args.fleet_max_wave,
         chain_rounds=args.chain_rounds,
+        result_store=args.result_store,
         # jaxlint: ignore[R7] telemetry is observation-only (zero-sync counter-asserted)
         trace=args.trace is not None,
         # jaxlint: ignore[R7] live-introspection endpoint; observation-only, never shapes the draw stream
@@ -816,6 +858,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except RuntimeError as e:
             return _err(f"Error: {e}")
     ctx = SearchContext(opt, mesh_plan=mesh_plan, fleet_plan=fleet_plan)
+    if ctx.result_store is not None:
+        note = " (read-only)" if ctx.result_store.readonly else ""
+        log(f"Result store: {args.result_store}{note}")
 
     # Telemetry wiring: rank-scoped directory (heartbeat JSONL + flight
     # dumps live under shard-NN/ for every non-primary or job-sharded
@@ -912,6 +957,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # Bounded join; a worker parked in a hung backend compile is
             # a daemon and never blocks exit.
             ctx.warmer.shutdown()
+        if ctx.result_store is not None:
+            # Drains the store's background writer so every queued
+            # publish is durable before the process exits.
+            ctx.result_store.close()
         if heartbeat is not None:
             # Final heartbeat line + the atomic end-of-run metrics.json
             # snapshot (counters + histograms) bench.py consumes.

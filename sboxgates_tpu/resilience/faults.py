@@ -24,6 +24,9 @@ the ones production code fires today):
 ``serve.requeue``         requeuing a preempted/failed serve job
 ``serve.drain``           entering a serve-mode graceful drain
 ``serve.wave``            a lane entering its merged serve wave
+``store.get``             entering a result-store lookup
+``store.put``             before a result-store entry write
+``store.index``           before a result-store index append
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -90,6 +93,9 @@ KNOWN_SITES = (
     "serve.requeue",
     "serve.drain",
     "serve.wave",
+    "store.get",
+    "store.put",
+    "store.index",
 )
 
 
@@ -160,6 +166,13 @@ def _current_job() -> Optional[str]:
     if job is not None:
         return job
     return os.environ.get("SBG_FAULT_JOB")
+
+
+def current_job() -> Optional[str]:
+    """The calling thread's :func:`set_job` pin (no env fallback) —
+    for carrying the pin onto work handed to another thread (the result
+    store's background writer keeps publishes @job:ID-targetable)."""
+    return getattr(_job_local, "job", None)
 
 
 def _process_rank() -> int:

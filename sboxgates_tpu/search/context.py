@@ -246,6 +246,16 @@ class Options:
     # host-side events only (zero extra device syncs) and results are
     # identical on or off.
     trace: bool = False
+    # Content-addressed global result store (--result-store /
+    # SBG_RESULT_STORE, sboxgates_tpu/store/): a durable store of
+    # finished, verified circuits (and interrupted-search frontiers)
+    # keyed on the CANONICAL form of (target, mask, metric).  Searches
+    # PUBLISH results here on completion; serve-mode admission CONSULTS
+    # it first, answering repeat queries from disk with zero device
+    # dispatches.  Never shapes the draw stream of a search that runs
+    # (hit jobs simply don't search); journaled so --resume-run
+    # restores the same publishing target.  None = off.
+    result_store: Optional[str] = None
     # Live status endpoint (--status-port, telemetry.status): serve a
     # read-only /status JSON snapshot (counters, histogram quantiles,
     # search-space coverage + ETA, warmup/breaker state, attribution
@@ -508,6 +518,19 @@ class SearchContext:
         # reads through status_state().  Plain int store: atomic, and
         # deliberately outside the stats registry (merge() sums).
         self.last_dispatch_gates: Optional[int] = None
+        # Content-addressed result store (Options.result_store): shared
+        # BY REFERENCE with every RestartContext/JobView (one writer
+        # thread, lock-protected entries), like the table caches.  The
+        # orchestrator drivers publish finished circuits through it and
+        # serve-mode admission consults it.  Deferred import: the store
+        # package never imports search, keeping the layering acyclic.
+        self.result_store = None
+        if opt.result_store:
+            from ..store import ResultStore
+
+            self.result_store = ResultStore(
+                opt.result_store, stats=self.stats
+            )
 
     # -- helpers ----------------------------------------------------------
 

@@ -64,6 +64,37 @@ def make_targets(sbox: np.ndarray) -> List[np.ndarray]:
     return [tt.target_table(sbox, bit) for bit in range(8)]
 
 
+def _store_publish_one(ctx, results, target, mask, output: int) -> None:
+    """Completion hook for the one-output driver: publishes the BEST
+    finished circuit (results are best-last) into the content-addressed
+    result store, keyed canonically, plus the LUT-decomposition
+    sub-tables in LUT mode.  Asynchronous and best-effort — the store
+    never touches the search result."""
+    store = getattr(ctx, "result_store", None)
+    if store is None or not results:
+        return
+    store.put_state(
+        results[-1], target, mask, ctx.opt.metric, output=output,
+        sub_tables=ctx.opt.lut_graph,
+        meta={"output_bit": output},
+    )
+
+
+def _store_publish_graph(ctx, states, targets, num_outputs, mask) -> None:
+    """Completion hook for the all-outputs drivers: the final state
+    under its exact multi-output key, plus one canonical single-output
+    entry per bound output (its cone) and the LUT sub-tables — so later
+    one-output queries for any bit of this S-box, in any equivalent
+    frame, hit."""
+    store = getattr(ctx, "result_store", None)
+    if store is None or not states:
+        return
+    store.put_multi(
+        states[0], [targets[o] for o in range(num_outputs)], mask,
+        ctx.opt.metric, sub_tables=ctx.opt.lut_graph,
+    )
+
+
 def sbox_num_outputs(targets) -> int:
     for i in range(7, -1, -1):
         if (targets[i] != 0).any():
@@ -105,6 +136,7 @@ def generate_graph_one_output(
     # loop there, like the multibox drivers' _auto_batched.  Fleet
     # contexts take the same driver — run_batched_circuits reroutes the
     # wave through the fleet dispatcher (search/fleet.py).
+    mask = tt.mask_table(st.num_inputs)
     if (
         (opt.batch_restarts or opt.fleet or ctx.fleet_plan is not None)
         and opt.iterations > 1
@@ -112,11 +144,12 @@ def generate_graph_one_output(
     ):
         from .batched import generate_graph_one_output_batched
 
-        return generate_graph_one_output_batched(
+        results = generate_graph_one_output_batched(
             ctx, st, targets, output, save_dir=save_dir, log=log,
             journal=journal,
         )
-    mask = tt.mask_table(st.num_inputs)
+        _store_publish_one(ctx, results, targets[output], mask, output)
+        return results
     results = []
     start_it = 0
     if journal is not None:
@@ -164,6 +197,7 @@ def generate_graph_one_output(
             "run_done",
             beam=[state_filename(s) for s in results],
         )
+    _store_publish_one(ctx, results, targets[output], mask, output)
     return results
 
 
@@ -298,6 +332,7 @@ def generate_graph(
         journal.append(
             "run_done", beam=[state_filename(s) for s in start_states]
         )
+    _store_publish_graph(ctx, start_states, targets, num_outputs, mask)
     return start_states
 
 
@@ -333,6 +368,7 @@ def _generate_graph_chained(
         save_state(st, save_dir)
     if journal is not None:
         journal.append("run_done", beam=[state_filename(st)])
+    _store_publish_graph(ctx, [st], targets, num_outputs, mask)
     return [st]
 
 

@@ -74,6 +74,26 @@ job), and ``serve.drain`` entering the drain.  The chaos matrix in
 tests/test_serve.py drives randomized preempt/kill/requeue schedules
 through these sites and asserts bit-identical final circuits.
 
+**Result store** (``Options.result_store`` / ``--result-store``): when
+the shared context carries a content-addressed result store
+(``sboxgates_tpu.store``), admission CONSULTS it before scheduling — a
+FULL hit (any query equivalent under input permutation/negation and
+output complement to a stored circuit) is re-verified against the
+original query over all 2^8 inputs and admitted straight to DONE with
+zero device dispatches: the job's directory gets the circuit checkpoint
+and a completed journal, the queue is never entered (the status view
+marks the row ``store=hit``), and ttfh is observed at admission — the
+cache-hit latency the bench's p99 delta measures.  A PARTIAL hit (the
+stored frontier of an interrupted search with the same seed and
+draw-shaping configuration) seeds the job directory with the frontier's
+journal records and checkpoints before queueing, so the ordinary
+resume path continues the search bit-identically — the PR 3 exact-resume
+contract, applied ACROSS PROCESSES via the store.  Completions publish
+back automatically through the driver hooks (`search.orchestrator`),
+and a graceful drain publishes each preempted job's frontier.  Store
+failures of every shape (injected ``store.*`` faults, torn entries,
+failed verification) degrade to miss-and-search.
+
 Threads: one scheduler (:meth:`ServeOrchestrator._work`) plus one
 worker per running job (:meth:`ServeOrchestrator._run_job`), both
 pinned in ``[tool.jaxlint] thread_roots``.  All shared orchestrator
@@ -95,14 +115,16 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..graph.state import State
+from ..core import ttable as tt
+from ..graph.state import NO_GATE, State
 from ..resilience import faults
+from ..resilience.checkpoint import durable_write_text
 from ..resilience.deadline import DeadlineConfig, DispatchTimeout
-from ..resilience.journal import SearchJournal
+from ..resilience.journal import JOURNAL_NAME, JOURNAL_VERSION, SearchJournal
 from ..telemetry import flight as _tflight
 from ..telemetry import trace as _ttrace
 from ..telemetry.heartbeat import Heartbeat
-from ..utils.sbox import SboxError, load_sbox
+from ..utils.sbox import SboxError, load_sbox, num_outputs
 from .context import SearchContext, bucket_size
 from .orchestrator import (
     generate_graph,
@@ -212,6 +234,10 @@ class ServeJob:
     #: sidecar on resume) — the scheduler clusters jobs sharing it so a
     #: drained wave re-groups deterministically.
     last_wave: str = ""
+    #: Result-store outcome for the status view: "hit" (answered from
+    #: the store at admission, queue skipped) or "partial" (search
+    #: seeded from a stored frontier); None = ordinary miss-and-search.
+    store: Optional[str] = None
     _preempt: threading.Event = field(default_factory=threading.Event)
 
     @property
@@ -347,6 +373,10 @@ class ServeOrchestrator:
         if merge is None:
             merge = os.environ.get("SBG_SERVE_NO_MERGE", "0") != "1"
         self.merge = bool(merge) and self.lanes >= 2
+        #: Content-addressed result store (ctx.result_store, built from
+        #: Options.result_store): admission consults it, drains publish
+        #: frontiers back; None = every query searches.
+        self.store = getattr(ctx, "result_store", None)
         self._cv = threading.Condition()
         self._jobs: Dict[str, ServeJob] = {}
         self._seq = 0
@@ -383,25 +413,59 @@ class ServeOrchestrator:
     def submit(self, job: ServeJob) -> ServeJob:
         """Admits one job; raises :class:`ServeClosed` after drain().
         The ``serve.admit`` fault site fires BEFORE any state mutation,
-        so an injected admission failure is loud and loses nothing."""
+        so an injected admission failure is loud and loses nothing.
+
+        With a result store attached, admission consults it first: a
+        full hit is admitted straight to DONE (circuit re-verified
+        against the original query, zero device dispatches, queue
+        skipped); a partial hit seeds the job directory with the stored
+        frontier before the job queues normally."""
         faults.fault_point("serve.admit")
+        # Submission time is captured BEFORE the store consult: ttfh
+        # must include the consult itself (canonicalize + read +
+        # rewrite + re-verify) — that IS the cache-hit latency the
+        # tenant sees.
+        t_sub = time.perf_counter()
         if job.seed is None:
             job.seed = job_seed(self.ctx.opt.seed or 0, job.job_id)
-        if not job.bucket:
-            # Warm-affinity seed value: a fresh job sweeps at its input
-            # count; preemption updates this to the live gate bucket.
-            # An unreadable table only costs grouping quality here — the
-            # worker's own load_sbox surfaces the real error through the
+        with self._cv:
+            if self._draining:
+                raise ServeClosed(
+                    f"serve queue is draining; job {job.job_id!r} rejected"
+                )
+            if job.job_id in self._jobs:
+                raise ValueError(f"duplicate job id {job.job_id!r}")
+        sbox = n_in = None
+        if not job.bucket or self.store is not None:
+            # Warm-affinity seed value (a fresh job sweeps at its input
+            # count; preemption updates this to the live gate bucket)
+            # AND the store-consult query shape.  An unreadable table
+            # only costs grouping/caching quality here — the worker's
+            # own load_sbox surfaces the real error through the
             # retry/quarantine path.
             try:
-                _, num_inputs = load_sbox(job.sbox_path, job.permute)
-                job.bucket = bucket_size(num_inputs)
+                sbox, n_in = load_sbox(job.sbox_path, job.permute)
+                if not job.bucket:
+                    job.bucket = bucket_size(n_in)
             except (OSError, SboxError) as e:
                 logger.warning(
                     "serve admit: cannot size job %s from %s (%r); "
                     "defaulting its bucket", job.job_id, job.sbox_path, e,
                 )
-                job.bucket = bucket_size(8)
+                if not job.bucket:
+                    job.bucket = bucket_size(8)
+        hit = None
+        if self.store is not None and sbox is not None:
+            # The store consult runs OUTSIDE the lock (canonicalize +
+            # disk read + all-2^8-inputs re-verify; host-side numpy
+            # only, zero device dispatches).  The job pin makes the
+            # store.* chaos sites @job:ID-targetable here, like every
+            # worker-side site.
+            faults.set_job(job.job_id)
+            try:
+                hit = self._consult_store(job, sbox, n_in)
+            finally:
+                faults.set_job(None)
         now = time.perf_counter()
         with self._cv:
             if self._draining:
@@ -412,16 +476,35 @@ class ServeOrchestrator:
                 raise ValueError(f"duplicate job id {job.job_id!r}")
             self._seq += 1
             job.seq = self._seq
-            job.state = QUEUED
-            job.submitted_t = now
+            job.submitted_t = t_sub
             job.enqueued_t = now
             if not job.last_wave:
                 # Resume affinity: a prior run's drained wave re-groups
                 # deterministically (the waves sidecar is the record).
                 job.last_wave = self._prior_waves.get(job.job_id, "")
+            if hit is not None:
+                job.state = DONE
+                job.store = "hit"
+                job.first_hit_t = job.finished_t = time.perf_counter()
+                job.result_count = 1
+            else:
+                job.state = QUEUED
             self._jobs[job.job_id] = job
             self.ctx.stats.inc("serve_jobs_admitted")
             self._cv.notify_all()
+        if hit is not None:
+            # ttfh/job_seconds observed at admission: the cache-hit
+            # latency the tenant sees (the bench's p99-delta numerator).
+            self.ctx.stats.observe(
+                "job_time_to_first_hit_s", job.first_hit_t - job.submitted_t
+            )
+            self.ctx.stats.observe(
+                "job_seconds", job.finished_t - job.submitted_t
+            )
+            self.log(
+                f"serve: job {job.job_id} served from the result store "
+                "(1 state)"
+            )
         return job
 
     # -- lifecycle ---------------------------------------------------------
@@ -473,6 +556,22 @@ class ServeOrchestrator:
             self._scheduler = None
         for t in list(self._workers.values()):
             t.join(max(0.0, deadline - time.perf_counter()) + 1.0)
+        if self.store is not None:
+            # Publish every interrupted job's frontier (journal snapshot
+            # + referenced checkpoints) back to the result store AFTER
+            # the workers have landed their final journal records: an
+            # equivalent query in another process resumes from here.
+            with self._cv:
+                pending = [
+                    j for j in self._jobs.values()
+                    if j.state not in TERMINAL
+                ]
+            for j in pending:
+                faults.set_job(j.job_id)
+                try:
+                    self._publish_frontier(j)
+                finally:
+                    faults.set_job(None)
         return self.status_view()
 
     def run_until_idle(self, timeout_s: Optional[float] = None) -> dict:
@@ -722,6 +821,194 @@ class ServeOrchestrator:
                 "resume re-grouping degrades to FIFO", job.job_id, e,
             )
 
+    # -- result store ------------------------------------------------------
+
+    def _job_config(self, job: ServeJob) -> dict:
+        """The per-job journal run_start configuration (one shape for
+        the worker's journal, a hit's completed journal, and a seeded
+        frontier's materialized journal)."""
+        return {
+            "job": job.job_id,
+            "sbox": os.path.abspath(job.sbox_path),
+            "output": job.output,
+            "seed": int(job.seed),
+            "tenant": job.tenant,
+            "iterations": self.ctx.opt.iterations,
+        }
+
+    def _frontier_cfg(self, job: ServeJob) -> dict:
+        """The draw-shaping configuration a frontier entry binds:
+        frontiers embed PRNG state, so a stored frontier may only seed a
+        search that would consume the exact same draw stream."""
+        opt = self.ctx.opt
+        return {
+            "seed": int(job.seed),
+            "output": job.output,
+            "permute": job.permute,
+            "iterations": opt.iterations,
+            "metric": opt.metric,
+            "lut": opt.lut_graph,
+            "randomize": opt.randomize,
+            "batch": opt.batch_restarts,
+            "chain_rounds": opt.chain_rounds,
+        }
+
+    def _consult_store(self, job: ServeJob, sbox, n_in: int):
+        """The admission-time store consult (no locks held).  A FULL
+        hit writes the job's artifacts (checkpoint + completed journal)
+        and returns the hit; a PARTIAL hit seeds the job directory with
+        the stored frontier and returns None (the job queues normally);
+        a miss returns None.  Every failure shape degrades to a miss —
+        the store can only ever save work, never lose a job."""
+        job_dir = self._job_dir(job)
+        # A job directory that already journaled locally resumes from
+        # its OWN journal (the restarted-serve-run case); a store
+        # frontier must not clobber that strictly-newer local state.
+        has_local = os.path.exists(os.path.join(job_dir, JOURNAL_NAME))
+        fcfg = None if has_local else self._frontier_cfg(job)
+        mask = tt.mask_table(n_in)
+        metric = self.ctx.opt.metric
+        if job.output >= 0:
+            target = tt.target_table(sbox, job.output)
+            kind, val = self.store.fetch(
+                target, mask, metric, frontier_cfg=fcfg
+            )
+        else:
+            try:
+                n_out = num_outputs(sbox, n_in)
+            except SboxError:
+                return None
+            targets = make_targets(sbox)[:n_out]
+            kind, val = self.store.fetch_multi(
+                targets, mask, metric, frontier_cfg=fcfg
+            )
+        if kind == "hit":
+            try:
+                self._finish_hit(job, val)
+                return val
+            except OSError as e:
+                logger.warning(
+                    "serve: cannot land store hit for %s (%r); "
+                    "searching instead", job.job_id, e,
+                )
+                return None
+        if kind == "partial":
+            self._seed_frontier(job, val)
+        return None
+
+    def _finish_hit(self, job: ServeJob, hit) -> None:
+        """Lands a full store hit as ordinary job artifacts: the
+        re-verified circuit as a durable checkpoint and a COMPLETED
+        per-job journal, so the job directory is indistinguishable from
+        a finished search (and a resumed serve run sees it as done)."""
+        from ..graph.xmlio import save_state
+
+        job_dir = self._job_dir(job)
+        os.makedirs(job_dir, exist_ok=True)
+        st = hit.state
+        if job.output >= 0:
+            # Entries are normalized to output bit 0; rebind to the
+            # queried bit (for an exact repeat this reproduces the
+            # publisher's file byte-for-byte).
+            gid = st.outputs[0]
+            st.outputs = [NO_GATE] * 8
+            st.outputs[job.output] = gid
+        journal = SearchJournal.start(
+            job_dir, dict(self._job_config(job), store="hit")
+        )
+        ckpt = save_state(st, job_dir)
+        journal.append(
+            "run_done", beam=[os.path.basename(ckpt)], store="hit"
+        )
+
+    def _seed_frontier(self, job: ServeJob, body: dict) -> None:
+        """Materializes a stored interrupted-search frontier into the
+        job directory — checkpoints plus a journal whose progress
+        records are the stored snapshot — so the worker's ordinary
+        resume path continues the search bit-identically (the PR 3
+        contract, applied across processes via the store)."""
+        job_dir = self._job_dir(job)
+        try:
+            os.makedirs(job_dir, exist_ok=True)
+            for fname, xml in body.get("checkpoints", {}).items():
+                fname = os.path.basename(fname)
+                durable_write_text(os.path.join(job_dir, fname), xml)
+            run_start = {
+                "seq": 0, "type": "run_start",
+                "version": JOURNAL_VERSION,
+                "config": dict(self._job_config(job), store="partial"),
+            }
+            lines = [_json.dumps(run_start, sort_keys=True)]
+            lines.extend(
+                _json.dumps(rec, sort_keys=True)
+                for rec in body.get("records", [])
+            )
+            durable_write_text(
+                os.path.join(job_dir, JOURNAL_NAME),
+                "\n".join(lines) + "\n",
+            )
+            job.store = "partial"
+        except OSError as e:
+            logger.warning(
+                "serve: cannot seed frontier for %s (%r); searching "
+                "from scratch", job.job_id, e,
+            )
+
+    def _publish_frontier(self, job: ServeJob) -> None:
+        """Publishes a drained job's journal snapshot (progress records
+        + the checkpoint bodies they reference) as a store frontier, so
+        an equivalent query in ANOTHER process resumes from here."""
+        store = self.store
+        if store is None or store.readonly:
+            return
+        job_dir = self._job_dir(job)
+        records = SearchJournal.load_records(job_dir)
+        if (
+            len(records) < 2
+            or records[0].get("type") != "run_start"
+            or any(r.get("type") == "run_done" for r in records)
+        ):
+            return
+        ckpts = {}
+        for rec in records:
+            names = []
+            if rec.get("ckpt"):
+                names.append(rec["ckpt"])
+            names.extend(rec.get("beam") or [])
+            for nm in names:
+                nm = os.path.basename(nm)
+                if nm in ckpts:
+                    continue
+                try:
+                    with open(
+                        os.path.join(job_dir, nm), encoding="utf-8"
+                    ) as f:
+                        ckpts[nm] = f.read()
+                except OSError:
+                    return  # incomplete frontier: don't publish
+        try:
+            sbox, n_in = load_sbox(job.sbox_path, job.permute)
+        except (OSError, SboxError):
+            return
+        mask = tt.mask_table(n_in)
+        metric = self.ctx.opt.metric
+        cfg = self._frontier_cfg(job)
+        meta = {"job": job.job_id, "tenant": job.tenant}
+        if job.output >= 0:
+            store.put_frontier(
+                tt.target_table(sbox, job.output), mask, metric, cfg,
+                records[1:], ckpts, meta=meta,
+            )
+        else:
+            try:
+                n_out = num_outputs(sbox, n_in)
+            except SboxError:
+                return
+            store.put_frontier(
+                None, mask, metric, cfg, records[1:], ckpts,
+                multi=make_targets(sbox)[:n_out], meta=meta,
+            )
+
     # -- the worker --------------------------------------------------------
 
     def _job_dir(self, job: ServeJob) -> str:
@@ -861,7 +1148,9 @@ class ServeOrchestrator:
             self.log(f"serve: job {job.job_id} preempted ({e})")
             if self._draining and view is not None:
                 # Drain artifacts: the flight dump lands IN the job's
-                # directory (the heartbeat/metrics.json below do too).
+                # directory (the heartbeat/metrics.json below do too;
+                # the interrupted search's frontier is published by
+                # drain() once every worker has landed).
                 _tflight.flight_dump(
                     "serve_drain", registry=view.stats,
                     directory=job_dir, extra={"job": job.job_id},
@@ -980,6 +1269,10 @@ class ServeOrchestrator:
                 }
                 if j.wave_id is not None:
                     row["wave"] = j.wave_id
+                if j.store is not None:
+                    # Cache-hit jobs visibly skip the queue; frontier-
+                    # seeded jobs visibly resume mid-search.
+                    row["store"] = j.store
                 if j.state == QUEUED:
                     row["queue_wait_s"] = round(now - j.enqueued_t, 3)
                 if j.state == RUNNING and j.started_t is not None:
@@ -998,7 +1291,7 @@ class ServeOrchestrator:
                         reg.get("device_dispatches", 0)
                     )
                 jobs[j.job_id] = row
-            return {
+            view = {
                 "schema": SERVE_SCHEMA,
                 "lanes": self.lanes,
                 "lane_bucket": self.lane_bucket,
@@ -1008,3 +1301,6 @@ class ServeOrchestrator:
                 "counts": counts,
                 "jobs": jobs,
             }
+            if self.store is not None:
+                view["store"] = self.store.status_view()
+            return view

@@ -139,6 +139,28 @@ METRICS: Dict[str, MetricDef] = {
         "merged fleet dispatches issued by serve waves (each one device "
         "dispatch serving a whole same-bucket tenant wave's sweeps)",
     ),
+    # content-addressed result store (sboxgates_tpu/store/)
+    "store_hits": MetricDef(
+        COUNTER, "lookups",
+        "queries answered with a stored, re-verified circuit (zero "
+        "device dispatches)",
+    ),
+    "store_misses": MetricDef(
+        COUNTER, "lookups",
+        "queries with no usable store entry (searched normally)",
+    ),
+    "store_partial_hits": MetricDef(
+        COUNTER, "lookups",
+        "queries seeded from a stored interrupted-search frontier",
+    ),
+    "store_puts": MetricDef(
+        COUNTER, "entries", "result-store entries durably published"
+    ),
+    "store_corrupt_quarantined": MetricDef(
+        COUNTER, "entries",
+        "torn or digest-corrupt store entries moved to quarantine/ "
+        "(each one served as a miss, never a crash)",
+    ),
     # histograms (bracketed members inherit the base declaration)
     "device_wait_s": MetricDef(
         HISTOGRAM, "s",
@@ -165,6 +187,12 @@ METRICS: Dict[str, MetricDef] = {
         HISTOGRAM, "lanes",
         "lanes per merged serve wave at formation (how much of the "
         "fleet jobs axis each admission round actually engaged)",
+    ),
+    "store_get_s": MetricDef(
+        HISTOGRAM, "s",
+        "end-to-end result-store lookup latency (canonicalize + read + "
+        "rewrite + all-2^8-inputs re-verify) — the hit path a repeat "
+        "query rides instead of a search",
     ),
     "rounds_per_dispatch": MetricDef(
         HISTOGRAM, "rounds",

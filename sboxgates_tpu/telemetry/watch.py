@@ -194,12 +194,22 @@ def render_serve(serve: dict) -> List[str]:
     then up to :data:`SERVE_MAX_ROWS` per-job rows — state, tenant,
     priority, bucket, retries/preemptions, and the job's ttfh so far."""
     counts = serve.get("counts", {})
+    store = serve.get("store")
     head = (
         f"  serve lanes={serve.get('lanes', '?')}"
         f" bucket={serve.get('lane_bucket', '?')}"
         + (
             f" waves={serve['waves']}"
             if serve.get("merge") and serve.get("waves") else ""
+        )
+        + (
+            # Result-store outcome counts: hit jobs skipped the queue
+            # entirely, so the queue view must say where they went.
+            f" store hit={store.get('hits', 0)}"
+            f"/part={store.get('partial_hits', 0)}"
+            f"/miss={store.get('misses', 0)}"
+            + ("(ro)" if store.get("readonly") else "")
+            if isinstance(store, dict) else ""
         )
         + (" DRAINING" if serve.get("draining") else "")
     )
@@ -223,6 +233,8 @@ def render_serve(serve: dict) -> List[str]:
         ]
         if "wave" in row:
             bits.append(f"wave={row['wave']}")
+        if "store" in row:
+            bits.append(f"store={row['store']}")
         if row.get("failures"):
             bits.append(f"fail={row['failures']}")
         if row.get("preemptions"):

@@ -303,6 +303,84 @@ def test_resume_journal_without_serve_keys(tmp_path, capsys):
     assert "nothing to resume" in out.out
 
 
+def test_result_store_validation_one_line_errors(tmp_path, capsys):
+    """--result-store contract: rejected on -c/-d conversion, and a
+    serve run needs the explicit --output-dir (the specific error names
+    the store) — each a one-line error, no traceback, no stranded
+    journal files."""
+    import shutil
+
+    monkey_dir = str(tmp_path / "store")
+    d = str(tmp_path)
+    files = _run_search(d, ["-i", "1", "-o", "0", "--seed", "5", FA])
+    xml = os.path.join(d, files[0])
+    capsys.readouterr()
+    for argv in (
+        ["-c", xml, "--result-store", monkey_dir],
+        ["-d", xml, "--result-store", monkey_dir],
+        ["--serve", DES, "--result-store", monkey_dir],
+    ):
+        rc = main(argv)
+        assert rc != 0, argv
+        err = capsys.readouterr().err
+        assert err.strip().count("\n") == 0, (argv, err)
+        assert "Traceback" not in err
+        assert "result-store" in err or "result store" in err, err
+    shutil.rmtree(monkey_dir, ignore_errors=True)
+
+
+def test_cli_result_store_publish_then_serve_hit(tmp_path, capsys):
+    """End-to-end through the CLI: a plain search with --result-store
+    publishes its circuit; a serve run against the same store answers
+    the repeat query from it (store=hit journal, store keys journaled,
+    SBG_RESULT_STORE env default honored)."""
+    import json
+
+    store = str(tmp_path / "store")
+    d1 = str(tmp_path / "r1")
+    rc = main([FA, "-i", "1", "-o", "0", "--seed", "5",
+               "--output-dir", d1, "--result-store", store])
+    assert rc == 0, capsys.readouterr().err
+    assert os.path.isdir(os.path.join(store, "objects"))
+    recs = [json.loads(line) for line in
+            open(os.path.join(d1, "search.journal.jsonl"))]
+    assert recs[0]["config"]["result_store"] == store
+    d2 = str(tmp_path / "r2")
+    rc = main([FA, "-o", "0", "--serve", "--seed", "5",
+               "--output-dir", d2, "--result-store", store])
+    assert rc == 0, capsys.readouterr().err
+    jdir = os.path.join(d2, "job00-crypto1_fa")
+    jrecs = [json.loads(line) for line in
+             open(os.path.join(jdir, "search.journal.jsonl"))]
+    assert jrecs[0]["config"]["store"] == "hit"
+    assert any(n.endswith(".xml") for n in os.listdir(jdir))
+
+
+def test_resume_journal_without_result_store_key(tmp_path, capsys):
+    """A version-2 journal written before the result_store key existed
+    resumes with its default (no store — the value every earlier build
+    effectively ran with) instead of being rejected."""
+    import json
+
+    d = str(tmp_path)
+    rc = main([FA, "-i", "1", "-o", "0", "-l", "--seed", "3",
+               "--output-dir", d])
+    assert rc == 0
+    jpath = os.path.join(d, "search.journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    assert "result_store" in recs[0]["config"]
+    del recs[0]["config"]["result_store"]
+    with open(jpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    os.unlink(os.path.join(d, "search.journal.json"))  # stale snapshot
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "incompatible build" not in out.err
+    assert "nothing to resume" in out.out
+
+
 def test_help_exits_zero():
     with pytest.raises(SystemExit) as e:
         main(["--help"])

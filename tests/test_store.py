@@ -1,0 +1,613 @@
+"""Content-addressed result store tests: canonicalization property
+tests (random tables under random input permutations/negations and
+output complement map to ONE key, and stored circuits rewrite back to
+the query frame verified over all 2^8 inputs), corruption and fault
+tolerance (torn/digest-corrupt entries and injected ``store.*`` faults
+degrade to miss-and-search, never a crash), and the serve integration
+acceptance gates: a repeated query is served with ZERO device
+dispatches bit-identically to a fresh search, and a drained search's
+stored frontier resumes bit-identically across processes."""
+
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sboxgates_tpu.core import canon
+from sboxgates_tpu.core import ttable as tt
+from sboxgates_tpu.graph.state import GATES, NO_GATE, State
+from sboxgates_tpu.graph.xmlio import state_to_xml
+from sboxgates_tpu.resilience import faults
+from sboxgates_tpu.resilience.deadline import DeadlineConfig
+from sboxgates_tpu.search import Options, SearchContext
+from sboxgates_tpu.search.orchestrator import (
+    generate_graph_one_output,
+    make_targets,
+)
+from sboxgates_tpu.search.serve import DONE, ServeJob, ServeOrchestrator
+from sboxgates_tpu.store import ResultStore, rewrite_state
+from sboxgates_tpu.store.store import _rebind
+from sboxgates_tpu.utils.sbox import load_sbox
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+DES = os.path.join(DATA, "des_s1.txt")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def random_table(rng, n):
+    bits = np.zeros(tt.TABLE_BITS, dtype=bool)
+    bits[: 1 << n] = rng.integers(0, 2, 1 << n).astype(bool)
+    return tt.from_bits(bits)
+
+
+def random_transform(rng, n):
+    return canon.Transform(
+        tuple(int(v) for v in rng.permutation(n)),
+        tuple(int(v) for v in rng.integers(0, 2, n)),
+        int(rng.integers(0, 2)),
+    )
+
+
+def random_circuit(n, n_gates, seed):
+    r = np.random.default_rng(seed)
+    st = State.init_inputs(n)
+    for _ in range(n_gates):
+        kind = r.integers(0, 3)
+        if kind == 0:
+            a, b = r.choice(st.num_gates, 2, replace=False)
+            st.add_gate(int(r.integers(1, 15)), int(a), int(b), GATES)
+        elif kind == 1 and st.num_gates >= 3:
+            a, b, c = r.choice(st.num_gates, 3, replace=False)
+            st.add_lut(int(r.integers(1, 256)), int(a), int(b), int(c))
+        else:
+            st.add_not_gate(int(r.integers(0, st.num_gates)), GATES)
+    st.outputs[0] = st.num_gates - 1
+    return st
+
+
+def xml_digests(d):
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(d, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(d)) if f.endswith(".xml")
+    }
+
+
+# -- canonicalization ------------------------------------------------------
+
+
+def test_transform_algebra_compose_invert():
+    """apply/compose/invert form a group action on tables."""
+    rng = np.random.default_rng(0)
+    for n in (3, 5, 8):
+        dom = 1 << n
+        for _ in range(12):
+            T = random_table(rng, n)
+            t1, t2 = random_transform(rng, n), random_transform(rng, n)
+            a = canon.apply_transform(canon.compose(t2, t1), T)
+            b = canon.apply_transform(t2, canon.apply_transform(t1, T))
+            assert np.array_equal(a, b)
+            ident = canon.compose(canon.invert(t1), t1)
+            assert ident.is_identity()
+            masked_bits = tt.to_bits(T).copy()
+            masked_bits[dom:] = False
+            assert np.array_equal(
+                canon.apply_transform(ident, T),
+                tt.from_bits(masked_bits),
+            )
+
+
+def test_canonical_key_frame_invariant():
+    """THE property gate: random truth tables under random input
+    permutations/negations and output complement all map to one
+    canonical key, and the returned transforms map every frame to the
+    SAME canonical table."""
+    rng = np.random.default_rng(1)
+    for n in (3, 4, 6, 8):
+        mask = tt.mask_table(n)
+        for _ in range(4):
+            T = random_table(rng, n)
+            key0, tr0 = canon.canonicalize(T, mask, GATES)
+            assert tr0 is not None
+            canon0 = canon.apply_transform(tr0, T & mask)
+            for _ in range(5):
+                g = random_transform(rng, n)
+                T2 = canon.apply_transform(g, T)
+                key2, tr2 = canon.canonicalize(T2, mask, GATES)
+                assert key2 == key0
+                assert np.array_equal(
+                    canon.apply_transform(tr2, T2 & mask), canon0
+                )
+            # Determinism: a literal repeat returns the same transform,
+            # so the composed hit rewrite is the identity.
+            key3, tr3 = canon.canonicalize(T.copy(), mask, GATES)
+            assert key3 == key0 and tr3 == tr0
+
+
+def test_canonical_key_ignores_dont_care_bits():
+    """Bits outside the mask never enter the key (a don't-care scribble
+    is the same query)."""
+    rng = np.random.default_rng(2)
+    mask = tt.mask_table(4)
+    T = random_table(rng, 4)
+    key0, _ = canon.canonicalize(T, mask, GATES)
+    bits = tt.to_bits(T).copy()
+    bits[16:] = rng.integers(0, 2, tt.TABLE_BITS - 16).astype(bool)
+    key1, _ = canon.canonicalize(tt.from_bits(bits), mask, GATES)
+    assert key1 == key0
+    # The metric is part of the key: GATES and SAT entries never mix.
+    key_sat, _ = canon.canonicalize(T, mask, 1)
+    assert key_sat != key0
+
+
+def test_symmetric_orbit_falls_back_to_exact_key():
+    """A fully symmetric table (XOR of all 8 inputs) blows the
+    candidate cap; canonicalize falls back to the exact-digest key
+    (deterministic, identity-frame only) instead of a multi-second
+    group scan — and the decision is orbit-invariant, so it can never
+    split a key."""
+    idx = np.arange(256)
+    bits = np.zeros(256, dtype=bool)
+    for i in range(8):
+        bits ^= ((idx >> i) & 1).astype(bool)
+    T = tt.from_bits(bits)
+    t0 = time.perf_counter()
+    key, tr = canon.canonicalize(T, tt.mask_table(8), GATES)
+    assert time.perf_counter() - t0 < 1.0
+    assert tr is None and key.startswith("x")
+    key2, tr2 = canon.canonicalize(T, tt.mask_table(8), GATES)
+    assert (key2, tr2) == (key, None)
+
+
+def test_rewrite_state_verified_over_all_inputs():
+    """Circuit rewrite under a random transform realizes exactly the
+    transformed table on ALL 2^8 inputs; the identity transform
+    reproduces the stored graph byte-for-byte."""
+    rng = np.random.default_rng(3)
+    for n in (3, 5, 8):
+        mask = tt.mask_table(n)
+        for s in range(6):
+            st = random_circuit(n, 6, 100 * n + s)
+            T = st.tables[st.outputs[0]]
+            t = random_transform(rng, n)
+            st2 = rewrite_state(st, t)
+            want = canon.apply_transform(t, T & mask)
+            got = st2.tables[st2.outputs[0]]
+            # Explicit all-2^8-inputs comparison under the mask.
+            assert np.array_equal(
+                tt.to_bits(got) & tt.to_bits(mask),
+                tt.to_bits(want) & tt.to_bits(mask),
+            )
+            ident = rewrite_state(st, canon.identity_transform(n))
+            assert state_to_xml(ident) == state_to_xml(st)
+
+
+# -- the store -------------------------------------------------------------
+
+
+def test_store_roundtrip_equivalent_frames_and_keep_first(tmp_path):
+    """put + get round trip: an exact repeat returns the stored graph
+    byte-identically; an equivalent-frame query gets a rewritten,
+    re-verified circuit; the first publisher of a key wins."""
+    store = ResultStore(str(tmp_path / "s"), sync=True)
+    st = random_circuit(5, 8, 42)
+    mask = tt.mask_table(5)
+    T = st.tables[st.outputs[0]].copy()
+    store.put_state(st, T, mask, GATES)
+    kind, hit = store.fetch(T, mask, GATES)
+    assert kind == "hit" and hit.exact_frame
+    assert state_to_xml(hit.state) == state_to_xml(
+        _rebind(st, st.outputs[0])
+    )
+    # Equivalent frame: rewritten + verified.
+    g = canon.Transform((4, 2, 0, 1, 3), (1, 0, 1, 0, 0), 1)
+    T2 = canon.apply_transform(g, T)
+    kind, hit2 = store.fetch(T2, mask, GATES)
+    assert kind == "hit" and not hit2.exact_frame
+    out = hit2.state.tables[hit2.state.outputs[0]]
+    assert bool(tt.eq_mask(out, T2, mask))
+    # Keep-first: a second publisher of the same key is a no-op.
+    other = random_circuit(5, 4, 7)
+    other.outputs[0] = other.num_gates - 1
+    before = open(store._path(hit.key)).read()
+    store.put_state(other, T, mask, GATES)
+    assert open(store._path(hit.key)).read() == before
+    # Unknown query: a miss, counted as such.
+    kind, none = store.fetch(
+        np.full(8, 0x1234, np.uint32), mask, GATES
+    )
+    assert kind == "miss" and none is None
+    store.close()
+
+
+def test_corrupt_entries_quarantined_as_miss(tmp_path):
+    """A truncated, digest-corrupt, or garbage entry is a MISS and is
+    moved to quarantine/ — never a crash, never a wrong answer."""
+    reg_ctx = SearchContext(Options(seed=1))
+    store = ResultStore(
+        str(tmp_path / "s"), stats=reg_ctx.stats, sync=True
+    )
+    mask = tt.mask_table(5)
+    sts, keys, seed = [], [], 0
+    while len(sts) < 3:  # seeds whose canonical keys are distinct
+        seed += 1
+        st = random_circuit(5, 6, seed)
+        key = canon.canonicalize(
+            st.tables[st.outputs[0]], mask, GATES
+        )[0]
+        if key in keys:
+            continue
+        sts.append(st)
+        keys.append(key)
+        store.put_state(st, st.tables[st.outputs[0]], mask, GATES)
+    # Truncate one, flip a digest byte in another, garbage the third.
+    p0, p1, p2 = (store._path(k) for k in keys)
+    torn = open(p0).read()[:40]
+    open(p0, "w").write(torn)
+    doc = json.load(open(p1))
+    doc["sha256"] = ("0" * 8) + doc["sha256"][8:]
+    json.dump(doc, open(p1, "w"))
+    open(p2, "w").write("not json at all")
+    for st in sts:
+        kind, val = store.fetch(
+            st.tables[st.outputs[0]], mask, GATES
+        )
+        assert kind == "miss" and val is None
+    qdir = tmp_path / "s" / "quarantine"
+    assert len(os.listdir(qdir)) == 3
+    assert int(reg_ctx.stats["store_corrupt_quarantined"]) == 3
+    assert int(reg_ctx.stats["store_misses"]) == 3
+    assert reg_ctx.stats.undeclared() == set()
+    store.close()
+
+
+def test_unknown_entry_version_is_plain_miss_not_quarantine(tmp_path):
+    """A future-build entry version reads as a MISS without quarantine:
+    stores are shared across builds, and an older reader must never
+    destroy an entry a newer build can still use."""
+    store = ResultStore(str(tmp_path / "s"), sync=True)
+    st = random_circuit(5, 6, 4)
+    mask = tt.mask_table(5)
+    T = st.tables[st.outputs[0]]
+    store.put_state(st, T, mask, GATES)
+    key = canon.canonicalize(T, mask, GATES)[0]
+    path = store._path(key)
+    doc = json.load(open(path))
+    doc["v"] = 99
+    json.dump(doc, open(path, "w"))
+    kind, val = store.fetch(T, mask, GATES)
+    assert kind == "miss" and val is None
+    assert os.path.exists(path)  # untouched, not quarantined
+    assert not os.path.exists(tmp_path / "s" / "quarantine")
+    store.close()
+
+
+def test_rewrite_shared_output_gate_complements_both_bits():
+    """Two output bits bound to the SAME gate under an output
+    complement: the in-place function flip is refused (it would invert
+    the second bit's view) and both bits come back correct."""
+    st = random_circuit(3, 4, 21)
+    gid = st.outputs[0]
+    st.outputs[1] = gid
+    t = canon.Transform((0, 1, 2), (0, 0, 0), 1)
+    out = rewrite_state(st, t)
+    mask = tt.mask_table(3)
+    for bit in (0, 1):
+        got = out.tables[out.outputs[bit]]
+        want = canon.apply_transform(t, st.tables[gid] & mask)
+        assert np.array_equal(
+            tt.to_bits(got) & tt.to_bits(mask),
+            tt.to_bits(want) & tt.to_bits(mask),
+        ), bit
+
+
+def test_store_fault_sites_degrade(tmp_path):
+    """Injected ``store.get`` / ``store.put`` / ``store.index`` raises
+    degrade to miss / skipped publish / skipped index line — the
+    search path never sees an exception."""
+    store = ResultStore(str(tmp_path / "s"), sync=True)
+    st = random_circuit(5, 6, 9)
+    mask = tt.mask_table(5)
+    T = st.tables[st.outputs[0]]
+    faults.arm("store.put", "raise", "1")
+    store.put_state(st, T, mask, GATES)  # injected: publish skipped
+    faults.disarm()
+    assert store.fetch(T, mask, GATES)[0] == "miss"
+    faults.arm("store.index", "raise", "1+")
+    store.put_state(st, T, mask, GATES)  # index append skipped, entry lands
+    faults.disarm()
+    assert not os.path.exists(tmp_path / "s" / "index.jsonl")
+    assert store.fetch(T, mask, GATES)[0] == "hit"
+    faults.arm("store.get", "raise", "1")
+    kind, val = store.fetch(T, mask, GATES)  # injected: miss
+    assert kind == "miss" and val is None
+    faults.disarm()
+    assert store.fetch(T, mask, GATES)[0] == "hit"
+    store.close()
+
+
+def test_unwritable_store_degrades_readonly(tmp_path):
+    """An unwritable store directory degrades to read-only mode (the
+    logged-note contract): construction never raises, publishes become
+    no-ops, and lookups against a populated read-only store keep
+    working."""
+    # An unwritable root (a plain file where the directory should be):
+    # construction degrades instead of raising.
+    bad = tmp_path / "not-a-dir"
+    bad.write_text("occupied")
+    store = ResultStore(str(bad))
+    assert store.readonly
+    assert store._thread is None  # no writer thread in ro mode
+    st = random_circuit(4, 5, 11)
+    mask = tt.mask_table(4)
+    T = st.tables[st.outputs[0]]
+    store.put_state(st, T, mask, GATES)  # silent no-op
+    assert store.fetch(T, mask, GATES)[0] == "miss"
+    # Explicit read-only mode over a populated store: lookups hit,
+    # publishes stay no-ops.
+    d = str(tmp_path / "ro")
+    ResultStore(d, sync=True).put_state(st, T, mask, GATES)
+    ro = ResultStore(d, readonly=True)
+    assert ro.fetch(T, mask, GATES)[0] == "hit"
+    skey = canon.canonicalize(T, mask, GATES)[0]
+    seed, okey, other = 11, skey, st
+    while okey == skey:  # a circuit in a DIFFERENT canonical class
+        seed += 1
+        other = random_circuit(4, 5, seed)
+        okey = canon.canonicalize(
+            other.tables[other.outputs[0]], mask, GATES
+        )[0]
+    ro.put_state(other, other.tables[other.outputs[0]], mask, GATES)
+    assert ro.status_view()["readonly"]
+    assert not os.path.exists(ro._path(okey))  # ro handle never wrote
+
+
+def test_lut_sub_tables_published_as_shared_entries(tmp_path):
+    """ReducedLUT-style sharing: publishing a LUT circuit also
+    publishes its decomposition sub-tables (cones of >= 2 gates), so a
+    later query for just the sub-function — in any equivalent frame —
+    hits."""
+    store = ResultStore(str(tmp_path / "s"), sync=True)
+    st = State.init_inputs(3)
+    g3 = st.add_lut(0x96, 0, 1, 2)
+    g4 = st.add_lut(0xE8, g3, 1, 2)
+    g5 = st.add_lut(0xCA, g4, 0, 2)
+    st.outputs[0] = g5
+    mask = tt.mask_table(3)
+    store.put_state(
+        st, st.tables[g5], mask, GATES, sub_tables=True
+    )
+    # The inner cone (g4 over g3) is its own shared entry now.
+    sub_target = st.tables[g4]
+    rng = np.random.default_rng(5)
+    g = canon.Transform(
+        tuple(int(v) for v in rng.permutation(3)), (1, 0, 1), 1
+    )
+    q = canon.apply_transform(g, sub_target)
+    kind, hit = store.fetch(q, mask, GATES)
+    assert kind == "hit"
+    out = hit.state.tables[hit.state.outputs[0]]
+    assert bool(tt.eq_mask(out, q, mask))
+    assert hit.meta.get("sub_table") is True
+    store.close()
+
+
+# -- serve integration -----------------------------------------------------
+
+#: Device-dispatch options (mirrors tests/test_serve.py DEVOPTS): node
+#: heads dispatch to the (CPU) device, so the zero-dispatch hit gate is
+#: meaningful.
+DEVOPTS = dict(
+    seed=11, lut_graph=True, randomize=False, host_small_steps=False,
+    native_engine=False, warmup=False,
+)
+
+
+def _toy_files(tmp_path, n):
+    from sboxgates_tpu.search.fleet import toy_fleet_boxes
+
+    d = tmp_path / "boxes"
+    os.makedirs(d, exist_ok=True)
+    paths = []
+    for i, bj in enumerate(toy_fleet_boxes(n)):
+        p = str(d / f"toy{i}.txt")
+        with open(p, "w") as f:
+            f.write(" ".join("%02x" % v for v in bj.sbox[:8]))
+        paths.append(p)
+    return paths
+
+
+def _serve_run(tmp_path, sub, store_dir, paths, output, **opts):
+    ctx = SearchContext(Options(**{
+        **DEVOPTS, **opts, "result_store": store_dir,
+    }))
+    orch = ServeOrchestrator(
+        ctx, str(tmp_path / sub), lanes=4,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.01),
+        log=lambda s: None,
+    )
+    jobs = [
+        orch.submit(ServeJob(job_id=f"t{i}", sbox_path=p, output=output))
+        for i, p in enumerate(paths)
+    ]
+    orch.start()
+    view = orch.run_until_idle(timeout_s=240)
+    orch.stop()
+    ctx.result_store.flush()
+    return ctx, orch, view, jobs
+
+
+def test_serve_repeat_query_zero_dispatch_bit_identical(tmp_path):
+    """THE acceptance gate: a repeated serve-mode query is served from
+    the store with ZERO device dispatches and a circuit bit-identical
+    to the one the fresh search produced, with the hit visible in the
+    queue view (the job skips the queue)."""
+    store_dir = str(tmp_path / "store")
+    paths = _toy_files(tmp_path, 4)
+    ctx1, orch1, v1, _ = _serve_run(
+        tmp_path, "cold", store_dir, paths, 0
+    )
+    assert v1["counts"][DONE] == 4, v1
+    assert int(ctx1.stats["device_dispatches"]) > 0
+    assert int(ctx1.stats["store_misses"]) == 4
+    assert int(ctx1.stats["store_puts"]) >= 1
+    ctx2, orch2, v2, jobs2 = _serve_run(
+        tmp_path, "warm", store_dir, paths, 0
+    )
+    assert v2["counts"][DONE] == 4, v2
+    assert int(ctx2.stats["store_hits"]) == 4
+    assert int(ctx2.stats.get("device_dispatches", 0)) == 0
+    assert ctx2.stats.histograms()["store_get_s"]["count"] >= 4
+    for j in jobs2:
+        row = v2["jobs"][j.job_id]
+        assert row["store"] == "hit"
+        assert "queue_wait_s" not in row  # never entered the queue
+        d_cold = xml_digests(os.path.join(orch1.root, j.job_id))
+        d_warm = xml_digests(os.path.join(orch2.root, j.job_id))
+        assert len(d_warm) == 1
+        (fname, digest), = d_warm.items()
+        assert d_cold.get(fname) == digest, (j.job_id, fname)
+        # The hit job's journal reads as a completed run.
+        recs = [
+            json.loads(line) for line in
+            open(os.path.join(orch2.root, j.job_id,
+                              "search.journal.jsonl"))
+        ]
+        assert recs[0]["config"]["store"] == "hit"
+        assert recs[-1]["type"] == "run_done"
+    assert v2["store"]["hits"] == 4
+    assert ctx2.stats.undeclared() == set()
+
+
+def test_serve_all_outputs_repeat_hits_exact_key(tmp_path):
+    """All-outputs queries key exactly (no canonical merge) and repeat
+    across tenants with zero dispatches."""
+    store_dir = str(tmp_path / "store")
+    paths = _toy_files(tmp_path, 2)
+    ctx1, orch1, v1, _ = _serve_run(
+        tmp_path, "cold", store_dir, paths, -1
+    )
+    assert v1["counts"][DONE] == 2, v1
+    ctx2, orch2, v2, _ = _serve_run(
+        tmp_path, "warm", store_dir, paths, -1
+    )
+    assert v2["counts"][DONE] == 2, v2
+    assert int(ctx2.stats["store_hits"]) == 2
+    assert int(ctx2.stats.get("device_dispatches", 0)) == 0
+    for i in range(2):
+        d_cold = xml_digests(os.path.join(orch1.root, f"t{i}"))
+        d_warm = xml_digests(os.path.join(orch2.root, f"t{i}"))
+        (fname, digest), = d_warm.items()
+        assert d_cold.get(fname) == digest
+
+
+def test_drained_frontier_resumes_across_processes(tmp_path):
+    """The partial-hit acceptance gate: a drained serve run publishes
+    its interrupted jobs' frontiers; a NEW orchestrator in a DIFFERENT
+    root (same seeds) seeds from the store and finishes bit-identically
+    to an uninterrupted run — the PR 3 resume contract applied across
+    processes via the store."""
+    store_dir = str(tmp_path / "store")
+    ctx1 = SearchContext(Options(
+        seed=11, iterations=4, result_store=store_dir,
+    ))
+    orch1 = ServeOrchestrator(
+        ctx1, str(tmp_path / "r1"), lanes=1,
+        deadline=DeadlineConfig(retries=3, backoff_s=5.0),
+        log=lambda s: None,
+    )
+    faults.arm("serve.preempt@job:j0", "raise", "2")
+    j0 = orch1.submit(ServeJob(job_id="j0", sbox_path=DES, output=0))
+    orch1.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60:
+        if orch1.status_view()["jobs"]["j0"].get("preemptions", 0):
+            break
+        time.sleep(0.02)
+    faults.disarm()
+    orch1.drain(timeout_s=30)
+    ctx1.result_store.flush()
+    assert int(ctx1.stats["store_puts"]) >= 1
+
+    ctx2 = SearchContext(Options(
+        seed=11, iterations=4, result_store=store_dir,
+    ))
+    orch2 = ServeOrchestrator(
+        ctx2, str(tmp_path / "r2"), lanes=1,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.01),
+        log=lambda s: None,
+    )
+    j0b = orch2.submit(ServeJob(job_id="j0", sbox_path=DES, output=0))
+    assert j0b.store == "partial"
+    assert int(ctx2.stats["store_partial_hits"]) == 1
+    orch2.start()
+    v2 = orch2.run_until_idle(timeout_s=120)
+    orch2.stop()
+    assert v2["counts"][DONE] == 1, v2
+    assert v2["jobs"]["j0"]["store"] == "partial"
+
+    ref_dir = str(tmp_path / "ref")
+    os.makedirs(ref_dir)
+    ctx3 = SearchContext(Options(seed=int(j0b.seed), iterations=4))
+    sbox, n = load_sbox(DES, 0)
+    st = State.init_inputs(n)
+    generate_graph_one_output(
+        ctx3, st, make_targets(sbox), 0, save_dir=ref_dir,
+        log=lambda s: None, journal=None,
+    )
+    assert xml_digests(os.path.join(orch2.root, "j0")) == \
+        xml_digests(ref_dir)
+
+
+def test_store_get_job_targeted_fault_degrades_one_tenant(tmp_path):
+    """``store.get@job:ID``: the injected lookup fault fires only on
+    the targeted tenant's admission consult — that job degrades to
+    miss-and-search while its neighbors keep hitting."""
+    store_dir = str(tmp_path / "store")
+    paths = _toy_files(tmp_path, 4)
+    _serve_run(tmp_path, "cold", store_dir, paths, 0)
+    faults.arm("store.get@job:t1", "raise", "1+")
+    ctx, orch, view, jobs = _serve_run(
+        tmp_path, "warm", store_dir, paths, 0
+    )
+    assert view["counts"][DONE] == 4, view
+    assert view["jobs"]["t1"].get("store") is None  # searched normally
+    assert int(ctx.stats["store_hits"]) == 3
+    assert int(ctx.stats["store_misses"]) == 1
+    for jid in ("t0", "t2", "t3"):
+        assert view["jobs"][jid]["store"] == "hit"
+
+
+def test_watch_renders_store_section():
+    """The serve queue view surfaces store outcomes: head counters and
+    per-job store=hit rows (cache-hit jobs visibly skip the queue)."""
+    from sboxgates_tpu.telemetry.watch import render_serve
+
+    serve = {
+        "lanes": 2, "lane_bucket": 2, "merge": True, "waves": 0,
+        "draining": False,
+        "counts": {"queued": 0, "running": 0, "preempted": 0,
+                   "quarantined": 0, "done": 2},
+        "store": {"hits": 1, "misses": 1, "partial_hits": 0,
+                  "puts": 1, "readonly": False},
+        "jobs": {
+            "a": {"state": "done", "tenant": "t", "priority": 0,
+                  "bucket": 2, "failures": 0, "preemptions": 0,
+                  "store": "hit", "ttfh_s": 0.001},
+            "b": {"state": "done", "tenant": "t", "priority": 0,
+                  "bucket": 2, "failures": 0, "preemptions": 0},
+        },
+    }
+    text = "\n".join(render_serve(serve))
+    assert "store hit=1/part=0/miss=1" in text
+    assert "store=hit" in text
