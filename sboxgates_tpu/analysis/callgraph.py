@@ -547,6 +547,40 @@ class ProjectGraph:
                         changed = True
         return taint
 
+    def call_index(self, fkey: str) -> Dict[Tuple[int, int], List[str]]:
+        """(line, col) of each resolved call inside ``fkey`` -> sorted
+        callee keys.  Lets a lexical pass (R10's branch-side walk) look
+        up which project functions a given ``ast.Call`` resolves to."""
+        idx: Dict[Tuple[int, int], List[str]] = {}
+        for e in self.out_edges.get(fkey, ()):
+            idx.setdefault((e.line, e.col), []).append(e.callee)
+        for v in idx.values():
+            v.sort()
+        return idx
+
+    def reach_witness(self, seeds: Dict[str, str]) -> Dict[str, str]:
+        """Fixpoint of "this function transitively reaches a seeded site".
+
+        ``seeds``: function key -> witness description.  Result maps every
+        function that reaches a seed through call edges to the minimal
+        witness string (deterministic: same shape as ``sync_taint`` but
+        generic over what the seeds mean — R10 seeds agreement sites,
+        R11 seeds nondeterminism sources)."""
+        reach = dict(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for fkey in sorted(self.functions):
+                best = reach.get(fkey)
+                for e in self.out_edges.get(fkey, ()):
+                    w = reach.get(e.callee)
+                    if w is not None and (best is None or w < best):
+                        best = w
+                if best is not None and reach.get(fkey) != best:
+                    reach[fkey] = best
+                    changed = True
+        return reach
+
     # -- serialization -----------------------------------------------------
 
     def as_json(self) -> dict:

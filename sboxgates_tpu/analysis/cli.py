@@ -198,6 +198,56 @@ def _as_payload(reports: List[FileReport]) -> dict:
     }
 
 
+def _as_sarif(reports: List[FileReport]) -> dict:
+    """SARIF 2.1.0 view of the unsuppressed findings — the interchange
+    format CI diff-annotation tooling consumes."""
+    findings, _ = _flatten(reports)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "jaxlint",
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {
+                                    "text": RULE_DOCS[rid]
+                                },
+                            }
+                            for rid in sorted(RULE_DOCS)
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in sorted(
+                        findings,
+                        key=lambda f: (f.path, f.line, f.col, f.rule),
+                    )
+                ],
+            }
+        ],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sboxgates_tpu.analysis",
@@ -265,10 +315,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "lock/loop context, thread and jit roots) as deterministic JSON "
         "and exit",
     )
+    ap.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="also write the unsuppressed findings as SARIF 2.1.0 to "
+        "FILE (CI diff annotation), alongside the chosen --format",
+    )
+    ap.add_argument(
+        "--coverage",
+        action="store_true",
+        help="chaos-coverage report: cross-reference faults.KNOWN_SITES "
+        "against the tests' arm()/SBG_FAULTS specs and [tool.jaxlint] "
+        "chaos_waivers; exit 1 on unexercised sites or stale waivers",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rid in (*ALL_RULES, "SUP", "ERR"):
+        for rid in (*ALL_RULES, "COV", "SUP", "ERR"):
             print(f"{rid:4s} {RULE_DOCS[rid]}")
         return 0
 
@@ -302,9 +365,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         print()
         return 0
 
+    if args.coverage:
+        from .durability import chaos_coverage
+        from .project import lint_project
+
+        config.whole_program = True  # the site registry needs the graph
+        _reports, graph = lint_project(
+            args.paths or None, config, return_graph=True
+        )
+        report = chaos_coverage(graph, config)
+        if args.format == "json":
+            json.dump(report, sys.stdout, indent=1, sort_keys=True)
+            print()
+        else:
+            for name in sorted(report["sites"]):
+                s = report["sites"][name]
+                if s["armed_by"]:
+                    how = f"armed by {', '.join(s['armed_by'])}"
+                elif s["waiver"]:
+                    how = f"waived: {s['waiver']}"
+                else:
+                    how = "UNCOVERED"
+                print(f"{name:20s} {s['declared']:40s} {how}")
+            for w in report["stale_waivers"]:
+                print(f"stale waiver: {w}")
+            print(
+                f"jaxlint: {report['armed_total']}/"
+                f"{report['declared_total']} fault sites armed, "
+                f"{len(report['uncovered'])} uncovered, "
+                f"{len(report['stale_waivers'])} stale waiver(s)"
+            )
+        return 1 if (
+            report["uncovered"] or report["stale_waivers"]
+        ) else 0
+
     reports = lint_paths(args.paths or None, config)
     findings, suppressed = _flatten(reports)
     payload = _as_payload(reports)
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(_as_sarif(reports), f, indent=1, sort_keys=True)
+            f.write("\n")
 
     if args.diff_base:
         base_sources: dict = {}

@@ -19,8 +19,10 @@ from typing import Dict, List, Optional
 FILE_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 #: Cross-module rules (whole-program pass only).  R7/R8/R9 are the
 #: contract-verification passes: registry drift, bucket discipline,
-#: lock ordering.
-CROSS_RULES = ("R1x", "R2x", "R4x", "R7", "R8", "R9")
+#: lock ordering.  R10/R11/R12 are the protocol/determinism/durability
+#: shadows: replicated-protocol divergence, determinism taint, and
+#: durable-write discipline.
+CROSS_RULES = ("R1x", "R2x", "R4x", "R7", "R8", "R9", "R10", "R11", "R12")
 ALL_RULES = FILE_RULES + CROSS_RULES
 
 #: Defaults mirror the committed pyproject table so API callers that never
@@ -59,6 +61,66 @@ DEFAULT_BLOCKING_CALLS = (
     "host_sync_deadline",
 )
 
+#: Call names whose RESULT differs per process (R10): a branch testing
+#: one of these — or a local derived from one — is rank-gated control
+#: flow.  Names that agree on every rank (``process_count``) are NOT
+#: rank sources: branching on them is replicated, not divergent.
+DEFAULT_RANK_SOURCES = (
+    "process_index",
+    "process_rank",
+    "is_primary",
+    "is_coordinator",
+)
+
+#: Agreement / collective entry points (R10): every process must reach
+#: these in lockstep, so a call path gated on one side of a rank branch
+#: hangs or splits the pod.  Device collectives are included — a
+#: rank-gated collective is the launch-count bug class directly.
+DEFAULT_AGREEMENT_SITES = (
+    "breach_verdict",
+    "journal_seq_check",
+    "run_config_check",
+    "_kv_exchange",
+    "wait_at_barrier",
+    "replicated_dispatch_with_retry",
+    "sync_verdict",
+    "process_allgather",
+    "broadcast_one_to_all",
+    "all_gather",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+)
+
+#: Bit-identity sinks (R11): calls whose inputs must be reproducible
+#: byte-for-byte across runs and processes.  A dotted entry like
+#: "journal.append" matches attribute chains ending in ``append`` whose
+#: receiver mentions ``journal``; a bare entry matches the call tail.
+DEFAULT_DETERMINISTIC_SINKS = (
+    "journal.append",
+    "durable_write_text",
+    "with_digest",
+    "canonicalize",
+    "exact_key",
+    "exact_multi_key",
+    "default_rng",
+    "SeedSequence",
+    "PRNGKey",
+)
+
+#: Persistence modules (R12): every truncating write / os.replace here
+#: must route through the shared durable helper or carry a reason.
+DEFAULT_DURABLE_MODULES = (
+    "sboxgates_tpu/resilience/*",
+    "sboxgates_tpu/store/*",
+    "sboxgates_tpu/telemetry/*",
+)
+
+#: Functions exempt from R12 — the durable helper itself (its fdopen +
+#: os.replace ARE the tmp+fsync+atomic-replace discipline).
+DEFAULT_DURABLE_HELPERS = ("durable_write_text",)
+
 
 @dataclass
 class JaxlintConfig:
@@ -94,6 +156,24 @@ class JaxlintConfig:
     blocking_calls: List[str] = field(
         default_factory=lambda: list(DEFAULT_BLOCKING_CALLS)
     )
+    rank_sources: List[str] = field(
+        default_factory=lambda: list(DEFAULT_RANK_SOURCES)
+    )
+    agreement_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_AGREEMENT_SITES)
+    )
+    deterministic_sinks: List[str] = field(
+        default_factory=lambda: list(DEFAULT_DETERMINISTIC_SINKS)
+    )
+    durable_modules: List[str] = field(
+        default_factory=lambda: list(DEFAULT_DURABLE_MODULES)
+    )
+    durable_helpers: List[str] = field(
+        default_factory=lambda: list(DEFAULT_DURABLE_HELPERS)
+    )
+    #: "site: reason" strings waiving chaos coverage for declared fault
+    #: sites that cannot be exercised by an armed test.
+    chaos_waivers: List[str] = field(default_factory=list)
 
     def is_hot(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
@@ -106,6 +186,10 @@ class JaxlintConfig:
     def is_excluded(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
         return any(fnmatch.fnmatch(rp, pat) for pat in self.exclude)
+
+    def is_durable(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rp, pat) for pat in self.durable_modules)
 
 
 _STR = r'"((?:[^"\\]|\\.)*)"'
@@ -216,6 +300,8 @@ def load_config(start: str = ".") -> JaxlintConfig:
         "hot_modules", "rules", "exclude", "paths",
         "thread_roots", "jit_roots",
         "dispatch_modules", "bucket_sources", "blocking_calls",
+        "rank_sources", "agreement_sites", "deterministic_sinks",
+        "durable_modules", "durable_helpers", "chaos_waivers",
     ):
         val = table.get(key)
         if isinstance(val, list) and all(isinstance(x, str) for x in val):

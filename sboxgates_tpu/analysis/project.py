@@ -355,6 +355,63 @@ def analyze_project(
         # with the call graph so the root-coverage gate can read it.
         graph.lock_order = lock_order
 
+    # Protocol/determinism/durability shadows (R10/R11/R12): the static
+    # mirrors of the replicated-degradation, bit-identical-resume, and
+    # torn-write runtime contracts.
+    if "R10" in config.rules:
+        from .protocol import run_r10
+
+        ran.add("R10")
+        for path, items in run_r10(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+    if "R11" in config.rules:
+        from .determinism import nondet_sites, run_r11
+
+        ran.add("R11")
+        ack11: Set[Tuple[str, int]] = set()
+        for fa in analyses:
+            for s in fa.sups:
+                if "R11" not in s.rules:
+                    continue
+                ack11.add((fa.path, s.line))
+                if s.standalone:
+                    ack11.add((fa.path, s.line + 1))
+        for path, items in run_r11(graph, config, ack11).items():
+            extra.setdefault(path, []).extend(items)
+        # The R2x acknowledged-source contract, for determinism: a valid
+        # R11 marker ON the nondet source kills the taint for every
+        # caller, and the source is re-emitted as a suppressed finding
+        # so the marker is never stale and the baseline documents the
+        # acknowledged-nondeterminism inventory.
+        src_lines = nondet_sites(graph, config)
+        for fa in analyses:
+            for sup in fa.sups:
+                if "R11" not in sup.rules:
+                    continue
+                lines = [sup.line]
+                if sup.standalone:
+                    lines.append(sup.line + 1)
+                for line in lines:
+                    hit = src_lines.get((fa.path, line))
+                    if hit is not None:
+                        extra.setdefault(fa.path, []).append(
+                            (
+                                "R11",
+                                line,
+                                hit[0],
+                                f"deliberate nondeterminism at its "
+                                f"source ({hit[1]}): acknowledged — "
+                                "sinks are not tainted by this site",
+                            )
+                        )
+                        break
+    if "R12" in config.rules:
+        from .durability import run_r12
+
+        ran.add("R12")
+        for path, items in run_r12(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+
     reports: List[FileReport] = []
     for fa in analyses:
         # Every x-rule that ran is judged for stale markers — including

@@ -89,6 +89,32 @@ RULE_DOCS = {
         "across a blocking dispatch/verdict resolve (deadlocks against "
         "the abandonment path)"
     ),
+    "R10": (
+        "replicated-protocol divergence: an agreement/collective site "
+        "(breach_verdict, journal_seq_check, _kv_exchange, device "
+        "collectives, replicated dispatch) reached from only one side of "
+        "a rank-gated branch, or a device collective issued inside a "
+        "host-agreement window — every process must issue the same "
+        "agreement sequence or the pod hangs (launch-count lockstep)"
+    ),
+    "R11": (
+        "determinism taint: a nondeterministic source (wall clock, "
+        "unseeded RNG, os.urandom, uuid, unsorted directory scan, set "
+        "iteration, id()) flows into a bit-identity sink (journal append, "
+        "checkpoint bytes, canonical store keys, seed derivation) — "
+        "breaks bit-identical resume and cross-process key agreement"
+    ),
+    "R12": (
+        "durability discipline: a truncating open / json.dump / "
+        "os.replace in a persistence module bypasses the shared "
+        "tmp+fsync+atomic-replace helper (durable_write_text) — a kill "
+        "mid-write leaves a torn file the recovery path must never see"
+    ),
+    "COV": (
+        "chaos coverage: a declared fault site (faults.KNOWN_SITES) with "
+        "no armed test and no [tool.jaxlint] chaos_waivers entry, or a "
+        "stale waiver naming a site no longer declared"
+    ),
     SUPPRESSION_RULE: (
         "malformed or unused jaxlint suppression (reason is mandatory; a "
         "marker whose finding no longer fires is itself a finding)"

@@ -133,7 +133,9 @@ def clean_stale_tmp(directory: str) -> int:
     count.  Safe at resume time: no writer is live."""
     removed = 0
     try:
-        names = os.listdir(directory)
+        # Sorted: removal order (and therefore the OSError fallback
+        # behavior) must not depend on filesystem enumeration order.
+        names = sorted(os.listdir(directory))
     except OSError:
         return 0
     for name in names:
@@ -158,8 +160,11 @@ def latest_valid_state(directory: str):
     from ..graph.xmlio import StateLoadError, load_state
 
     try:
+        # Sorted: ties in the (mtime, path) recovery ordering below must
+        # break identically on every platform — resume picks the same
+        # checkpoint regardless of directory enumeration order.
         names = [
-            n for n in os.listdir(directory)
+            n for n in sorted(os.listdir(directory))
             if n.endswith(".xml") and not n.startswith(TMP_PREFIX)
         ]
     except OSError:

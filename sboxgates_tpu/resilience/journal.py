@@ -126,7 +126,7 @@ class SearchJournal:
             os.unlink(os.path.join(directory, SNAPSHOT_NAME))
         except FileNotFoundError:
             pass
-        open(j._path, "w", encoding="utf-8").close()
+        durable_write_text(j._path, "")
         j.append("run_start", version=JOURNAL_VERSION, config=config)
         return j
 

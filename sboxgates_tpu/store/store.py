@@ -133,8 +133,10 @@ class ResultStore:
     def _object_dirs(self) -> List[str]:
         base = os.path.join(self.root, "objects")
         try:
+            # Sorted: index rebuilds and sweeps must visit shards in the
+            # same order on every platform/filesystem.
             return [
-                os.path.join(base, d) for d in os.listdir(base)
+                os.path.join(base, d) for d in sorted(os.listdir(base))
                 if os.path.isdir(os.path.join(base, d))
             ]
         except OSError:
@@ -211,6 +213,7 @@ class ResultStore:
         qdir = os.path.join(self.root, "quarantine")
         try:
             os.makedirs(qdir, exist_ok=True)
+            # jaxlint: ignore[R12] rename of already-durable bytes — no content is written, so there is nothing to tear
             os.replace(path, os.path.join(qdir, os.path.basename(path)))
             self._inc("store_corrupt_quarantined")
             logger.warning(

@@ -169,8 +169,8 @@ class FlightRecorder:
             "reason": reason,
             "rank": self.rank(),
             "pid": os.getpid(),
-            "time_unix": time.time(),
-            "time_perf": time.perf_counter(),
+            "time_unix": time.time(),  # jaxlint: ignore[R11] incident wall-clock stamp is advisory forensics metadata, never replayed or keyed on
+            "time_perf": time.perf_counter(),  # jaxlint: ignore[R11] perf epoch for correlating dump with heartbeat lines; forensics only
             "extra": {k: _trunc(v) for k, v in (extra or {}).items()},
             "events": events,
         }
@@ -195,12 +195,9 @@ class FlightRecorder:
             d, f"flight-rank{self.rank():02d}-{n}.json"
         )
         # Durable: a dump exists to survive the crash that triggered it.
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(text)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from ..resilience.checkpoint import durable_write_text
+
+        durable_write_text(path, text)
         return path
 
 

@@ -78,8 +78,12 @@ class Heartbeat:
         self.path = os.path.join(directory, JSONL_NAME)
         if not resume:
             # Fresh run owns the file; a resume appends after the crash
-            # tail so the incident window stays inspectable.
-            open(self.path, "w", encoding="utf-8").close()
+            # tail so the incident window stays inspectable.  Truncation
+            # goes through the durable helper: a kill here must not
+            # leave a torn JSONL a resume would try to parse.
+            from ..resilience.checkpoint import durable_write_text
+
+            durable_write_text(self.path, "")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -127,8 +131,8 @@ class Heartbeat:
             "seq": self._seq,
             "rank": self.rank,
             "pid": os.getpid(),
-            "time_unix": time.time(),
-            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "time_unix": time.time(),  # jaxlint: ignore[R11] heartbeat wall-clock stamp is advisory telemetry, never replayed or keyed on
+            "uptime_s": round(time.monotonic() - self._t0, 3),  # jaxlint: ignore[R11] uptime is advisory telemetry, not replayed state
             "counters": self.registry.scalars(),
             "process": GLOBAL.scalars(),
             # Quantile summaries instead of raw bucket tallies: the
@@ -188,8 +192,8 @@ class Heartbeat:
         payload = {
             "schema": SCHEMA,
             "rank": self.rank,
-            "time_unix": time.time(),
-            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "time_unix": time.time(),  # jaxlint: ignore[R11] snapshot wall-clock stamp is advisory telemetry, never replayed or keyed on
+            "uptime_s": round(time.monotonic() - self._t0, 3),  # jaxlint: ignore[R11] uptime is advisory telemetry, not replayed state
             "heartbeat_lines": self._seq,
             "process": GLOBAL.scalars(),
             # Per-(kernel, bucket) roofline rows: compile-time cost
@@ -201,10 +205,9 @@ class Heartbeat:
         if self.run_config is not None:
             payload["config"] = self.run_config
         path = os.path.join(self.directory, SNAPSHOT_NAME)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(payload, f, sort_keys=True, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from ..resilience.checkpoint import durable_write_text
+
+        durable_write_text(
+            path, json.dumps(payload, sort_keys=True, indent=1)
+        )
         return path

@@ -94,7 +94,7 @@ def build_status(
     count for the coverage denominators (the CLI wires
     ``SearchContext.last_dispatch_gates``); None degrades coverage to
     examined-and-rate rows."""
-    uptime = time.monotonic() - t0_monotonic
+    uptime = time.monotonic() - t0_monotonic  # jaxlint: ignore[R11] /status uptime is advisory operator telemetry, never replayed
     scalars = registry.scalars()
     hists = registry.histograms()
     g = None
@@ -105,7 +105,7 @@ def build_status(
             logger.warning("status gates provider failed: %r", e)
     doc = {
         "schema": STATUS_SCHEMA,
-        "time_unix": time.time(),
+        "time_unix": time.time(),  # jaxlint: ignore[R11] /status wall-clock stamp is advisory operator telemetry, never replayed or keyed on
         "uptime_s": round(uptime, 3),
         "counters": scalars,
         "histograms": hists,

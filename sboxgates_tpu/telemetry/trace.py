@@ -253,8 +253,9 @@ class Tracer:
         returns the path."""
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(self.chrome_trace(), f)
+        from ..resilience.checkpoint import durable_write_text
+
+        durable_write_text(path, json.dumps(self.chrome_trace()))
         return path
 
 

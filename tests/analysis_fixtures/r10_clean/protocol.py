@@ -1,0 +1,39 @@
+"""Rank-gated branches where every process still reaches agreement."""
+
+import jax
+
+
+def breach_verdict(flag):
+    return bool(flag)
+
+
+def symmetric(flag):
+    # Matching peer path: the guard returns through the same agreement
+    # site the fall-through does, so every process issues one call.
+    if jax.process_index() == 0:
+        return breach_verdict(True)
+    return breach_verdict(flag)
+
+
+def both_sides(flag):
+    if jax.process_index() == 0:
+        breach_verdict(True)
+    else:
+        breach_verdict(flag)
+
+
+def replicated_guard(flag):
+    # process_count is a replicated predicate, not a rank source: every
+    # process takes the same side.
+    if jax.process_count() <= 1:
+        return bool(flag)
+    return breach_verdict(flag)
+
+
+def local_only(items):
+    rank = jax.process_index()
+    out = []
+    for i, item in enumerate(items):
+        if i % 3 == rank:
+            out.append(item)
+    return out

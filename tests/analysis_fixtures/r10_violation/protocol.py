@@ -1,0 +1,30 @@
+"""Rank-gated agreement divergence: each shape R10 must flag."""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def agree(flag):
+    # Transitive carrier: reaches the agreement site for its callers.
+    return breach_verdict(flag)
+
+
+def breach_verdict(flag):
+    return bool(flag)
+
+
+def one_sided(flag):
+    if jax.process_index() == 0:
+        breach_verdict(flag)
+
+
+def guard_style(flag):
+    rank = jax.process_index()
+    if rank != 0:
+        return None
+    return agree(flag)
+
+
+def collective_in_host_window(client, x):
+    client.wait_at_barrier("sync", 1000)
+    return multihost_utils.process_allgather(x)

@@ -1,0 +1,23 @@
+"""The deterministic twins: same sinks, deterministic inputs."""
+
+import os
+
+
+def manifest(directory):
+    names = sorted(os.listdir(directory))
+    return canonicalize(names)
+
+
+def canonicalize(parts):
+    return "|".join(parts)
+
+
+def derive_key(seed):
+    import numpy as np
+
+    return np.random.default_rng(seed)
+
+
+def fan_out(journal, items):
+    for item in sorted(set(items)):
+        journal.append("item", name=item)

@@ -1,0 +1,7 @@
+"""Nondeterminism source hidden behind a project-local helper."""
+
+import time
+
+
+def stamp():
+    return time.time()

@@ -1,0 +1,30 @@
+"""Nondeterministic values flowing into bit-identity sinks."""
+
+import os
+
+from .clock import stamp
+
+
+def record(journal, payload):
+    journal.append("done", t=stamp())
+
+
+def derive_key(parts):
+    import numpy as np
+
+    seed = int.from_bytes(os.urandom(4), "big")
+    return np.random.default_rng(seed)
+
+
+def manifest(directory):
+    names = os.listdir(directory)
+    return canonicalize(names)
+
+
+def canonicalize(parts):
+    return "|".join(parts)
+
+
+def fan_out(journal, items):
+    for item in set(items):
+        journal.append("item", name=item)

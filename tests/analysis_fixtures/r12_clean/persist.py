@@ -1,0 +1,23 @@
+"""The durable twin: all replacement writes ride the exempt helper."""
+
+import json
+import os
+import tempfile
+
+
+def durable_write_text(path, text):
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish(path, payload):
+    durable_write_text(path, json.dumps(payload))
+
+
+def append_event(path, line):
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)

@@ -1,0 +1,15 @@
+"""In-place truncating writes inside a durable module."""
+
+import json
+import os
+
+
+def publish(path, payload):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(path + ".new", path)
+
+
+def append_event(path, line):
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)

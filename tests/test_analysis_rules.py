@@ -23,7 +23,10 @@ FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 #: The pre-contract rule set for the legacy multi-file packs: R7's
 #: thread-pin gate would otherwise (correctly) flag the deliberately
 #: unpinned Thread targets those packs spawn to exercise R4/R4x.
-LEGACY_RULES = [r for r in ALL_RULES if r not in ("R7", "R8", "R9")]
+LEGACY_RULES = [
+    r for r in ALL_RULES
+    if r not in ("R7", "R8", "R9", "R10", "R11", "R12")
+]
 
 
 def lint_fixture(name, **kwargs):
@@ -549,7 +552,7 @@ def test_pack_scan_is_deterministic():
     assert msgs_a == msgs_b
 
 
-# -- contract-verification packs (R7/R8/R9) --------------------------------
+# -- contract-verification packs (R7–R12) ----------------------------------
 
 #: pack -> (config kwargs, exact sorted (rule, file, line)).  The clean
 #: twins run under the same kwargs as their dirty pack unless listed.
@@ -583,6 +586,31 @@ CONTRACT_PACKS = {
             ("R9", "workers.py", 25),  # lock held across the resolve
         ],
     ),
+    "r10_violation": (
+        dict(rules=["R10"]),
+        [
+            ("R10", "protocol.py", 17),  # agreement from one side only
+            ("R10", "protocol.py", 23),  # early-return guard, transitive
+            ("R10", "protocol.py", 30),  # collective in host window
+        ],
+    ),
+    "r11_violation": (
+        dict(rules=["R11"]),
+        [
+            ("R11", "driver.py", 9),   # wall clock via clock.stamp()
+            ("R11", "driver.py", 16),  # urandom seed into default_rng
+            ("R11", "driver.py", 21),  # unsorted listdir -> canonicalize
+            ("R11", "driver.py", 30),  # set iteration -> journal.append
+        ],
+    ),
+    "r12_violation": (
+        dict(rules=["R12"], durable_modules=["*"]),
+        [
+            ("R12", "persist.py", 8),   # truncating open("w")
+            ("R12", "persist.py", 9),   # json.dump to the stream
+            ("R12", "persist.py", 10),  # raw os.replace
+        ],
+    ),
 }
 
 CONTRACT_CLEAN = {
@@ -591,6 +619,10 @@ CONTRACT_CLEAN = {
     "r8_clean": dict(rules=["R8"], dispatch_modules=["*"]),
     "r9_clean": dict(rules=["R9"],
                      thread_roots=["forward", "also_forward"]),
+    "r10_clean": dict(rules=["R10"]),
+    "r11_clean": dict(rules=["R11"]),
+    "r12_clean": dict(rules=["R12"], durable_modules=["*"],
+                      durable_helpers=["durable_write_text"]),
 }
 
 
@@ -716,6 +748,144 @@ def test_r9_held_lock_suppressible_inline(tmp_path):
     assert [
         (f.rule, r.path, f.line) for r in reports for f in r.suppressed
     ] == [("R9", "mod.py", 6)]
+
+
+def test_r10_messages_name_sites_side_and_contract():
+    kwargs, _ = CONTRACT_PACKS["r10_violation"]
+    reports = lint_pack("r10_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    m = by_site[("protocol.py", 17)]
+    assert "breach_verdict" in m and "launch-count lockstep" in m
+    # Guard style: the flagged side is the fall-through past the early
+    # return, and the agreement site is reached TRANSITIVELY (the
+    # witness names the carrier's call site).
+    m = by_site[("protocol.py", 23)]
+    assert "breach_verdict (via protocol.py:9)" in m
+    assert "the path past the guard" in m
+    m = by_site[("protocol.py", 30)]
+    assert "process_allgather" in m and "host-agreement window" in m
+
+
+def test_r11_messages_carry_source_witness_and_sink():
+    kwargs, _ = CONTRACT_PACKS["r11_violation"]
+    reports = lint_pack("r11_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    # Interprocedural: the wall clock hides behind clock.stamp().
+    m = by_site[("driver.py", 9)]
+    assert "wall clock time.time()" in m and "journal.append" in m
+    assert "os.urandom" in by_site[("driver.py", 16)]
+    assert "default_rng" in by_site[("driver.py", 16)]
+    m = by_site[("driver.py", 21)]
+    assert "unsorted directory scan listdir()" in m
+    assert "canonicalize" in m
+    assert "unordered set" in by_site[("driver.py", 30)]
+
+
+def test_r12_messages_point_at_the_durable_helper():
+    kwargs, _ = CONTRACT_PACKS["r12_violation"]
+    reports = lint_pack("r12_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    assert "truncating open(mode='w')" in by_site[("persist.py", 8)]
+    assert "json.dump" in by_site[("persist.py", 9)]
+    assert "os.replace" in by_site[("persist.py", 10)]
+    for m in by_site.values():
+        assert "durable" in m
+
+
+def test_r10_findings_suppressible_inline(tmp_path):
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "import jax\n"
+        "def breach_verdict(flag):\n"
+        "    return bool(flag)\n"
+        "def gated(flag):\n"
+        "    # jaxlint: ignore[R10] primary-only verdict is re-broadcast to every rank by the caller\n"
+        "    if jax.process_index() == 0:\n"
+        "        breach_verdict(flag)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R10"], whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    assert [
+        (f.rule, r.path, f.line) for r in reports for f in r.suppressed
+    ] == [("R10", "mod.py", 6)]
+
+
+def test_r11_acknowledged_source_suppresses_downstream_sinks(tmp_path):
+    """The R11 contract: the marker goes on the SOURCE, which silences
+    every sink it taints — and the acknowledged source itself lands in
+    the suppressed inventory so the marker can never go stale
+    silently."""
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "import time\n"
+        "def record(journal):\n"
+        "    t = time.time()  # jaxlint: ignore[R11] operator-facing stamp, never replayed or keyed on\n"
+        "    journal.append('note', t=t)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R11"], whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    sup = [
+        (f.rule, r.path, f.line, f.message)
+        for r in reports for f in r.suppressed
+    ]
+    assert [(s[0], s[1], s[2]) for s in sup] == [("R11", "mod.py", 3)]
+    assert "acknowledged" in sup[0][3]
+
+
+def test_r11_stale_acknowledged_source_marker_is_flagged(tmp_path):
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "def record(journal, t):\n"
+        "    # jaxlint: ignore[R11] nothing nondeterministic here\n"
+        "    journal.append('note', t=t)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R11"], whole_program=True,
+    )
+    assert pack_found(lint_project(config=cfg)) == [
+        ("SUP", "mod.py", 2)
+    ]
+
+
+def test_r12_findings_suppressible_inline(tmp_path):
+    pack = tmp_path / "pack"
+    pack.mkdir()
+    (pack / "mod.py").write_text(
+        "import os\n"
+        "def quarantine(src, dst):\n"
+        "    # jaxlint: ignore[R12] rename of already-durable bytes — nothing to tear\n"
+        "    os.replace(src, dst)\n"
+    )
+    cfg = JaxlintConfig(
+        root=str(pack), paths=["."], rules=["R12"],
+        durable_modules=["*"], whole_program=True,
+    )
+    reports = lint_project(config=cfg)
+    assert pack_found(reports) == []
+    assert [
+        (f.rule, r.path, f.line) for r in reports for f in r.suppressed
+    ] == [("R12", "mod.py", 4)]
 
 
 def test_contract_pack_scan_is_deterministic():

@@ -202,6 +202,43 @@ def test_latest_valid_state_skips_corrupt_newest(tmp_path):
     assert state_to_xml(recovered) == state_to_xml(st)
 
 
+def test_latest_valid_state_mtime_ties_break_by_name(tmp_path):
+    """Recovery ordering is (mtime, path) over a SORTED directory scan:
+    checkpoints with identical mtimes resolve to the lexicographically
+    greatest name on every platform — the scan must not ride raw
+    os.listdir enumeration order (regression for the unsorted scan R11
+    flagged)."""
+    st = small_state()
+    for name in ("b-tie.xml", "a-tie.xml", "c-tie.xml"):
+        p = tmp_path / name
+        p.write_text(with_digest(state_to_xml(st)))
+        os.utime(p, (5, 5))
+    for _ in range(3):
+        path, recovered = latest_valid_state(str(tmp_path))
+        assert path == str(tmp_path / "c-tie.xml")
+        assert state_to_xml(recovered) == state_to_xml(st)
+
+
+def test_clean_stale_tmp_removes_in_sorted_order(tmp_path, monkeypatch):
+    """Stranded-temp removal visits a sorted listing, so the removal
+    sequence (and therefore which files survive a mid-sweep OSError)
+    is identical on every filesystem."""
+    order = []
+    real_unlink = os.unlink
+
+    def recording_unlink(p):
+        order.append(os.path.basename(p))
+        real_unlink(p)
+
+    monkeypatch.setattr(os, "unlink", recording_unlink)
+    for name in ("zz", "aa", "mm"):
+        (tmp_path / f"{TMP_PREFIX}{name}.tmp").write_text("x")
+    (tmp_path / "keep.xml").write_text("x")
+    assert clean_stale_tmp(str(tmp_path)) == 3
+    assert order == sorted(order) and len(order) == 3
+    assert os.listdir(str(tmp_path)) == ["keep.xml"]
+
+
 def test_latest_valid_state_empty_dir(tmp_path):
     assert latest_valid_state(str(tmp_path)) is None
 

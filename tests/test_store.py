@@ -336,6 +336,19 @@ def test_store_fault_sites_degrade(tmp_path):
     store.close()
 
 
+def test_object_dirs_sorted_for_deterministic_sweeps(tmp_path):
+    """Shard traversal (index rebuilds, stale-tmp sweeps) visits
+    objects/ subdirectories in sorted order, not filesystem enumeration
+    order (regression for the unsorted os.listdir R11 flagged)."""
+    store = ResultStore(str(tmp_path / "s"), sync=True)
+    base = os.path.join(str(tmp_path / "s"), "objects")
+    for shard in ("ff", "00", "7a"):
+        os.makedirs(os.path.join(base, shard), exist_ok=True)
+    dirs = [os.path.basename(d) for d in store._object_dirs()]
+    assert dirs == sorted(dirs)
+    assert {"00", "7a", "ff"} <= set(dirs)
+
+
 def test_unwritable_store_degrades_readonly(tmp_path):
     """An unwritable store directory degrades to read-only mode (the
     logged-note contract): construction never raises, publishes become
