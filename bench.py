@@ -2979,6 +2979,281 @@ def bench_store() -> list:
     return out
 
 
+def _serve_net_distinct_queries(k):
+    """K genuinely distinct 3-input queries as wire-format S-box texts:
+    candidate output-0 truth tables deduped by their CANONICAL key (the
+    toy fleet corpus is useless here — its boxes are all complement-
+    equivalent on any one output bit, which the canonical keys merge
+    by design)."""
+    from sboxgates_tpu.core import canon
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES
+    from sboxgates_tpu.utils.sbox import parse_sbox
+
+    mask = tt.mask_table(3)
+    seen, queries = set(), []
+    for t in (0x96, 0xe8, 0xca, 0x80, 0x88, 0x68, 0x6a, 0xea,
+              0xf8, 0x9e, 0x7e, 0x1e):
+        text = " ".join("%02x" % ((t >> i) & 1) for i in range(8))
+        sbox, _n = parse_sbox(text)
+        key, _ = canon.canonicalize(
+            tt.target_table(sbox, 0), mask, GATES
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        queries.append(text)
+        if len(queries) == k:
+            break
+    return queries
+
+
+def _serve_net_stack(work, sub, store_dir=None, seed=9, lanes=4):
+    """One in-process admission stack (context + orchestrator +
+    AdmissionServer on an ephemeral loopback port), NOT started."""
+    from sboxgates_tpu.resilience.deadline import DeadlineConfig
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search.serve import ServeOrchestrator
+    from sboxgates_tpu.serve_net import TokenStore, write_token_file
+    from sboxgates_tpu.serve_net.server import AdmissionServer
+
+    opts = dict(
+        seed=seed, lut_graph=True, randomize=False,
+        host_small_steps=False, native_engine=False, warmup=False,
+    )
+    if store_dir is not None:
+        opts["result_store"] = store_dir
+    ctx = SearchContext(Options(**opts))
+    orch = ServeOrchestrator(
+        ctx, os.path.join(work, sub), lanes=lanes,
+        deadline=DeadlineConfig(retries=2, backoff_s=0.05),
+        log=lambda s: None,
+    )
+    tok = os.path.join(work, "tokens.json")
+    if not os.path.exists(tok):
+        write_token_file(tok, {
+            f"ten{i}": {"token": f"tok{i}", "max_jobs": 64,
+                        "rate_per_s": 5000.0, "burst": 2000}
+            for i in range(3)
+        })
+    srv = AdmissionServer(
+        orch, TokenStore.load(tok), ctx.stats, orch.root,
+        log=lambda s: None,
+    )
+    return ctx, orch, srv
+
+
+def _net_post(port, token, sbox_text, idem=None, wait_s=None):
+    """One closed-loop client round trip: POST the query, then ride the
+    long-poll GET to terminal.  Returns (post_status, final_doc)."""
+    import http.client
+
+    headers = {"Authorization": f"Bearer {token}"}
+    if idem:
+        headers["Idempotency-Key"] = idem
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        c.request("POST", "/v1/jobs",
+                  body=json.dumps({"sbox": sbox_text, "output": 0}),
+                  headers=headers)
+        r = c.getresponse()
+        status, doc = r.status, json.loads(r.read().decode("utf-8"))
+        while wait_s and status < 400 and doc.get("state") not in (
+            "done", "quarantined"
+        ):
+            c.request(
+                "GET", f"/v1/jobs/{doc['job_id']}?wait={wait_s}",
+                headers=headers,
+            )
+            r = c.getresponse()
+            doc = json.loads(r.read().decode("utf-8"))
+        return status, doc
+    finally:
+        c.close()
+
+
+def bench_serve_net() -> list:
+    """``bench.py --serve-net``: the network admission front door
+    (BENCH_NET.json).
+
+    1. ``serve_net_load`` — closed-loop loopback clients (one thread per
+       tenant connection) posting a zipf-repeat query mix through the
+       REAL HTTP surface and long-polling each job to done: admitted
+       jobs/hour, admission p99 (the ``net_admit_s`` histogram), and
+       the repeat-hit ratio headline.  Structural gates: every request
+       completed with a circuit, and the whole mix admitted exactly ONE
+       search per distinct canonical query.
+    2. ``serve_net_repeat`` — a fresh stack against the populated
+       result store answers a repeat POST with 200 + the circuit and
+       ZERO device dispatches end to end.
+    3. ``serve_net_duplicate`` — N barrier-released concurrent POSTs of
+       one identical query admit exactly one search; the rest join.
+    4. ``serve_net_drain`` — jobs admitted mid-load survive the drain
+       (listener closed first, orchestrator drained second) and the
+       next boot's journal replay runs every one to completion.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    work = tempfile.mkdtemp(prefix="sbg-net-bench-")
+    out = []
+    try:
+        # Arm 1: the zipf-repeat closed loop.
+        queries = _serve_net_distinct_queries(4)
+        # Zipf-ish repeat weights over the distinct queries: the head
+        # query dominates, the tail is cold — the serve-cache shape.
+        mix = [queries[j] for j, w in enumerate((8, 4, 2, 1))
+               for _ in range(w)]
+        clients = 5
+        per_client = len(mix) // clients + 1
+        store_dir = os.path.join(work, "store")
+        ctx, orch, srv = _serve_net_stack(work, "load", store_dir)
+        srv.start()
+        orch.start()
+        results = [[] for _ in range(clients)]
+
+        def run_client(i):
+            for j in range(per_client):
+                q = mix[(i * per_client + j) % len(mix)]
+                results[i].append(
+                    _net_post(srv.port, f"tok{i % 3}", q, wait_s=30)
+                )
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=run_client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(ENTRY_BUDGET_S)
+        wall = time.perf_counter() - t0
+        flat = [r for rows in results for r in rows]
+        completed = sum(
+            1 for s, d in flat
+            if s in (200, 202) and d.get("state") == "done"
+            and d.get("circuits")
+        )
+        admitted = int(ctx.stats.get("net_jobs_admitted", 0))
+        repeats = int(ctx.stats.get("net_repeat_hits", 0))
+        admit_h = ctx.stats.histograms().get("net_admit_s", {})
+        srv.close()
+        orch.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+        orch.stop()
+        ctx.result_store.flush()
+        requests = clients * per_client
+        out.append({
+            "metric": "serve_net_load",
+            "value": round(requests / max(wall, 1e-9) * 3600.0, 1),
+            "unit": "client requests served to done per hour "
+                    "(zipf-repeat mix, closed-loop loopback clients)",
+            "requests": requests,
+            "distinct_queries": len(queries),
+            "all_completed": completed == requests,
+            "one_search_per_query": admitted == len(queries),
+            "hit_ratio": round(repeats / max(requests, 1), 3),
+            "admission_p50_s": admit_h.get("p50"),
+            "admission_p99_s": admit_h.get("p99"),
+            "wall_s": round(wall, 3),
+        })
+        # Arm 2: the stored-query repeat — fresh stack, same store.
+        ctx2, orch2, srv2 = _serve_net_stack(work, "warm", store_dir)
+        srv2.start()
+        orch2.start()
+        s, d = _net_post(srv2.port, "tok0", queries[0], wait_s=30)
+        srv2.close()
+        orch2.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+        orch2.stop()
+        out.append({
+            "metric": "serve_net_repeat",
+            "value": int(ctx2.stats.get("device_dispatches", 0)),
+            "unit": "device dispatches answering a stored query "
+                    "over HTTP (gated at zero)",
+            "zero_device_dispatches_on_repeat": bool(
+                s == 200 and d.get("state") == "done"
+                and d.get("store") == "hit" and d.get("circuits")
+                and int(ctx2.stats.get("device_dispatches", 0)) == 0
+            ),
+            "status": s,
+        })
+        # Arm 3: concurrent duplicates — one search, the rest join.
+        ctx3, orch3, srv3 = _serve_net_stack(work, "dup")
+        srv3.start()
+        orch3.start()
+        n = 6
+        barrier = threading.Barrier(n)
+        dup = [None] * n
+
+        def dup_client(i):
+            barrier.wait()
+            dup[i] = _net_post(
+                srv3.port, "tok0", queries[1], idem="dup", wait_s=30
+            )
+
+        threads = [
+            threading.Thread(target=dup_client, args=(i,))
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(ENTRY_BUDGET_S)
+        admitted3 = int(ctx3.stats.get("net_jobs_admitted", 0))
+        srv3.close()
+        orch3.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+        orch3.stop()
+        out.append({
+            "metric": "serve_net_duplicate",
+            "value": admitted3,
+            "unit": f"searches admitted for {n} concurrent identical "
+                    "POSTs (gated at one)",
+            "no_duplicate_search": bool(
+                admitted3 == 1
+                and all(r and r[0] in (200, 202) for r in dup)
+                and len({r[1]["job_id"] for r in dup}) == 1
+            ),
+        })
+        # Arm 4: drain mid-load, replay next boot.
+        from sboxgates_tpu.serve_net.admission import pending_jobs
+
+        ctx4, orch4, srv4 = _serve_net_stack(work, "drain")
+        srv4.start()  # scheduler NOT started: jobs stay admitted/queued
+        admitted4 = []
+        for j, q in enumerate(queries[:3]):
+            s, d = _net_post(srv4.port, "tok1", q, idem=f"dr{j}")
+            if s == 202:
+                admitted4.append(d["job_id"])
+        srv4.close()
+        orch4.drain(timeout_s=10.0)
+        survived = set(pending_jobs(orch4.root)) == set(admitted4)
+        ctx5, orch5, srv5 = _serve_net_stack(work, "drain")
+        replayed = srv5.replay()
+        orch5.start()
+        view5 = orch5.run_until_idle(timeout_s=ENTRY_BUDGET_S)
+        orch5.stop()
+        done5 = sum(
+            1 for jid in admitted4
+            if view5["jobs"].get(jid, {}).get("state") == "done"
+        )
+        out.append({
+            "metric": "serve_net_drain",
+            "value": done5,
+            "unit": f"of {len(admitted4)} drained-mid-load jobs "
+                    "completed by the next boot's journal replay",
+            "drain_loses_nothing": bool(
+                survived
+                and len(admitted4) == 3
+                and set(replayed) == set(admitted4)
+                and done5 == len(admitted4)
+            ),
+        })
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def bench_roofline() -> list:
     """Measured roofline placement for EVERY kernel in the ``KERNELS``
     registry (BENCH_ROOFLINE.json) — the maintained successor to
@@ -3243,6 +3518,24 @@ BENCH_CHECKS = {
             ("store_hit_ratio", "ratio_ok", 0.0, "exact"),
         ],
     ),
+    "net": (
+        # The admission-service drift gate: structural, machine-
+        # independent fields only — every closed-loop request completed,
+        # the zipf mix admitted one search per distinct query, a stored
+        # repeat answered over HTTP with zero device dispatches,
+        # concurrent duplicates shared one search, and a drain lost no
+        # admitted job across restart.
+        bench_serve_net,
+        "BENCH_NET.json",
+        [
+            ("serve_net_load", "all_completed", 0.0, "exact"),
+            ("serve_net_load", "one_search_per_query", 0.0, "exact"),
+            ("serve_net_repeat", "zero_device_dispatches_on_repeat",
+             0.0, "exact"),
+            ("serve_net_duplicate", "no_duplicate_search", 0.0, "exact"),
+            ("serve_net_drain", "drain_loses_nothing", 0.0, "exact"),
+        ],
+    ),
     "hoststream": (
         bench_host_stream_pipeline,
         "BENCH_PIPELINE.json",
@@ -3420,6 +3713,22 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
         detail = bench_store()
         with open(os.path.join(HERE, "BENCH_STORE.json"), "w") as f:
+            json.dump(with_meta(detail), f, indent=1)
+        print(json.dumps(detail[0]))
+        return
+    if "--serve-net" in sys.argv:
+        # Standalone mode: the network admission front door (closed-
+        # loop loopback clients, admission p99 + jobs/hour under a
+        # zipf-repeat mix, stored-repeat zero-dispatch, concurrent-
+        # duplicate single-search, drain/replay loss-free), written to
+        # BENCH_NET.json.  CPU-safe.
+        if SMOKE or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_serve_net()
+        with open(os.path.join(HERE, "BENCH_NET.json"), "w") as f:
             json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[0]))
         return

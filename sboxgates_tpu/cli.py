@@ -131,6 +131,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "seconds (default: unbounded); a breach is "
                         "raised at the job's next journal boundary "
                         "and consumes one retry")
+    p.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                   help="serve mode only: open the network admission "
+                        "service on http://127.0.0.1:PORT (0 binds an "
+                        "ephemeral port) — an authenticated, quota-"
+                        "enforced HTTP front door (serve_net/): POST "
+                        "/v1/jobs submits a query idempotently (repeat "
+                        "of a stored query answers 200 with the circuit "
+                        "and zero device dispatches; an in-flight "
+                        "duplicate joins the existing search), GET "
+                        "/v1/jobs/<id>?wait=N long-polls progress, and "
+                        "every accepted job is fsync'd to an admission "
+                        "journal BEFORE its 202 so a crash loses "
+                        "nothing (restart replays it); requires "
+                        "--serve-token-file")
+    p.add_argument("--serve-token-file", default=None, metavar="FILE",
+                   help="per-tenant bearer-token file for --serve-port "
+                        "(JSON: {\"version\": 1, \"tenants\": {name: "
+                        "{\"token\": ..., \"max_jobs\": N, "
+                        "\"rate_per_s\": R, \"burst\": B}}}); loaded "
+                        "FAIL-CLOSED — a missing, corrupt, or world-"
+                        "writable file refuses to serve rather than "
+                        "admit openly")
     p.add_argument("--serve-no-merge", action="store_true",
                    help="disable fleet-merged serve waves: same-bucket "
                         "tenants admitted together then run as "
@@ -292,6 +314,11 @@ JOURNAL_CONFIG_KEYS = (
     # (a store hit simply doesn't search), but a resumed run must keep
     # publishing to — and consulting — the same store.
     "result_store",
+    # Network admission service: observation/admission surface only —
+    # never shapes a draw stream — but the run record must identify a
+    # network-serving run and its credential source.
+    "serve_port",
+    "serve_token_file",
 )
 
 #: Keys added to JOURNAL_CONFIG_KEYS after a journal version shipped:
@@ -308,6 +335,8 @@ JOURNAL_KEY_DEFAULTS = {
     "serve_timeout": None,
     "chain_rounds": 0,
     "result_store": None,
+    "serve_port": None,
+    "serve_token_file": None,
 }
 
 
@@ -487,6 +516,49 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _err(f"Bad serve timeout value: {args.serve_timeout}")
     if args.serve_no_merge and not args.serve:
         return _err("--serve-no-merge requires --serve.")
+    if args.serve_port is not None and not args.serve:
+        return _err(
+            "--serve-port requires --serve (and an explicit "
+            "--output-dir): the admission service fronts the serve "
+            "orchestrator."
+        )
+    if args.serve_token_file is not None and args.serve_port is None:
+        return _err("--serve-token-file requires --serve-port.")
+    if args.serve_port is not None:
+        if not (0 <= args.serve_port <= 65535):
+            return _err(f"Bad serve port value: {args.serve_port}")
+        if args.serve_token_file is None:
+            return _err(
+                "--serve-port requires --serve-token-file: network "
+                "admission is authenticated-only (never open)."
+            )
+        # Fail-closed credential checks BEFORE the engine import: a
+        # missing, world-writable, or corrupt token file is a one-line
+        # refusal, never an open or half-started server.
+        from .serve_net import TokenFileError, TokenStore
+        from .serve_net import check_file as _check_token_file
+
+        problem = _check_token_file(args.serve_token_file)
+        if problem is not None:
+            return _err(problem)
+        try:
+            TokenStore.load(args.serve_token_file)
+        except TokenFileError as e:
+            return _err(str(e))
+        # Port-in-use is also a startup refusal, not a mid-run crash:
+        # probe-bind the requested port now (ephemeral 0 always binds).
+        import socket as _socket
+
+        probe = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        try:
+            probe.bind(("127.0.0.1", args.serve_port))
+        except OSError as e:
+            return _err(
+                f"serve port {args.serve_port} unavailable: "
+                f"{e.strerror or e}"
+            )
+        finally:
+            probe.close()
     if args.chain_rounds < 0:
         return _err(f"Bad chain rounds value: {args.chain_rounds}")
     if args.chain_rounds > 0:
@@ -1122,6 +1194,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                 status_server.add_provider("serve", orch.status_view)
             if heartbeat is not None:
                 heartbeat.add_provider("serve", orch.status_view)
+            net_server = None
+            if args.serve_port is not None:
+                # The network admission front door (serve_net/): token
+                # auth was validated fail-closed before the engine
+                # import; here the journal replays admitted-but-
+                # unfinished jobs from the prior boot BEFORE the
+                # listener opens, so recovered work is ahead of new
+                # traffic.
+                from .serve_net import TokenStore
+                from .serve_net.server import AdmissionServer
+
+                net_server = AdmissionServer(
+                    orch, TokenStore.load(args.serve_token_file),
+                    ctx.stats, args.output_dir,
+                    port=args.serve_port, log=log,
+                )
+                replayed = net_server.replay()
+                if replayed:
+                    log(
+                        f"serve-net: replayed {len(replayed)} admitted "
+                        "job(s) from the admission journal"
+                    )
+                # Drain order on SIGTERM: close the listener FIRST
+                # (new admissions refused), then drain the
+                # orchestrator (hooks run in list order).
+                drain_hooks.append(lambda: net_server.close())
             drain_hooks.append(lambda: orch.drain(timeout_s=10.0))
             for i, path in enumerate(args.input):
                 stem = os.path.splitext(os.path.basename(path))[0]
@@ -1130,7 +1228,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     output=args.single_output, permute=args.permute,
                 ))
             orch.start()
-            view = orch.run_until_idle()
+            if net_server is not None:
+                net_server.start()
+                log(
+                    "serve-net: admission endpoint on "
+                    f"http://127.0.0.1:{net_server.port}/v1/jobs"
+                )
+                # Long-lived: admission arrives over HTTP, so idle is
+                # not done — only SIGTERM (drain) ends the run.
+                view = orch.run_until_drained()
+                net_server.close()
+            else:
+                view = orch.run_until_idle()
             orch.stop()
             counts = view["counts"]
             log("serve: " + "  ".join(

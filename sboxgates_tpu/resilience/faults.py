@@ -27,6 +27,10 @@ the ones production code fires today):
 ``store.get``             entering a result-store lookup
 ``store.put``             before a result-store entry write
 ``store.index``           before a result-store index append
+``net.accept``            dispatching one admission-API HTTP request
+``net.auth``              checking one admission request's bearer token
+``net.body``              reading one admission request's body
+``net.admit_journal``     after an admission-journal record reaches disk
 ========================  =====================================================
 
 Arming — ``SBG_FAULTS`` (read at first use) or :func:`arm`::
@@ -61,6 +65,17 @@ the serve-mode chaos matrix preempts, kills, or poisons exactly one
 tenant's job on a deterministic schedule while its neighbors run
 undisturbed — the job-queue analog of ``@rank:N``.  Hit counting for a
 job-targeted site happens only on threads running the matching job.
+
+Tenant targeting — a site name may carry an ``@tenant:NAME`` suffix
+(``net.auth@tenant:acme:raise``): the fault then fires only on a thread
+currently serving tenant ``NAME`` (:func:`set_tenant`, called by the
+network admission handler after authentication; overridable via
+``SBG_FAULT_TENANT`` for single-tenant subprocess tests).  This is how
+the admission chaos matrix rejects, kills, or stalls exactly one
+tenant's traffic while the other tenants' requests flow undisturbed —
+the front-door analog of ``@job:ID``.  Hit counting for a
+tenant-targeted site happens only on threads serving the matching
+tenant.
 """
 
 from __future__ import annotations
@@ -96,6 +111,10 @@ KNOWN_SITES = (
     "store.get",
     "store.put",
     "store.index",
+    "net.accept",
+    "net.auth",
+    "net.body",
+    "net.admit_journal",
 )
 
 
@@ -116,6 +135,7 @@ class _Spec:
 _WHEN_RE = re.compile(r"^(\d+)(\+?)$")
 _RANK_RE = re.compile(r"@rank:(\d+)$")
 _JOB_RE = re.compile(r"@job:([A-Za-z0-9_.\-]+)$")
+_TENANT_RE = re.compile(r"@tenant:([A-Za-z0-9_.\-]+)$")
 
 _lock = threading.Lock()
 _specs: Dict[str, _Spec] = {}
@@ -127,19 +147,27 @@ _rank: Optional[int] = None
 #: tenants' jobs concurrently in one process, and a job-targeted fault
 #: must fire only on the thread actually running that job.
 _job_local = threading.local()
-#: True when any armed site is rank-/job-targeted — recomputed under
-#: _lock by every _specs mutation, so fault_point's fast path reads ONE
-#: bool per kind instead of iterating _specs (which background threads
-#: would race against a concurrent arm()/disarm() resize).
+#: Thread-local current tenant (set_tenant) for @tenant:NAME matching —
+#: per-THREAD like the job pin: the admission server handles many
+#: tenants' requests concurrently in one process, and a tenant-targeted
+#: fault must fire only on the thread serving that tenant.
+_tenant_local = threading.local()
+#: True when any armed site is rank-/job-/tenant-targeted — recomputed
+#: under _lock by every _specs mutation, so fault_point's fast path
+#: reads ONE bool per kind instead of iterating _specs (which background
+#: threads would race against a concurrent arm()/disarm() resize).
 _rank_targeted = False
 _job_targeted = False
+_tenant_targeted = False
 
 
 def _note_specs_changed() -> None:
-    """Caller holds _lock: refresh the rank-/job-targeting flags."""
-    global _rank_targeted, _job_targeted
+    """Caller holds _lock: refresh the rank-/job-/tenant-targeting
+    flags."""
+    global _rank_targeted, _job_targeted, _tenant_targeted
     _rank_targeted = any("@rank:" in s for s in _specs)
     _job_targeted = any("@job:" in s for s in _specs)
+    _tenant_targeted = any("@tenant:" in s for s in _specs)
 
 
 def set_rank(rank: Optional[int]) -> None:
@@ -175,6 +203,31 @@ def current_job() -> Optional[str]:
     return getattr(_job_local, "job", None)
 
 
+def set_tenant(tenant: Optional[str]) -> None:
+    """Pins the CALLING THREAD's tenant for ``@tenant:NAME``-targeted
+    sites (called by the admission handler once a request's token
+    resolves to a tenant); ``None`` clears it.  Thread-local by design —
+    see :data:`_tenant_local`."""
+    _tenant_local.tenant = None if tenant is None else str(tenant)
+
+
+def _current_tenant() -> Optional[str]:
+    """Tenant used for ``@tenant:NAME`` matching: the thread's
+    :func:`set_tenant` value, else the ``SBG_FAULT_TENANT`` environment
+    fallback (single-tenant subprocess tests), else None (no
+    tenant-qualified lookup)."""
+    tenant = getattr(_tenant_local, "tenant", None)
+    if tenant is not None:
+        return tenant
+    return os.environ.get("SBG_FAULT_TENANT")
+
+
+def current_tenant() -> Optional[str]:
+    """The calling thread's :func:`set_tenant` pin (no env fallback) —
+    for carrying the pin onto work handed to another thread."""
+    return getattr(_tenant_local, "tenant", None)
+
+
 def _process_rank() -> int:
     """Rank used for ``@rank:N`` matching: explicit :func:`set_rank` >
     ``SBG_FAULT_RANK`` > ``JAX_PROCESS_ID`` > 0.  Never imports jax — the
@@ -203,15 +256,17 @@ def parse_spec(text: str) -> Dict[str, _Spec]:
         if len(fields) != 2 or not fields[0]:
             raise ValueError(
                 f"bad fault spec {part!r}: expected "
-                "'site[@rank:N|@job:ID]:action[@when]'"
+                "'site[@rank:N|@job:ID|@tenant:NAME]:action[@when]'"
             )
         site, action = fields
         if ":" in site and not (
             _RANK_RE.search(site) or _JOB_RE.search(site)
+            or _TENANT_RE.search(site)
         ):
             raise ValueError(
                 f"bad fault site {site!r} in {part!r}: a ':' in a site "
-                "name is only valid as an '@rank:N' or '@job:ID' suffix"
+                "name is only valid as an '@rank:N', '@job:ID', or "
+                "'@tenant:NAME' suffix"
             )
         when = "1+"
         if "@" in action:
@@ -294,6 +349,10 @@ def fault_point(site: str) -> None:
         job = _current_job()
         if job is not None:
             names.append(f"{site}@job:{job}")
+    if _tenant_targeted:
+        tenant = _current_tenant()
+        if tenant is not None:
+            names.append(f"{site}@tenant:{tenant}")
     if all(_specs.get(n) is None for n in names):
         return
     spec = None

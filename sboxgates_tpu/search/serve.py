@@ -238,6 +238,10 @@ class ServeJob:
     #: the store at admission, queue skipped) or "partial" (search
     #: seeded from a stored frontier); None = ordinary miss-and-search.
     store: Optional[str] = None
+    #: Duplicate submissions attached to this job instead of searching
+    #: again (the network front door's join-in-flight path, :meth:`
+    #: ServeOrchestrator.join`) — N clients, one search.
+    joined: int = 0
     _preempt: threading.Event = field(default_factory=threading.Event)
 
     @property
@@ -386,6 +390,12 @@ class ServeOrchestrator:
         self._stop = False
         self._scheduler: Optional[threading.Thread] = None
         self._workers: Dict[str, threading.Thread] = {}
+        #: Terminal-transition hook (the network admission service's
+        #: durable "done" marker rides here): called with the ServeJob
+        #: once it is DONE or QUARANTINED and its artifacts have landed.
+        #: Invoked OUTSIDE _cv (R9) and exception-guarded — a failing
+        #: observer can never take a worker down.
+        self.on_terminal: Optional[Callable[[ServeJob], None]] = None
         os.makedirs(root, exist_ok=True)
         # Wave-membership sidecar (NOT the per-job search journal — that
         # must stay byte-identical to a standalone run): each wave
@@ -462,10 +472,12 @@ class ServeOrchestrator:
             # store.* chaos sites @job:ID-targetable here, like every
             # worker-side site.
             faults.set_job(job.job_id)
+            faults.set_tenant(job.tenant)
             try:
                 hit = self._consult_store(job, sbox, n_in)
             finally:
                 faults.set_job(None)
+                faults.set_tenant(None)
         now = time.perf_counter()
         with self._cv:
             if self._draining:
@@ -505,6 +517,7 @@ class ServeOrchestrator:
                 f"serve: job {job.job_id} served from the result store "
                 "(1 state)"
             )
+            self._notify_terminal(job)
         return job
 
     # -- lifecycle ---------------------------------------------------------
@@ -568,11 +581,30 @@ class ServeOrchestrator:
                 ]
             for j in pending:
                 faults.set_job(j.job_id)
+                faults.set_tenant(j.tenant)
                 try:
                     self._publish_frontier(j)
                 finally:
                     faults.set_job(None)
+                    faults.set_tenant(None)
         return self.status_view()
+
+    def run_until_drained(self, timeout_s: Optional[float] = None) -> dict:
+        """Blocks until :meth:`drain` begins, then until the drain
+        lands — the network-serving main loop: admission arrives over
+        HTTP for the process lifetime, so "all current jobs done" is
+        NOT done; only SIGTERM (wired to drain by the CLI) ends it."""
+        deadline = (
+            None if timeout_s is None else time.perf_counter() + timeout_s
+        )
+        with self._cv:
+            while not self._draining and not self._stop:
+                if deadline is not None and time.perf_counter() > deadline:
+                    return self.status_view()
+                self._cv.wait(0.5)
+        # The drain phase gets the same budget again (not the remnant):
+        # a bounded caller wants "don't hang", not exact accounting.
+        return self.run_until_idle(timeout_s=timeout_s)
 
     def run_until_idle(self, timeout_s: Optional[float] = None) -> dict:
         """Blocks until every admitted job is terminal (DONE or
@@ -1058,6 +1090,7 @@ class ServeOrchestrator:
         QUARANTINED) so a poison job can never take the scheduler — or
         a neighbor tenant — down with it."""
         faults.set_job(job.job_id)
+        faults.set_tenant(job.tenant)
         t0 = time.perf_counter()
         job_dir = self._job_dir(job)
         view: Optional[JobView] = None
@@ -1178,6 +1211,7 @@ class ServeOrchestrator:
                 self._requeue(job, backoff_s=backoff)
         finally:
             faults.set_job(None)
+            faults.set_tenant(None)
             if wave is not None:
                 self._leave_wave(wave, job)
             if hb is not None:
@@ -1194,7 +1228,13 @@ class ServeOrchestrator:
                 self.ctx.stats.merge(view.stats)
             with self._cv:
                 self._workers.pop(job.job_id, None)
+                terminal = job.state in TERMINAL
                 self._cv.notify_all()
+            if terminal:
+                # Fired after the worker entry is popped and artifacts
+                # have landed — a wait_terminal() woken by the notify
+                # above and an on_terminal observer see the same state.
+                self._notify_terminal(job)
 
     def _requeue(self, job: ServeJob, backoff_s: float = 0.0) -> None:
         """Back onto the queue (preemption or retriable failure).  The
@@ -1283,6 +1323,8 @@ class ServeOrchestrator:
                     row["results"] = j.result_count
                 if j.error is not None:
                     row["error"] = j.error
+                if j.joined:
+                    row["joined"] = j.joined
                 reg = j.registry
                 if reg is not None and j.state == RUNNING:
                     # The fork's own lock serializes this read against
@@ -1304,3 +1346,90 @@ class ServeOrchestrator:
             if self.store is not None:
                 view["store"] = self.store.status_view()
             return view
+
+    def job(self, job_id: str) -> Optional[ServeJob]:
+        """The admitted job by id, or None — the network front door's
+        existence/status probe."""
+        with self._cv:
+            return self._jobs.get(job_id)
+
+    def active_jobs(self, tenant: str) -> int:
+        """Non-terminal jobs this tenant currently owns — the quota
+        denominator the network front door enforces at admission."""
+        with self._cv:
+            return sum(
+                1 for j in self._jobs.values()
+                if j.tenant == tenant and j.state not in TERMINAL
+            )
+
+    def join(self, job_id: str) -> Optional[ServeJob]:
+        """Attaches one more client to an already-admitted job (the
+        idempotent-submission join-in-flight path): N duplicate
+        submissions share ONE search.  Returns the job, or None if no
+        such job is admitted."""
+        with self._cv:
+            j = self._jobs.get(job_id)
+            if j is not None:
+                j.joined += 1
+            return j
+
+    def wait_terminal(
+        self, job_id: str, timeout_s: float
+    ) -> Optional[ServeJob]:
+        """Blocks until the job is terminal (DONE or QUARANTINED) AND
+        its worker has landed artifacts and merged its fork — the
+        long-poll primitive behind ``GET /v1/jobs/<id>?wait=N``.  Pure
+        condition-variable wait: zero device syncs, zero polling of
+        job state from the HTTP thread.  Returns the job (terminal or
+        not at timeout), or None if unknown."""
+        deadline = time.perf_counter() + max(0.0, timeout_s)
+        with self._cv:
+            while True:
+                j = self._jobs.get(job_id)
+                if j is None:
+                    return None
+                if j.state in TERMINAL and job_id not in self._workers:
+                    return j
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return j
+                self._cv.wait(min(left, 0.5))
+
+    def result_files(self, job_id: str) -> List[str]:
+        """Absolute paths of a DONE job's result circuits, recovered
+        from its journal's ``run_done`` record (``beam`` carries the
+        state basenames) — the artifact surface a network responder
+        streams back.  Host-side file reads only; empty when the job
+        is not terminal or its artifacts are gone."""
+        with self._cv:
+            j = self._jobs.get(job_id)
+        if j is None or j.state not in TERMINAL:
+            return []
+        job_dir = self._job_dir(j)
+        records = SearchJournal.load_records(job_dir)
+        beam: List[str] = []
+        for rec in records:
+            if rec.get("type") == "run_done":
+                beam = list(rec.get("beam") or [])
+        out = []
+        for name in beam:
+            path = os.path.join(job_dir, os.path.basename(str(name)))
+            if os.path.exists(path):
+                out.append(path)
+        return out
+
+    def _notify_terminal(self, job: ServeJob) -> None:
+        """Fires the owner's :attr:`on_terminal` observer (outside
+        ``_cv``, exception-guarded): the admission journal's durable
+        "done" marker rides here, and a failing observer must never
+        take a worker — or an admission — down."""
+        cb = self.on_terminal
+        if cb is None:
+            return
+        try:
+            cb(job)
+        except Exception as e:
+            logger.warning(
+                "serve: on_terminal observer failed for job %s: %r",
+                job.job_id, e,
+            )

@@ -133,6 +133,8 @@ class StatusServer:
         host: str = "127.0.0.1",
         extra: Optional[Dict[str, Callable[[], object]]] = None,
         gates_fn: Optional[Callable[[], Optional[int]]] = None,
+        request_timeout_s: float = 5.0,
+        max_body: int = 65536,
     ):
         self.registry = registry
         self.extra = extra
@@ -142,7 +144,25 @@ class StatusServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Per-connection socket timeout (StreamRequestHandler honors
+            # the class attribute): the server is single-threaded, so
+            # without it ONE half-open or slowloris client would wedge
+            # /status for everyone — with it the stdlib cuts the
+            # connection off and the serve loop moves on.
+            timeout = float(request_timeout_s)
+
             def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+                # Bounded request size: /status takes no body, so any
+                # advertised payload past the bound is refused unread
+                # (the admission endpoint's 413 treatment, shared
+                # substrate discipline).
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = 0
+                if length > int(max_body):
+                    self.send_error(413, "request body too large")
+                    return
                 if self.path.split("?", 1)[0] not in ("/status", "/"):
                     self.send_error(404, "try /status")
                     return

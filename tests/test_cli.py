@@ -551,3 +551,84 @@ def test_cli_no_warmup_and_explicit_compile_cache(tmp_path):
                "--compile-cache", "", FA, "--output-dir", d2])
     assert rc == 0
     assert not os.path.isdir(os.path.join(d2, "xla_cache"))
+
+
+# -- the network admission flags (--serve-port / --serve-token-file) -------
+
+
+def test_serve_net_flag_rejections_one_line(tmp_path, monkeypatch,
+                                            capsys):
+    """The admission-server flags fail closed at the CLI: every
+    incoherent combination, unusable token file, and unusable port is
+    a one-line error BEFORE the engine spins up — never a traceback,
+    never an open (unauthenticated) listener."""
+    import json
+    import socket
+
+    monkeypatch.chdir(tmp_path)
+    d = str(tmp_path / "out")
+    tok = str(tmp_path / "tokens.json")
+    with open(tok, "w") as f:
+        json.dump({"version": 1,
+                   "tenants": {"a": {"token": "t"}}}, f)
+    os.chmod(tok, 0o600)
+    # A bound socket makes "port in use" deterministic.
+    taken = socket.socket()
+    taken.bind(("127.0.0.1", 0))
+    busy = str(taken.getsockname()[1])
+    bad = str(tmp_path / "nope.json")
+    world = str(tmp_path / "world.json")
+    with open(world, "w") as f:
+        f.write("{}")
+    os.chmod(world, 0o666)
+    serve = ["--serve", DES, "--output-dir", d]
+    try:
+        for argv in (
+            ["--serve-port", "0", DES],              # needs --serve
+            ["--serve-token-file", tok] + serve,     # needs --serve-port
+            ["--serve-port", "0"] + serve,           # needs token file
+            ["--serve-port", "70000",
+             "--serve-token-file", tok] + serve,     # bad port
+            ["--serve-port", "0",
+             "--serve-token-file", bad] + serve,     # missing file
+            ["--serve-port", "0",
+             "--serve-token-file", world] + serve,   # world-writable
+            ["--serve-port", busy,
+             "--serve-token-file", tok] + serve,     # port in use
+        ):
+            rc = main(argv)
+            assert rc != 0, argv
+            err = capsys.readouterr().err
+            assert err.strip().count("\n") == 0, (argv, err)
+            assert "Traceback" not in err
+            assert not list(tmp_path.glob("search.journal.*")), argv
+    finally:
+        taken.close()
+
+
+def test_resume_journal_without_serve_net_keys(tmp_path, capsys):
+    """A run journal written before serve_port/serve_token_file existed
+    resumes with their defaults (no admission server) instead of being
+    rejected as an incompatible build — the same back-compat contract
+    the serve keys themselves got."""
+    import json
+
+    d = str(tmp_path)
+    rc = main([FA, "-i", "1", "-o", "0", "-l", "--seed", "3",
+               "--output-dir", d])
+    assert rc == 0
+    jpath = os.path.join(d, "search.journal.jsonl")
+    recs = [json.loads(line) for line in open(jpath)]
+    for key in ("serve_port", "serve_token_file"):
+        assert key in recs[0]["config"]
+        assert recs[0]["config"][key] is None
+        del recs[0]["config"][key]
+    with open(jpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in recs)
+    os.unlink(os.path.join(d, "search.journal.json"))  # stale snapshot
+    capsys.readouterr()
+    rc = main(["--resume-run", d])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "incompatible build" not in out.err
+    assert "nothing to resume" in out.out
