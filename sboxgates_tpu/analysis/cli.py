@@ -198,9 +198,17 @@ def _as_payload(reports: List[FileReport]) -> dict:
     }
 
 
-def _as_sarif(reports: List[FileReport]) -> dict:
+def _as_sarif(reports: List[FileReport],
+              baseline_keys: frozenset = frozenset()) -> dict:
     """SARIF 2.1.0 view of the unsuppressed findings — the interchange
-    format CI diff-annotation tooling consumes."""
+    format CI diff-annotation tooling consumes.
+
+    ``baseline_keys``: (path, line, rule) triples from the committed
+    ``--baseline``.  A finding the baseline already accounts for is
+    still emitted (the log stays a complete scan record) but carries a
+    ``suppressions`` entry of kind ``external`` (§3.27.23: suppressed
+    outside the source, here by the baseline file), so CI annotators
+    show only genuinely new results."""
     findings, _ = _flatten(reports)
     return {
         "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
@@ -237,6 +245,11 @@ def _as_sarif(reports: List[FileReport]) -> dict:
                                 }
                             }
                         ],
+                        **(
+                            {"suppressions": [{"kind": "external"}]}
+                            if (f.path, f.line, f.rule) in baseline_keys
+                            else {}
+                        ),
                     }
                     for f in sorted(
                         findings,
@@ -403,9 +416,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings, suppressed = _flatten(reports)
     payload = _as_payload(reports)
 
+    # The baseline is read up front: the SARIF export marks
+    # baseline-matched results as externally suppressed, so it needs
+    # the key set before writing (the exit-code comparison below reuses
+    # the same set).
+    base_set: Optional[set] = None
+    if args.baseline:
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as f:
+                base = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"jaxlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        base_set = {
+            (d["path"], d["line"], d["rule"])
+            for d in base.get("findings", ())
+        }
+
     if args.sarif:
         with open(args.sarif, "w", encoding="utf-8") as f:
-            json.dump(_as_sarif(reports), f, indent=1, sort_keys=True)
+            json.dump(
+                _as_sarif(reports, frozenset(base_set or ())),
+                f, indent=1, sort_keys=True,
+            )
             f.write("\n")
 
     if args.diff_base:
@@ -472,16 +505,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{len(reports)} file(s) scanned"
         )
 
-    if args.baseline:
-        try:
-            with open(args.baseline, "r", encoding="utf-8") as f:
-                base = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
-            print(f"jaxlint: cannot read baseline: {e}", file=sys.stderr)
-            return 2
-        base_set = {
-            (d["path"], d["line"], d["rule"]) for d in base.get("findings", ())
-        }
+    if base_set is not None:
         now_set = {(f.path, f.line, f.rule) for f in findings}
         new = now_set - base_set
         fixed = base_set - now_set

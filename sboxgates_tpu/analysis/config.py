@@ -21,8 +21,13 @@ FILE_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 #: contract-verification passes: registry drift, bucket discipline,
 #: lock ordering.  R10/R11/R12 are the protocol/determinism/durability
 #: shadows: replicated-protocol divergence, determinism taint, and
-#: durable-write discipline.
-CROSS_RULES = ("R1x", "R2x", "R4x", "R7", "R8", "R9", "R10", "R11", "R12")
+#: durable-write discipline.  R13/R14/R15 are the network-tier
+#: trust-boundary shadows: untrusted-input taint, admission-order
+#: dominance, and resource lifecycle.
+CROSS_RULES = (
+    "R1x", "R2x", "R4x", "R7", "R8", "R9",
+    "R10", "R11", "R12", "R13", "R14", "R15",
+)
 ALL_RULES = FILE_RULES + CROSS_RULES
 
 #: Defaults mirror the committed pyproject table so API callers that never
@@ -121,6 +126,99 @@ DEFAULT_DURABLE_MODULES = (
 #: os.replace ARE the tmp+fsync+atomic-replace discipline).
 DEFAULT_DURABLE_HELPERS = ("durable_write_text",)
 
+#: Modules that parse network requests (R13 sources seed here; R14
+#: dominance applies to these functions' bodies).
+DEFAULT_HANDLER_MODULES = ("sboxgates_tpu/serve_net/*",)
+
+#: Calls whose RESULT is request-derived (R13 sources).  Dotted entries
+#: match like deterministic_sinks: "headers.get" matches
+#: ``h.headers.get(...)``; a bare entry matches the call tail.
+DEFAULT_UNTRUSTED_SOURCES = (
+    "headers.get",
+    "rfile.read",
+    "urlsplit",
+    "parse_qs",
+    "recv",
+)
+
+#: Calls whose RESULT is trusted even when their inputs are tainted
+#: (R13): schema validators, int/range coercion, canonical-key and
+#: digest derivation, and the token-file-backed authenticator.
+DEFAULT_SANITIZERS = (
+    "int",
+    "float",
+    "len",
+    "parse_sbox",
+    "authenticate",
+    "canonicalize",
+    "exact_key",
+    "exact_multi_key",
+    "blake2b",
+    "sha256",
+    "hexdigest",
+)
+
+#: Sensitive sinks (R13): filesystem path construction, journal/store
+#: record fields, the fault-scope tenant tag, and process spawns.
+#: Dotted entries match like deterministic_sinks.
+DEFAULT_TRUST_SINKS = (
+    "path.join",
+    "open",
+    "journal.admit",
+    "journal.append",
+    "set_tenant",
+    "subprocess.run",
+    "subprocess.Popen",
+    "subprocess.call",
+    "os.system",
+    "os.remove",
+    "os.rename",
+)
+
+#: Authentication / rate-limit call sites (R14): every effectful call
+#: in a handler body must be dominated by one.
+DEFAULT_AUTH_SITES = ("authenticate", "allow")
+
+#: Quota check sites (R14): fresh-admission effects must also be
+#: dominated by one of these.
+DEFAULT_QUOTA_SITES = ("active_jobs",)
+
+#: Fsync'd admission-journal appends (R14): every 202-class response
+#: write must be dominated by one.
+DEFAULT_JOURNAL_SITES = ("journal.admit", "journal.append")
+
+#: Effectful calls in handler bodies (R14): orchestrator enqueue/join
+#: and durable admission records.
+DEFAULT_EFFECT_SITES = ("orch.submit", "orch.join", "journal.admit")
+
+#: Response-writing helpers (R14): a call with a constant 201/202
+#: status argument is an admission acknowledgement.
+DEFAULT_RESPONSE_SITES = ("_send_json", "send_response")
+
+#: Resource constructors (R15): sockets, listeners, threads, temp
+#: files.  A project class whose base's name tail matches one of these
+#: counts too (``class Server(ThreadingHTTPServer)``).
+DEFAULT_RESOURCE_CTORS = (
+    "socket.socket",
+    "create_connection",
+    "ThreadingHTTPServer",
+    "HTTPServer",
+    "TCPServer",
+    "Thread",
+    "Timer",
+    "mkstemp",
+    "NamedTemporaryFile",
+    "TemporaryFile",
+)
+
+#: Teardown registries (R15): handing a resource (or a closure over
+#: one) to these counts as a release on all paths.
+DEFAULT_TEARDOWN_REGISTRIES = (
+    "drain_hooks",
+    "_teardown",
+    "atexit.register",
+)
+
 
 @dataclass
 class JaxlintConfig:
@@ -174,6 +272,39 @@ class JaxlintConfig:
     #: "site: reason" strings waiving chaos coverage for declared fault
     #: sites that cannot be exercised by an armed test.
     chaos_waivers: List[str] = field(default_factory=list)
+    handler_modules: List[str] = field(
+        default_factory=lambda: list(DEFAULT_HANDLER_MODULES)
+    )
+    untrusted_sources: List[str] = field(
+        default_factory=lambda: list(DEFAULT_UNTRUSTED_SOURCES)
+    )
+    sanitizers: List[str] = field(
+        default_factory=lambda: list(DEFAULT_SANITIZERS)
+    )
+    trust_sinks: List[str] = field(
+        default_factory=lambda: list(DEFAULT_TRUST_SINKS)
+    )
+    auth_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_AUTH_SITES)
+    )
+    quota_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_QUOTA_SITES)
+    )
+    journal_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_JOURNAL_SITES)
+    )
+    effect_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_EFFECT_SITES)
+    )
+    response_sites: List[str] = field(
+        default_factory=lambda: list(DEFAULT_RESPONSE_SITES)
+    )
+    resource_ctors: List[str] = field(
+        default_factory=lambda: list(DEFAULT_RESOURCE_CTORS)
+    )
+    teardown_registries: List[str] = field(
+        default_factory=lambda: list(DEFAULT_TEARDOWN_REGISTRIES)
+    )
 
     def is_hot(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
@@ -190,6 +321,10 @@ class JaxlintConfig:
     def is_durable(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
         return any(fnmatch.fnmatch(rp, pat) for pat in self.durable_modules)
+
+    def is_handler(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rp, pat) for pat in self.handler_modules)
 
 
 _STR = r'"((?:[^"\\]|\\.)*)"'
@@ -302,6 +437,10 @@ def load_config(start: str = ".") -> JaxlintConfig:
         "dispatch_modules", "bucket_sources", "blocking_calls",
         "rank_sources", "agreement_sites", "deterministic_sinks",
         "durable_modules", "durable_helpers", "chaos_waivers",
+        "handler_modules", "untrusted_sources", "sanitizers",
+        "trust_sinks", "auth_sites", "quota_sites", "journal_sites",
+        "effect_sites", "response_sites", "resource_ctors",
+        "teardown_registries",
     ):
         val = table.get(key)
         if isinstance(val, list) and all(isinstance(x, str) for x in val):

@@ -412,6 +412,63 @@ def analyze_project(
         for path, items in run_r12(graph, config).items():
             extra.setdefault(path, []).extend(items)
 
+    # Trust-boundary shadows (R13/R14/R15): the static mirrors of the
+    # network tier's auth-before-effect, journal-before-202, and
+    # drain-safe-teardown runtime contracts.
+    if "R13" in config.rules:
+        from .trustflow import run_r13, untrusted_sites
+
+        ran.add("R13")
+        ack13: Set[Tuple[str, int]] = set()
+        for fa in analyses:
+            for s in fa.sups:
+                if "R13" not in s.rules:
+                    continue
+                ack13.add((fa.path, s.line))
+                if s.standalone:
+                    ack13.add((fa.path, s.line + 1))
+        for path, items in run_r13(graph, config, ack13).items():
+            extra.setdefault(path, []).extend(items)
+        # The R2x/R11 acknowledged-source contract, for request taint:
+        # a valid R13 marker ON the untrusted source kills the taint
+        # for every consumer, and the source is re-emitted as a
+        # suppressed finding so the marker is never stale and the
+        # baseline documents the acknowledged-input inventory.
+        src13 = untrusted_sites(graph, config)
+        for fa in analyses:
+            for sup in fa.sups:
+                if "R13" not in sup.rules:
+                    continue
+                lines = [sup.line]
+                if sup.standalone:
+                    lines.append(sup.line + 1)
+                for line in lines:
+                    hit = src13.get((fa.path, line))
+                    if hit is not None:
+                        extra.setdefault(fa.path, []).append(
+                            (
+                                "R13",
+                                line,
+                                hit[0],
+                                f"deliberate untrusted input at its "
+                                f"source ({hit[1]}): acknowledged — "
+                                "sinks are not tainted by this site",
+                            )
+                        )
+                        break
+    if "R14" in config.rules:
+        from .ordering import run_r14
+
+        ran.add("R14")
+        for path, items in run_r14(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+    if "R15" in config.rules:
+        from .lifecycle import run_r15
+
+        ran.add("R15")
+        for path, items in run_r15(graph, config).items():
+            extra.setdefault(path, []).extend(items)
+
     reports: List[FileReport] = []
     for fa in analyses:
         # Every x-rule that ran is judged for stale markers — including

@@ -110,6 +110,30 @@ RULE_DOCS = {
         "tmp+fsync+atomic-replace helper (durable_write_text) — a kill "
         "mid-write leaves a torn file the recovery path must never see"
     ),
+    "R13": (
+        "untrusted-input taint: a request-derived value (headers, body "
+        "fields, query params, path segments) reaches a sensitive sink "
+        "(filesystem path construction, journal/store record fields, "
+        "faults.set_tenant, process spawns) without passing a declared "
+        "sanitizer (schema validator, int/range coercion, canonical-key "
+        "or digest derivation) — path traversal and unvalidated tenant "
+        "names, caught structurally"
+    ),
+    "R14": (
+        "admission-order discipline: an effectful call in a handler "
+        "body (orchestrator enqueue/join, durable admission record) not "
+        "dominated by the auth+quota check sites, or a 2xx admission "
+        "response not dominated by the fsync'd admission-journal append "
+        "— the fail-closed-auth-before-effects and journal-before-202 "
+        "contracts, on every path"
+    ),
+    "R15": (
+        "resource lifecycle: a socket/listener/thread/temp-file "
+        "acquisition not released on all exit paths (with/try-finally, "
+        "ownership transfer via return or hand-off, teardown-registry "
+        "registration, or a class teardown for self-stored resources) — "
+        "a failed bind must never leak an ephemeral listener"
+    ),
     "COV": (
         "chaos coverage: a declared fault site (faults.KNOWN_SITES) with "
         "no armed test and no [tool.jaxlint] chaos_waivers entry, or a "
@@ -998,6 +1022,12 @@ class FileReport:
     path: str
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
+    #: rules this scan actually executed against the file (per-file
+    #: rules that applied, plus every cross-module pass when the
+    #: whole-program driver ran) — the gate asserts registry parity on
+    #: this set, so a rule silently dropping out of the default config
+    #: is a test failure, not a quiet coverage loss
+    checked: Set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -1092,7 +1122,9 @@ def finalize_report(
     """Matches raw findings (per-file + ``extra_raw`` from cross-module
     passes) against the file's suppressions, and reports stale markers
     for every rule in ``checked`` ∪ ``extra_checked``."""
-    report = FileReport(path=fa.path)
+    report = FileReport(
+        path=fa.path, checked=set(fa.checked) | set(extra_checked)
+    )
     if fa.parse_finding is not None:
         report.findings.append(fa.parse_finding)
         return report

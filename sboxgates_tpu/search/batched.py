@@ -425,10 +425,16 @@ def run_batched_circuits(
         threading.Thread(target=worker, args=(i,), name=f"restart-{i}")
         for i in range(n)
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    try:
+        for t in threads:
+            t.start()
+    finally:
+        # Join on every exit path: if a start() raises mid-loop, the
+        # already-running workers must not keep mutating results/ctx
+        # after the exception propagates to the caller.
+        for t in threads:
+            if t.ident is not None:  # started
+                t.join()
     if errors:
         raise errors[0]
     ctx.stats.inc("restart_batch_dispatches", rdv.stats["dispatches"])

@@ -452,10 +452,16 @@ def _run_fleet_wave(ctx, jobs: List[tuple]) -> List[tuple]:
         threading.Thread(target=worker, args=(i,), name=f"fleet-{i}")
         for i in range(n)
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    try:
+        for t in threads:
+            t.start()
+    finally:
+        # Join on every exit path: if a start() raises mid-loop, the
+        # already-running workers must not keep mutating results/ctx
+        # after the exception propagates to the caller.
+        for t in threads:
+            if t.ident is not None:  # started
+                t.join()
     if errors:
         raise errors[0]
     fleet_stats_into(ctx, rdv)

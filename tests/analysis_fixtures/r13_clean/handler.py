@@ -1,0 +1,22 @@
+"""The clean twin: every request-derived value is laundered through a
+declared sanitizer (int coercion, digest derivation) before any sink,
+and a record looked up BY a tainted key is not itself tainted."""
+import hashlib
+import os
+
+from records import record_job
+
+
+class Handler:
+    def post(self, h):
+        idx = int(h.headers.get("X-Index", "0"))
+        body = h.rfile.read(64)
+        path = os.path.join("/jobs", f"job-{idx}")
+        tag = hashlib.blake2b(body).hexdigest()
+        record_job(tag)
+        return path
+
+    def get(self, h):
+        job_id = h.headers.get("X-Job-Id")
+        job = self.jobs.get(job_id)
+        record_job(job)

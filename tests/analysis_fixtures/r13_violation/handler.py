@@ -1,0 +1,21 @@
+"""A network handler whose request-derived values reach sensitive
+sinks raw: one sink in this module, one two calls away (the flow an
+intraprocedural linter cannot see), plus an acknowledged source whose
+marker kills the taint and re-emits as a suppressed inventory entry."""
+import os
+
+from records import record_job
+
+
+class Handler:
+    def post(self, h):
+        name = h.headers.get("X-Job-Name")
+        body = h.rfile.read(64)
+        path = os.path.join("/jobs", name)
+        record_job(body)
+        return path
+
+    def post_acked(self, h):
+        # jaxlint: ignore[R13] demo acknowledged source: the tag is recorded verbatim by design
+        tag = h.headers.get("X-Tag")
+        record_job(tag)

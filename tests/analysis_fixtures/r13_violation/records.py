@@ -1,0 +1,14 @@
+"""NOT a handler module: the journal sink fires here only because the
+caller's request-derived argument taints the parameter."""
+
+
+class Journal:
+    def append(self, rec):
+        self.rec = rec
+
+
+journal = Journal()
+
+
+def record_job(body):
+    journal.append({"raw": body})

@@ -1,0 +1,27 @@
+"""Admission handlers that break the auth -> quota -> journal order:
+an unguarded effect, a one-sided (non-dominating) check, a 202 written
+before the journal append, and an acknowledged deliberate site."""
+
+
+class Handler:
+    def _send_json(self, h, status, doc):
+        pass
+
+    def post_unchecked(self, h):
+        self.orch.submit(h.job)
+
+    def post_one_sided(self, h):
+        if h.token:
+            self.authenticate(h)
+            self.active_jobs(h)
+        self.orch.submit(h.job)
+
+    def post_unjournaled(self, h):
+        self.authenticate(h)
+        self.active_jobs(h)
+        self.orch.submit(h.job)
+        self._send_json(h, 202, {})
+
+    def post_acked(self, h):
+        # jaxlint: ignore[R14] demo deliberate replay path: checks ran at the original accept
+        self.orch.submit(h.job)

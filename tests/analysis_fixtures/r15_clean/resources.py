@@ -1,0 +1,46 @@
+"""The clean twin: every acquisition is released on all exit paths
+(finally / with), transferred to the caller, exempt by declaration
+(daemon), registered with a teardown registry, or released by a class
+teardown method."""
+import socket
+import threading
+
+from http.server import HTTPServer
+
+
+def probe(host):
+    s = socket.socket()
+    try:
+        s.connect((host, 80))
+        s.send(b"ping")
+    finally:
+        s.close()
+
+
+def scoped(host):
+    with socket.socket() as s:
+        s.connect((host, 80))
+
+
+def make_worker():
+    t = threading.Thread(target=print)
+    return t
+
+
+def daemon_watcher():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+def registered(drain_hooks):
+    s = socket.socket()
+    drain_hooks.append(s.close)
+    return None
+
+
+class Holder:
+    def open_server(self):
+        self.srv = HTTPServer(("", 0), None)
+
+    def close(self):
+        self.srv.server_close()

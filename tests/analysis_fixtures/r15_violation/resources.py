@@ -1,0 +1,37 @@
+"""Resources acquired without an all-paths release: straight-line
+close/join (an exception between acquire and release leaks), a
+fire-and-forget constructor, a self-stored server no method tears
+down, and an acknowledged deliberate leak."""
+import socket
+import threading
+
+from http.server import HTTPServer
+
+
+def leaky_probe(host):
+    s = socket.socket()
+    s.connect((host, 80))
+    s.send(b"ping")
+    s.close()
+
+
+def leaky_workers(n):
+    ts = [threading.Thread(target=print) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def fire_and_forget():
+    threading.Thread(target=print).start()
+
+
+class Holder:
+    def open_server(self):
+        self.srv = HTTPServer(("", 0), None)
+
+
+def acked_probe(host):
+    s = socket.socket()  # jaxlint: ignore[R15] demo deliberate leak: process-lifetime probe socket
+    s.connect((host, 80))

@@ -25,7 +25,8 @@ FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 #: unpinned Thread targets those packs spawn to exercise R4/R4x.
 LEGACY_RULES = [
     r for r in ALL_RULES
-    if r not in ("R7", "R8", "R9", "R10", "R11", "R12")
+    if r not in ("R7", "R8", "R9", "R10", "R11", "R12",
+                 "R13", "R14", "R15")
 ]
 
 
@@ -611,6 +612,30 @@ CONTRACT_PACKS = {
             ("R12", "persist.py", 10),  # raw os.replace
         ],
     ),
+    "r13_violation": (
+        dict(rules=["R13"], handler_modules=["handler.py"]),
+        [
+            ("R13", "handler.py", 14),  # raw header name into path.join
+            ("R13", "records.py", 14),  # body taint via param, cross-module
+        ],
+    ),
+    "r14_violation": (
+        dict(rules=["R14"], handler_modules=["handler.py"]),
+        [
+            ("R14", "handler.py", 11),  # effect with no checks at all
+            ("R14", "handler.py", 17),  # checks in one if-arm only
+            ("R14", "handler.py", 23),  # 202 with no journal append
+        ],
+    ),
+    "r15_violation": (
+        dict(rules=["R15"]),
+        [
+            ("R15", "resources.py", 12),  # straight-line close
+            ("R15", "resources.py", 19),  # straight-line join of list
+            ("R15", "resources.py", 27),  # constructed and discarded
+            ("R15", "resources.py", 32),  # self-stored, no teardown
+        ],
+    ),
 }
 
 CONTRACT_CLEAN = {
@@ -623,6 +648,9 @@ CONTRACT_CLEAN = {
     "r11_clean": dict(rules=["R11"]),
     "r12_clean": dict(rules=["R12"], durable_modules=["*"],
                       durable_helpers=["durable_write_text"]),
+    "r13_clean": dict(rules=["R13"], handler_modules=["handler.py"]),
+    "r14_clean": dict(rules=["R14"], handler_modules=["handler.py"]),
+    "r15_clean": dict(rules=["R15"]),
 }
 
 
@@ -1004,3 +1032,104 @@ def test_r8_constant_assigned_local_is_static(tmp_path):
     assert pack_found(lint_project(config=cfg)) == [
         ("R8", "driver.py", 10)
     ]
+
+
+# -- trust-boundary packs (R13-R15, ISSUE 19) ------------------------------
+
+
+def test_r13_messages_carry_witness_sink_and_remedy():
+    kwargs, _ = CONTRACT_PACKS["r13_violation"]
+    reports = lint_pack("r13_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    m = by_site[("handler.py", 14)]
+    assert "headers.get" in m and "path.join" in m and "sanitizer" in m
+    # The cross-module sink names the ORIGINAL request source, not the
+    # intermediate parameter.
+    m = by_site[("records.py", 14)]
+    assert "rfile.read" in m and "journal.append" in m
+
+
+def test_r13_acknowledged_source_kills_taint_and_stays_inventoried():
+    """The R2x/R11 on-source marker contract for R13: a marker on the
+    SOURCE line suppresses every downstream sink finding, and the
+    source re-emits as a suppressed "acknowledged" entry so the marker
+    is never stale."""
+    kwargs, expected = CONTRACT_PACKS["r13_violation"]
+    reports = lint_pack("r13_violation", **kwargs)
+    assert pack_found(reports) == expected  # no post_acked sink finding
+    sups = [
+        (f.rule, r.path, f.line, f.message)
+        for r in reports
+        for f in r.suppressed
+    ]
+    assert [(s[0], s[1], s[2]) for s in sups] == [
+        ("R13", "handler.py", 20)
+    ]
+    assert "acknowledged" in sups[0][3]
+
+
+def test_r14_messages_hint_at_the_other_path():
+    kwargs, _ = CONTRACT_PACKS["r14_violation"]
+    reports = lint_pack("r14_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    # No check anywhere: the message says so outright.
+    assert "no auth site on any path" in by_site[("handler.py", 11)]
+    # One-sided check: the message names where the check DOES run.
+    m = by_site[("handler.py", 17)]
+    assert "runs on another path" in m and "line 15" in m
+    # Unjournaled 202: names the crash-loses-a-job consequence.
+    m = by_site[("handler.py", 23)]
+    assert "no journal append on any path" in m and "crash" in m
+
+
+def test_r14_inline_suppression_covers_deliberate_effects():
+    kwargs, _ = CONTRACT_PACKS["r14_violation"]
+    reports = lint_pack("r14_violation", **kwargs)
+    sups = [
+        (f.rule, r.path, f.line)
+        for r in reports
+        for f in r.suppressed
+    ]
+    assert ("R14", "handler.py", 27) in sups
+
+
+def test_r14_clean_twin_hoists_auth_into_shared_helper():
+    """The clean twin's auth check lives in ``_auth`` — dominance must
+    credit the helper call via the call graph's reach map, or every
+    real-world refactor would need a marker."""
+    src = open(
+        os.path.join(FIXTURES, "r14_clean", "handler.py"),
+        encoding="utf-8",
+    ).read()
+    assert "def _auth" in src and "self._auth(h)" in src
+    assert pack_found(
+        lint_pack("r14_clean", **CONTRACT_CLEAN["r14_clean"])
+    ) == []
+
+
+def test_r15_messages_and_inline_suppression():
+    kwargs, _ = CONTRACT_PACKS["r15_violation"]
+    reports = lint_pack("r15_violation", **kwargs)
+    by_site = {
+        (r.path, f.line): f.message
+        for r in reports
+        for f in r.findings
+    }
+    assert "socket.socket" in by_site[("resources.py", 12)]
+    assert "finally" in by_site[("resources.py", 12)]
+    assert "discarded" in by_site[("resources.py", 27)]
+    assert "self.srv" in by_site[("resources.py", 32)]
+    sups = [
+        (f.rule, r.path, f.line)
+        for r in reports
+        for f in r.suppressed
+    ]
+    assert ("R15", "resources.py", 36) in sups

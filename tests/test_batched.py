@@ -115,3 +115,55 @@ def test_batched_error_propagates(monkeypatch):
     with pytest.raises(RuntimeError, match="kernel boom"):
         # device-kernel path forced so the patched kernel is reached
         _run(os.path.join(DATA, "crypto1_fa.txt"), 3, host_small_steps=False)
+
+
+def test_batched_workers_joined_when_start_fails(monkeypatch):
+    """Regression (jaxlint R15): when a mid-loop ``Thread.start()``
+    raises (thread limit), the workers already running must be joined
+    before the exception propagates — the caller must never race live
+    restart threads over ``results``/``ctx`` stats."""
+    import threading
+    import time
+
+    import pytest
+
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.search import batched
+    from sboxgates_tpu.search.batched import run_batched_circuits
+
+    sbox, n = load_sbox(os.path.join(DATA, "crypto1_fa.txt"))
+    targets = make_targets(sbox)
+    mask = tt.mask_table(n)
+    # lut_graph forces the threaded driver: the single-core sequential
+    # fast path only covers gate-mode host-only batches.
+    ctx = SearchContext(
+        Options(seed=9, iterations=2, batch_restarts=True, lut_graph=True)
+    )
+    st = State.init_inputs(n)
+    jobs = [(st.copy(), targets[0], mask) for _ in range(2)]
+
+    first_worker_finished = threading.Event()
+
+    def slow_create(rctx, nst, target, m, gates):
+        time.sleep(0.2)
+        first_worker_finished.set()
+        return NO_GATE
+
+    monkeypatch.setattr(batched, "create_circuit", slow_create)
+
+    real_start = threading.Thread.start
+    started = []
+
+    def flaky_start(self):
+        if started:
+            raise RuntimeError("can't start new thread")
+        started.append(self)
+        real_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", flaky_start)
+    with pytest.raises(RuntimeError, match="can't start new thread"):
+        run_batched_circuits(ctx, jobs)
+    # The join ran on the error path: worker 0 completed before the
+    # exception escaped, and its thread is gone.
+    assert first_worker_finished.is_set()
+    assert not started[0].is_alive()

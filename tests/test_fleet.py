@@ -722,3 +722,40 @@ def test_fleet_staged_lut7_stream_merge():
     # merged: at least one multi-lane fleet dispatch happened.
     assert rdv.stats["fleet_dispatches"] >= 1
     assert rdv.stats["batched_rows"] >= 2
+
+
+def test_fleet_workers_joined_when_start_fails(monkeypatch):
+    """Regression (jaxlint R15): a mid-loop ``Thread.start()`` failure
+    inside a fleet wave joins the already-running workers before the
+    exception propagates (same contract as the batched driver)."""
+    import time
+
+    from sboxgates_tpu.search import kwan
+
+    ctx = SearchContext(Options(fleet=True, **DEV))
+    st, target, mask = build_planted_lut5_small()
+    jobs = [(st.copy(), target, mask) for _ in range(2)]
+
+    first_worker_finished = threading.Event()
+
+    def slow_create(rctx, nst, t, m, gates):
+        time.sleep(0.2)
+        first_worker_finished.set()
+        return NO_GATE
+
+    monkeypatch.setattr(kwan, "create_circuit", slow_create)
+
+    real_start = threading.Thread.start
+    started = []
+
+    def flaky_start(self):
+        if started:
+            raise RuntimeError("can't start new thread")
+        started.append(self)
+        real_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", flaky_start)
+    with pytest.raises(RuntimeError, match="can't start new thread"):
+        _run_fleet_wave(ctx, jobs)
+    assert first_worker_finished.is_set()
+    assert not started[0].is_alive()
