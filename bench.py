@@ -2184,6 +2184,117 @@ def bench_engine_mux_threads() -> dict:
     }
 
 
+def bench_order_ab() -> list:
+    """``--order-ab``: lexicographic vs spectral candidate ordering over
+    a planted serve mix (four mixed-gate G=24 states, targets planted on
+    the HIGHEST gates so they sit at the tail of the lex rank space —
+    the regime best-first ordering exists for).  Reports per-target
+    candidates-scanned-to-first-hit and p50/p99 time-to-first-hit for
+    both arms, plus the three structural fields ``--check order`` gates
+    on: the exhaustive hit set is unchanged (7-LUT collector, every hit,
+    both orders), spectral scans <= lex on >= 3 of the 4 planted targets
+    (dispatch-count-based, so it holds on CPU CI), and two spectral runs
+    are bit-identical (same hit, same draw/dispatch counts).
+
+    The 5-LUT stream chunk is shrunk to 1024 ranks for this section
+    (saved/restored) so C(24,5) = 42504 spans many chunks — with the
+    production 128Ki chunk these spaces are one dispatch and ordering
+    correctly never engages; the production win regime is G >= ~90."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(HERE, "tests"))
+    from planted import build_planted_lut7, verify_lut5_result
+
+    from sboxgates_tpu.core import boolfunc as bf
+    from sboxgates_tpu.core import ttable as tt
+    from sboxgates_tpu.graph.state import GATES, State
+    from sboxgates_tpu.search import Options, SearchContext
+    from sboxgates_tpu.search import context as sctx
+    from sboxgates_tpu.search import lut as slut
+
+    def planted(seed):
+        rng = np.random.default_rng(seed)
+        st = State.init_inputs(8)
+        funs = [bf.AND, bf.OR, bf.XOR, bf.A_AND_NOT_B]
+        while st.num_gates < 24:
+            a, b = rng.choice(st.num_gates, size=2, replace=False)
+            st.add_gate(funs[rng.integers(len(funs))], int(a), int(b), GATES)
+        outer = tt.eval_lut(0x2D, st.table(19), st.table(21), st.table(23))
+        target = tt.eval_lut(0xB4, outer, st.table(20), st.table(22))
+        return st, target, tt.mask_table(8)
+
+    def run(order, seed):
+        st, target, mask = planted(seed)
+        ctx = SearchContext(Options(seed=7, candidate_order=order))
+        t0 = time.perf_counter()
+        res = slut.lut5_search(ctx, st, target, mask, [])
+        dt = time.perf_counter() - t0
+        assert res is not None and verify_lut5_result(st, target, mask, res)
+        sig = (tuple(int(x) for x in res["gates"]),
+               int(res["func_outer"]), int(res["func_inner"]),
+               ctx.stats["lut5_candidates"],
+               ctx.stats.get("order_tier_dispatches", 0))
+        return dt, ctx.stats["lut5_candidates"], sig
+
+    saved = sctx.STREAM_CHUNK[5]
+    sctx.STREAM_CHUNK[5] = 1024
+    try:
+        seeds = (3, 6, 7, 10)
+        run("lex", seeds[0])  # warm/compile both arms
+        run("spectral", seeds[0])
+        targets, lex_t, spec_t = [], [], []
+        wins = 0
+        deterministic = True
+        for seed in seeds:
+            ldt, lscans, _ = run("lex", seed)
+            sdt, sscans, sig1 = run("spectral", seed)
+            _, _, sig2 = run("spectral", seed)
+            deterministic = deterministic and sig1 == sig2
+            wins += sscans <= lscans
+            lex_t.append(ldt)
+            spec_t.append(sdt)
+            targets.append({
+                "seed": seed, "lex_scans": lscans, "spectral_scans": sscans,
+                "lex_ttfh_s": ldt, "spectral_ttfh_s": sdt,
+            })
+
+        # Hit-SET equivalence at the one driver that collects every hit
+        # rather than stopping at the first: C(22,7) = 170544 spans six
+        # 7-LUT stream chunks at the production chunk size, so the tier
+        # drivers genuinely reorder without the shrunk-chunk override.
+        st7, target7, mask7 = build_planted_lut7(22)
+        rows = {}
+        for order in ("lex", "spectral"):
+            ctx = SearchContext(Options(seed=7, candidate_order=order))
+            combos, req1, req0 = slut._lut7_collect_hits(
+                ctx, st7, target7, mask7, []
+            )
+            rows[order] = {
+                (tuple(int(x) for x in c),
+                 np.asarray(a).tobytes(), np.asarray(b).tobytes())
+                for c, a, b in zip(combos, req1, req0)
+            }
+        hit_set_equal = bool(rows["lex"]) and rows["lex"] == rows["spectral"]
+    finally:
+        sctx.STREAM_CHUNK[5] = saved
+
+    lex_t.sort()
+    spec_t.sort()
+    n = len(seeds)
+    return [{
+        "metric": "order_ab",
+        "value": spec_t[n // 2], "unit": "s",
+        "lex_ttfh_p50_s": lex_t[n // 2], "lex_ttfh_p99_s": lex_t[-1],
+        "spectral_ttfh_p50_s": spec_t[n // 2],
+        "spectral_ttfh_p99_s": spec_t[-1],
+        "spectral_wins": wins, "targets_total": n,
+        "exhaustive_hit_set_equal": hit_set_equal,
+        "spectral_scans_leq_lex_on_planted": wins >= 3,
+        "ordering_deterministic_across_runs": deterministic,
+        "targets": targets,
+    }]
+
+
 def bench_batch_axis_pivot() -> dict:
     """The batch axis in its claimed win regime (VERDICT r2 item 4):
     pivot-sized states (G=140, C(140,5)=416M — every node makes real
@@ -3536,6 +3647,22 @@ BENCH_CHECKS = {
             ("serve_net_drain", "drain_loses_nothing", 0.0, "exact"),
         ],
     ),
+    "order": (
+        # Candidate-ordering drift gate: structural, machine-independent
+        # fields only — the exhaustive 7-LUT hit set is unchanged under
+        # spectral order, spectral scans <= lex (by dispatch/candidate
+        # COUNT, not wall time) on >= 3 of 4 planted targets, and two
+        # spectral runs are bit-identical.
+        bench_order_ab,
+        "BENCH_ORDER.json",
+        [
+            ("order_ab", "exhaustive_hit_set_equal", 0.0, "exact"),
+            ("order_ab", "spectral_scans_leq_lex_on_planted",
+             0.0, "exact"),
+            ("order_ab", "ordering_deterministic_across_runs",
+             0.0, "exact"),
+        ],
+    ),
     "hoststream": (
         bench_host_stream_pipeline,
         "BENCH_PIPELINE.json",
@@ -3700,6 +3827,21 @@ def main() -> None:
         with open(os.path.join(HERE, "BENCH_SERVE.json"), "w") as f:
             json.dump(with_meta(detail), f, indent=1)
         print(json.dumps(detail[1]))
+        return
+    if "--order-ab" in sys.argv:
+        # Standalone mode: the lex-vs-spectral candidate-ordering A/B
+        # over the planted serve mix (p50/p99 time-to-first-hit +
+        # candidates-scanned-to-first-hit per arm, hit-set/determinism
+        # structural fields), written to BENCH_ORDER.json.  CPU-safe.
+        if SMOKE or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        detail = bench_order_ab()
+        with open(os.path.join(HERE, "BENCH_ORDER.json"), "w") as f:
+            json.dump(with_meta(detail), f, indent=1)
+        print(json.dumps(detail[0]))
         return
     if "--store" in sys.argv and "--check" not in sys.argv:
         # Standalone mode: the content-addressed result store A/B

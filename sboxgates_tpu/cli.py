@@ -169,6 +169,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the kernel cannot finish falls back to the "
                         "full recursive search; circuits are "
                         "bit-identical for every N > 0")
+    p.add_argument("--candidate-order", default="lex", metavar="ORDER",
+                   help="sweep-stream candidate order: 'lex' (default) "
+                        "streams rank chunks lexicographically; "
+                        "'spectral' scores the gate tables against the "
+                        "masked target on device (Walsh correlation, "
+                        "ops/spectral.py) and sweeps the score tiers "
+                        "best-first.  Ordering only — run-to-exhaustion "
+                        "visits the identical hit set either way, and "
+                        "the order is a deterministic function of the "
+                        "search state (no RNG, no wall clock), so "
+                        "resume stays bit-identical")
     p.add_argument("--pipeline-depth", type=int, default=2, metavar="N",
                    help="in-flight dispatches / prefetched chunks for the "
                         "streaming sweep drivers (default 2; 1 = serial "
@@ -310,6 +321,11 @@ JOURNAL_CONFIG_KEYS = (
     # draws with per-round seed blocks, so it shapes the draw stream
     # and must be restored on resume.
     "chain_rounds",
+    # Candidate ordering: the tier segmentation changes the DISPATCH
+    # count of every ordered sweep, and each dispatch draws a seed —
+    # so the order shapes the draw stream and must be restored for a
+    # --resume-run to replay bit-identically.
+    "candidate_order",
     # Result store: never shapes the draw stream of a search that runs
     # (a store hit simply doesn't search), but a resumed run must keep
     # publishing to — and consulting — the same store.
@@ -334,6 +350,7 @@ JOURNAL_KEY_DEFAULTS = {
     "serve_retries": 2,
     "serve_timeout": None,
     "chain_rounds": 0,
+    "candidate_order": "lex",
     "result_store": None,
     "serve_port": None,
     "serve_token_file": None,
@@ -579,6 +596,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "--chain-rounds drives the all-outputs graph search; "
                 "it cannot be combined with -o."
             )
+    if args.candidate_order not in ("lex", "spectral"):
+        return _err(f"Bad candidate order value: {args.candidate_order}")
     if args.fleet_candidates < 1:
         return _err(
             f"Bad fleet candidates value: {args.fleet_candidates}"
@@ -819,6 +838,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet_candidates=args.fleet_candidates,
         fleet_max_wave=args.fleet_max_wave,
         chain_rounds=args.chain_rounds,
+        candidate_order=args.candidate_order,
         result_store=args.result_store,
         # jaxlint: ignore[R7] telemetry is observation-only (zero-sync counter-asserted)
         trace=args.trace is not None,

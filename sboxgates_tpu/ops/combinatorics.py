@@ -446,3 +446,53 @@ class ChunkPrefetcher:
     def __exit__(self, *exc) -> bool:
         self.close()
         return False
+
+
+# -------------------------------------------------------------------------
+# Best-first rank-remap streams (spectral candidate ordering)
+# -------------------------------------------------------------------------
+
+
+def tier_segments(
+    chunk_scores: Sequence[int],
+    n_chunks: int,
+    tiers: int = 4,
+) -> list:
+    """Best-first dispatch order over chunk ranges, as contiguous segments.
+
+    The spectral prepass (``ops.sweeps.spectral_score_stream``) returns
+    one score per rank chunk.  Rather than materializing a permutation of
+    C(g, k) ranks, the scores are quantized into ``tiers`` integer tiers
+    and each maximal run of same-tier chunks becomes one segment; the
+    existing chunked ``while_loop`` kernels then sweep segment
+    ``[lo*chunk, hi*chunk)`` ranges best-first through their ordinary
+    (start, total) operands — per-chunk verdicts stay bit-identical to
+    the lexicographic sweep because chunk boundaries never move.
+
+    Returns ``[(lo_chunk, hi_chunk, tier), ...]`` ordered (tier
+    descending, lo ascending).  The segments PARTITION ``[0, n_chunks)``
+    — asserted here, because this is the exhaustiveness contract: scores
+    reorder the sweep, they never shrink it.  Deterministic given the
+    scores (pure integer quantization, no clock, no RNG), so R11 and
+    resume bit-identity hold per (target, mask) config.
+    """
+    from .spectral import quantize_tiers
+
+    s = np.asarray(chunk_scores, dtype=np.int64)[:n_chunks]
+    assert s.shape[0] == n_chunks, (s.shape, n_chunks)
+    if n_chunks <= 0:
+        return []
+    tier = quantize_tiers(s, tiers)
+    runs = []
+    lo = 0
+    for i in range(1, n_chunks):
+        if tier[i] != tier[lo]:
+            runs.append((lo, i, int(tier[lo])))
+            lo = i
+    runs.append((lo, n_chunks, int(tier[lo])))
+    runs.sort(key=lambda r: (-r[2], r[0]))
+    covered = sorted((a, b) for a, b, _ in runs)
+    assert covered[0][0] == 0 and covered[-1][1] == n_chunks and all(
+        covered[i][1] == covered[i + 1][0] for i in range(len(covered) - 1)
+    ), f"tier_segments must partition [0, {n_chunks}): {covered}"
+    return runs

@@ -1,0 +1,224 @@
+"""Walsh-spectral candidate scoring for best-first sweep ordering.
+
+The exact sweep drivers (:mod:`sboxgates_tpu.search.lut`) visit candidate
+combinations in uniform lexicographic rank order, so time-to-first-hit is
+pure luck of where the hit lands in the rank space.  WARP-LUTs' relaxation
+(PAPERS.md) observes that a candidate LUT function can realize the target
+only if the target correlates with the candidate's *span* — and span
+correlations are exactly Walsh coefficients.  This module computes those
+scores on device:
+
+**Packed Walsh–Hadamard transform.**  A gate's 256-bit truth table lives
+packed in 8 uint32 words.  :func:`unpack_signs` expands it to ±1 lanes and
+:func:`wht` runs the radix-2 butterfly (8 stages for 256 positions, pure
+int32 adds — no floats anywhere, so scores are exact and deterministic).
+
+**Masked correlation via Parseval.**  With the target restricted to its
+care set (``x_t[p] = mask[p] * (1 - 2*target[p])``, so don't-care positions
+contribute nothing and stop distorting scores) and a gate as
+``x_g[p] = 1 - 2*g[p]``, the masked agreement-minus-disagreement count is
+``dot(x_t, x_g)``.  The WHT matrix H satisfies ``H^T H = 256 I``, so
+``dot(x_t, x_g) == dot(wht(x_t), wht(x_g)) // 256`` exactly in integers —
+:func:`gate_scores` computes ``|corr|`` in the Walsh domain (and the test
+suite pins it against the direct popcount formulation).
+
+**Span scores.**  For a k-tuple, the signed per-cell care counts
+``d[cell]`` (:func:`cell_counts`) satisfy ``wht(d)[S] ==
+corr(target, XOR of the tuple elements selected by S)`` — the 2^k Walsh
+coefficients ARE the correlations of the target against the tuple's whole
+XOR span.  :func:`span_scores` takes the max |coefficient| over S != 0.
+This is the exact per-combination scorer; the streaming tier pass uses the
+cheaper sum-of-element-gate-scores proxy (gathering k precomputed scores
+per combination instead of re-deriving 2^k cells) because the score is
+*ordering-only* — a weaker proxy can never cost correctness, only
+ordering quality.
+
+**Contract.**  Scores order the sweep; they never prune it.  Every
+consumer must still visit the full rank space (see
+``ops.combinatorics.tier_segments`` for the partition guarantee).  All
+arithmetic is integer, seeded by nothing, clocked by nothing: scores are a
+pure function of (tables, target, mask), so R11 determinism and resume
+bit-identity hold per config.
+
+The optional Pallas kernel (:func:`gate_scores` with ``backend="pallas"``)
+fuses unpack -> butterfly -> spectral dot in VMEM; it is bit-identical to
+the XLA path by construction and rides the same pallas->xla fallback latch
+as the 5-LUT filter head (``search.lut._spectral_pallas_ok``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Largest rank-space size the stream drivers score spectrally.  The
+#: scoring pass is O(total * k) int32 gathers — far cheaper than the
+#: O(total * 2^k * W) feasibility sweep it reorders — but it is still a
+#: full-space prepass, so beyond this bound the drivers keep lexicographic
+#: order (advisory, documented in README "Candidate ordering").
+SPECTRAL_SCORE_MAX = 1 << 22
+
+#: Gates per Pallas block: [BG, 256] int32 signs plus the butterfly
+#: intermediates stay well inside VMEM; 64 divides every table bucket.
+BLOCK_G = 64
+
+
+def unpack_signs(words):
+    """Packed truth tables -> ±1 sign lanes.
+
+    ``words``: uint32[..., W].  Returns int32[..., W*32] with lane
+    ``w*32 + j`` = ``1 - 2*bit(words[..., w], j)`` — bit set means -1.
+    """
+    w = words.shape[-1]
+    sh = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> sh) & jnp.uint32(1)      # [..., W, 32]
+    bits = bits.reshape(words.shape[:-1] + (w * 32,))
+    return 1 - 2 * bits.astype(jnp.int32)
+
+
+def wht(x):
+    """In-place-order Walsh–Hadamard transform over the last axis.
+
+    ``x``: int32[..., n] with n a power of two.  Pure adds/subtracts —
+    exact int32 as long as ``n * max|x|`` fits (256 * 256 here).  The
+    transform is its own inverse up to the factor n: ``wht(wht(x)) ==
+    n * x`` (Parseval's ``H^T H = n I``).
+    """
+    n = x.shape[-1]
+    assert n & (n - 1) == 0, n
+    h = 1
+    while h < n:
+        y = x.reshape(x.shape[:-1] + (n // (2 * h), 2, h))
+        a, b = y[..., 0, :], y[..., 1, :]
+        x = jnp.stack([a + b, a - b], axis=-2).reshape(x.shape)
+        h *= 2
+    return x
+
+
+def target_spectrum(target, mask):
+    """WHT of the masked ±1 target: int32[256] from uint32[8] pair.
+
+    Lane p carries ``mask[p] * (1 - 2*target[p])`` — don't-care positions
+    are zeroed BEFORE the transform, so every downstream correlation is
+    automatically restricted to the care set.
+    """
+    care = unpack_signs(mask[None])[0]                       # ±1
+    care = (care < 0).astype(jnp.int32)                      # mask bits
+    return wht(care * unpack_signs(target[None])[0])
+
+
+def _gate_scores_xla(tables, spectrum):
+    xg = wht(unpack_signs(tables))                           # [B, 256]
+    corr = (xg * spectrum[None, :]).sum(axis=-1) // 256
+    return jnp.abs(corr).astype(jnp.int32)
+
+
+def _gate_scores_pallas(tables, spectrum, *, interpret=False):
+    """Fused unpack -> butterfly -> spectral dot, one VMEM block of
+    gates per grid step.  Bit-identical to the XLA path (same unpack
+    order, same integer butterfly)."""
+    from jax.experimental import pallas as pl
+
+    b = tables.shape[0]
+    assert b % BLOCK_G == 0, b
+
+    def kernel(t_ref, spec_ref, out_ref):
+        words = t_ref[:]                                     # [BG, 8] i32
+        sh = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 32), 2)
+        bits = (words[:, :, None] >> sh) & 1                 # [BG, 8, 32]
+        x = 1 - 2 * bits.reshape(BLOCK_G, 256)
+        h = 1
+        while h < 256:
+            y = x.reshape(BLOCK_G, 256 // (2 * h), 2, h)
+            a, b_ = y[:, :, 0, :], y[:, :, 1, :]
+            x = jnp.stack([a + b_, a - b_], axis=2).reshape(BLOCK_G, 256)
+            h *= 2
+        corr = (x * spec_ref[:]).sum(axis=-1) // 256
+        out_ref[:] = jnp.abs(corr)[None]
+
+    as_i32 = lambda a: jax.lax.bitcast_convert_type(a, jnp.int32)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b // BLOCK_G,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_G, 8), lambda i: (i, 0)),
+            pl.BlockSpec((1, 256), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_G), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        interpret=interpret,
+    )(as_i32(tables), spectrum.reshape(1, 256))
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("backend", "interpret"))
+def gate_scores(tables, target, mask, *, backend="xla", interpret=False):
+    """Masked spectral correlation score per gate: int32[B] in [0, 256].
+
+    ``tables``: uint32[B, 8] (zero-padded bucket rows score garbage but
+    are never gathered — combos index real gates only); ``target`` /
+    ``mask``: uint32[8].  ``score[j] = |#masked agree - #masked
+    disagree|`` between the target and gate j, computed in the Walsh
+    domain (Parseval-exact, see module docstring).
+    """
+    spectrum = target_spectrum(target, mask)
+    if backend == "pallas":
+        return _gate_scores_pallas(tables, spectrum, interpret=interpret)
+    return _gate_scores_xla(tables, spectrum)
+
+
+def cell_counts(tabs, target, mask):
+    """Signed per-cell care counts for k-tuples.
+
+    ``tabs``: uint32[k, W, N] gathered tuple tables (candidate axis
+    minormost, the sweep layout); ``target``/``mask``: uint32[W].
+    Returns int32[2^k, N]: ``d[c] = #(positions in cell c with
+    mask & target) - #(positions in cell c with mask & ~target)``, with
+    cell index bit (k-1-i) = input i's value (input 0 on the MSB, the
+    ``sweeps._cell_constraints_t`` convention).
+    """
+    k = tabs.shape[0]
+    full = jnp.full(tabs.shape[1:], 0xFFFFFFFF, dtype=jnp.uint32)[None]
+    cells = full                                             # [1, W, N]
+    for i in range(k - 1, -1, -1):
+        t = tabs[i][None]
+        cells = jnp.concatenate([cells & ~t, cells & t], axis=0)
+    pos = jax.lax.population_count(cells & (mask & target)[None, :, None])
+    neg = jax.lax.population_count(cells & (mask & ~target)[None, :, None])
+    return (pos.astype(jnp.int32) - neg.astype(jnp.int32)).sum(axis=1)
+
+
+def span_scores(tabs, target, mask):
+    """Exact span-correlation score per k-tuple: int32[N].
+
+    ``wht(cell_counts)[S]`` is the masked correlation of the target
+    against the XOR of the tuple elements selected by S, for every one of
+    the 2^k subsets at once; the score is the max |coefficient| over
+    S != 0 (S = 0 is the constant function — not in any LUT's useful
+    span).  Exact but O(2^k) per tuple: the streaming prepass uses the
+    per-gate sum proxy instead; this is the reference scorer the tests
+    pin the machinery against and the natural hook for don't-care
+    workloads.
+    """
+    d = cell_counts(tabs, target, mask)                      # [2^k, N]
+    coef = wht(jnp.moveaxis(d, 0, -1))                       # [N, 2^k]
+    return jnp.abs(coef[..., 1:]).max(axis=-1).astype(jnp.int32)
+
+
+def quantize_tiers(scores: np.ndarray, tiers: int = 4) -> np.ndarray:
+    """Host-side linear score quantization: int array -> tier ids.
+
+    Buckets ``scores`` into ``tiers`` equal-width integer bins between
+    min and max (tier ``tiers-1`` = best).  Pure integer arithmetic on
+    the host verdict — deterministic given the scores.  A flat score
+    vector collapses to one tier (ordering degenerates to lexicographic,
+    which is exactly the right fallback).
+    """
+    s = np.asarray(scores, dtype=np.int64)
+    lo, hi = int(s.min()), int(s.max())
+    if hi == lo:
+        return np.zeros(s.shape, dtype=np.int64)
+    return (s - lo) * tiers // (hi - lo + 1)

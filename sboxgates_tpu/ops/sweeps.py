@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import ttable as tt
+from . import spectral
 
 # -------------------------------------------------------------------------
 # Cell-constraint computation
@@ -507,6 +508,59 @@ def feasible_stream(tables, binom, g, target, mask, excl, start, total, *, k, ch
     examined = jnp.minimum(nxt, total) - start
     verdict = jnp.stack([found.astype(jnp.int32), cstart, examined])
     return verdict, feasible, r1, r0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "chunk", "n_chunks", "backend")
+)
+def spectral_score_stream(
+    tables, binom, g, target, mask, excl, total, *, k, chunk, n_chunks,
+    backend="xla",
+):
+    """Per-chunk spectral scores for the whole rank space in ONE dispatch.
+
+    The best-first prepass (see :mod:`sboxgates_tpu.ops.spectral`): gate
+    tables are Walsh-scored against the masked target on device, then a
+    fori_loop unranks every chunk of combination ranks and reduces
+    ``max over combos of (sum of element gate scores)`` per chunk.  The
+    sum discriminates chunks containing high-correlation tuples (a max
+    over elements saturates — every chunk holds combos touching any
+    given gate); excluded and out-of-range rows score -1.
+
+    Returns int32[n_chunks] (``n_chunks`` is padded to a shape bucket by
+    the driver; chunks past ceil(total/chunk) come back -1 and are
+    ignored).  No seed, no clock: a pure function of (tables, target,
+    mask, excl, total), so the tier order derived from it is
+    deterministic per config — R11 and resume bit-identity hold.
+    ``backend="pallas"`` routes the gate-score stage through the fused
+    VMEM kernel behind the shared pallas->xla fallback latch
+    (``search.lut._spectral_backend``).
+    """
+    total = jnp.asarray(total, jnp.int32)
+    spectrum = spectral.target_spectrum(target, mask)
+    if backend == "pallas":
+        gscores = spectral._gate_scores_pallas(tables, spectrum)
+    else:
+        gscores = spectral._gate_scores_xla(tables, spectrum)
+
+    def body(c, out):
+        ranks = c * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        valid = ranks < total
+        combos = _unrank_combos(binom, g, k, jnp.minimum(ranks, total - 1))
+        hit_excl = (combos[:, :, None] == excl[None, None, :]).any(axis=(0, 2))
+        s = gscores[combos].sum(axis=0)              # [chunk], <= k*256
+        s = jnp.where(valid & ~hit_excl, s, -1)
+        return out.at[c].set(s.max())
+
+    out0 = jnp.full((n_chunks,), -1, jnp.int32)
+    return jax.lax.fori_loop(0, n_chunks, body, out0)
+
+
+#: Registry alias: the pivot-path tile scorer dispatches the gate-score
+#: stage alone (tiles key on their pivot gate, so per-gate scores tier
+#: them host-side with no rank arithmetic).  Registered in
+#: search.warmup.KERNELS, which resolves kernels as sweeps attributes.
+spectral_gate_scores = spectral.gate_scores
 
 
 def _lut3_stream_core(tables, binom, g, target, mask, excl, start, total, seed, chunk):

@@ -115,6 +115,7 @@ KNOWN_SITES = (
     "net.auth",
     "net.body",
     "net.admit_journal",
+    "order.score",
 )
 
 

@@ -239,6 +239,19 @@ class Options:
     # wave the chain windows stack on the fleet jobs axis — dispatches
     # per round drop toward 1/(lanes x chain_rounds).
     chain_rounds: int = 0
+    # Candidate sweep ordering (--candidate-order, ops/spectral.py):
+    # "lex" visits combination ranks in uniform lexicographic order;
+    # "spectral" runs the Walsh-scored best-first prepass — rank chunks
+    # are spectrally scored against the masked target in one extra
+    # dispatch, bucketed into score tiers, and the SAME chunked kernels
+    # sweep tier segments best-first.  Ordering-only: the search stays
+    # exhaustive and the run-to-completion hit set is identical to lex
+    # (tests + bench --check order gate it).  Deterministic given
+    # (target, mask) — no clock, no RNG — but it SHAPES THE DRAW STREAM
+    # (the dispatch count, hence the next_seed() draw count, depends on
+    # where the hit lands in tier order), so it is journaled and
+    # restored by --resume-run like the other execution-mode flags.
+    candidate_order: str = "lex"
     # Structured tracing (--trace, telemetry.trace): every dispatch,
     # compile, warmup build, rendezvous merge, deadline window, and
     # journal write becomes a span in the process tracer, exportable as
@@ -1005,6 +1018,7 @@ class SearchContext:
             "mesh": self.mesh_plan is not None,
             "fleet": self.fleet_plan is not None or self.opt.fleet,
             "lut_graph": self.opt.lut_graph,
+            "candidate_order": self.opt.candidate_order,
             "last_dispatch_gates": self.last_dispatch_gates,
         }
 
@@ -1175,6 +1189,7 @@ class SearchContext:
     def feasible_stream_dispatch(
         self, st: State, target, mask, inbits, k: int, start: int = 0,
         prebuilt=None, phase: Optional[str] = None,
+        stop: Optional[int] = None,
     ) -> Callable[[], tuple]:
         """Async half of :meth:`feasible_stream_driver`: issues the device
         dispatch immediately (JAX async dispatch — the kernel starts
@@ -1182,10 +1197,15 @@ class SearchContext:
         ``resolve`` callable producing the driver's 7-tuple.  The
         pipelined drivers keep >= 2 of these in flight, syncing only on
         the compact verdict inside resolve(); ``phase`` names the
-        profiler overlap row the blocked time is charged to."""
+        profiler overlap row the blocked time is charged to.  ``stop``
+        bounds the sweep to ranks [start, stop) — the best-first tier
+        drivers (search.lut._order_segments) dispatch one segment at a
+        time through it; None sweeps to the space's end as before."""
         if prebuilt is None:
             prebuilt = self.stream_args(st, target, mask, inbits, k)
         base_args, total, chunk = prebuilt
+        if stop is not None:
+            total = min(int(stop), total)
         args = (*base_args, start, total)
         if self.mesh_plan is not None:
             from ..parallel.mesh import sharded_feasible_stream

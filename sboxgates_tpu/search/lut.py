@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from contextlib import closing
 from typing import List, Optional, Tuple
@@ -30,6 +31,7 @@ import numpy as np
 
 from ..graph.state import NO_GATE, State, check_num_gates_possible
 from ..ops import combinatorics as comb
+from ..ops import spectral
 from ..ops import sweeps
 from ..resilience.deadline import DispatchTimeout
 from . import warmup as _warmup
@@ -518,99 +520,123 @@ def _lut5_search_pivot(
     if ctx.mesh_plan is not None:
         from ..parallel.mesh import sharded_pivot_stream
 
-    start_t = 0
-    while start_t < t_real:
-        if ctx.mesh_plan is not None:
-            # SPMD lockstep rounds of one tile per device; per-device
-            # verdicts resolved in tile order, so the chosen circuit matches
-            # the single-device stream's when not randomizing.
-            seed = ctx.next_seed()
+    # Spectral best-first tile order: each tile keys on its pivot gate m,
+    # so one gate-score dispatch tiers ALL tiles host-side (no rank
+    # arithmetic, no space bound).  Mesh placements keep tile order (the
+    # lockstep rounds own the tile striding).
+    segments = None
+    if (
+        ctx.mesh_plan is None
+        and ctx.opt.candidate_order == "spectral"
+        and t_real > 1
+    ):
+        segments = _order_tile_segments(
+            ctx, st, dev_tables, target, mask, descs, t_real, "lut5.pivot"
+        )
+    ordered = segments is not None
+    if not ordered:
+        segments = [(0, t_real, 0)]
+    for seg_lo, seg_hi, tier in segments:
+        start_t = seg_lo
+        while start_t < seg_hi:
+            if ctx.mesh_plan is not None:
+                # SPMD lockstep rounds of one tile per device; per-device
+                # verdicts resolved in tile order, so the chosen circuit
+                # matches the single-device stream's when not randomizing.
+                seed = ctx.next_seed()
 
-            # Per-ATTEMPT stats dict, allocated inside the attempt: an
-            # abandoned deadline worker that completes late writes only
-            # into its own private dict, so it can never race ctx.stats
-            # NOR the winning attempt's merge (the winner's dict is
-            # quiescent once the attempt returns it).
-            def _pivot_attempt(s=start_t):
-                astats: dict = {}
-                # jaxlint: ignore[R2] deliberate sync: per-round sharded verdict gather is the stream's only sync point
-                out = np.asarray(sharded_pivot_stream(
-                    ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv, jdescs,
-                    s, t_real, jw, jm, seed,
-                    tl=tl, th=th, stats=astats,
+                # Per-ATTEMPT stats dict, allocated inside the attempt: an
+                # abandoned deadline worker that completes late writes only
+                # into its own private dict, so it can never race ctx.stats
+                # NOR the winning attempt's merge (the winner's dict is
+                # quiescent once the attempt returns it).
+                def _pivot_attempt(s=start_t):
+                    astats: dict = {}
+                    # jaxlint: ignore[R2] deliberate sync: per-round sharded verdict gather is the stream's only sync point
+                    out = np.asarray(sharded_pivot_stream(
+                        ctx.mesh_plan, tables, lc1, lc0, hc, jlv, jhv,
+                        jdescs, s, t_real, jw, jm, seed,
+                        tl=tl, th=th, stats=astats,
+                    ))
+                    return out, astats
+
+                verdicts, local_stats = ctx.guarded_dispatch(
+                    _pivot_attempt, "lut5.pivot.sharded"
+                )
+                for k, n in local_stats.items():
+                    ctx.stats.inc(k, n)
+                next_t = int(verdicts[0, 9])
+                ctx.stats.inc("lut5_candidates", int(
+                    size_cum[min(next_t, t_real)] - size_cum[start_t]
                 ))
-                return out, astats
+                hits = verdicts[verdicts[:, 0] != 0]
+                for hv in hits[np.argsort(hits[:, 1])]:
+                    if int(hv[0]) == 1:
+                        return decode_hit(
+                            int(hv[2]), int(hv[3]), int(hv[4]),
+                            int(hv[5]), int(hv[6]), int(hv[7]), int(hv[8]),
+                        )
+                    res = redrive_tile(int(hv[1]))
+                    if res is not None:
+                        return res
+                start_t = next_t
+                continue
 
-            verdicts, local_stats = ctx.guarded_dispatch(
-                _pivot_attempt, "lut5.pivot.sharded"
+            backend = pivot_backend()
+            seed = ctx.next_seed()
+            # The pallas tile kernels are single-lane (no job axis); their
+            # dispatches stay per-thread while the XLA backends merge
+            # through the rendezvous into one stacked pivot stream per
+            # round (ops.pallas_pivot.job_axis_backend documents the gate).
+            dispatch = (
+                ctx.kernel_call if backend.startswith("pallas")
+                else lambda nm, stat, a, g=None: ctx.stream_dispatch(
+                    nm, stat, a,
+                    shared=_warmup.FLEET_SHARED["lut5_pivot_stream"], g=g,
+                )
             )
-            for k, n in local_stats.items():
-                ctx.stats.inc(k, n)
-            next_t = int(verdicts[0, 9])
+            v = ctx.guarded_dispatch(
+                # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
+                lambda s=start_t, hi=seg_hi: np.asarray(dispatch(
+                    "lut5_pivot_stream",
+                    dict(
+                        tl=tl, th=th,
+                        tile_batch=(
+                            1 if backend.startswith("pallas")
+                            else pivot_tile_batch()
+                        ),
+                        pipeline=pivot_pipeline(), backend=backend,
+                    ),
+                    (tables, lc1, lc0, hc, jlv, jhv, jdescs, s, hi,
+                     jw, jm, seed),
+                    g=g,
+                )),
+                "lut5.pivot",
+            )
+            if ordered:
+                ctx.stats.inc("order_tier_dispatches")
+            status, next_t = int(v[0]), int(v[8])
             ctx.stats.inc("lut5_candidates", int(
                 size_cum[min(next_t, t_real)] - size_cum[start_t]
             ))
-            hits = verdicts[verdicts[:, 0] != 0]
-            for hv in hits[np.argsort(hits[:, 1])]:
-                if int(hv[0]) == 1:
-                    return decode_hit(
-                        int(hv[2]), int(hv[3]), int(hv[4]),
-                        int(hv[5]), int(hv[6]), int(hv[7]), int(hv[8]),
-                    )
-                res = redrive_tile(int(hv[1]))
-                if res is not None:
-                    return res
+            if status == 0:
+                break  # segment exhausted; fall to the next tier segment
+            if status == 1:
+                if ordered:
+                    ctx.stats.inc("order_first_hit_tier", tier)
+                return decode_hit(
+                    int(v[1]), int(v[2]), int(v[3]), int(v[4]), int(v[5]),
+                    int(v[6]), int(v[7]),
+                )
+            # status 2: more feasible tuples in tile next_t-1 than the
+            # in-kernel solver rows — fetch that tile's full constraints
+            # and solve them all.
+            res = redrive_tile(next_t - 1)
+            if res is not None:
+                if ordered:
+                    ctx.stats.inc("order_first_hit_tier", tier)
+                return res
             start_t = next_t
-            continue
-
-        backend = pivot_backend()
-        seed = ctx.next_seed()
-        # The pallas tile kernels are single-lane (no job axis); their
-        # dispatches stay per-thread while the XLA backends merge
-        # through the rendezvous into one stacked pivot stream per
-        # round (ops.pallas_pivot.job_axis_backend documents the gate).
-        dispatch = (
-            ctx.kernel_call if backend.startswith("pallas")
-            else lambda nm, stat, a, g=None: ctx.stream_dispatch(
-                nm, stat, a,
-                shared=_warmup.FLEET_SHARED["lut5_pivot_stream"], g=g,
-            )
-        )
-        v = ctx.guarded_dispatch(
-            # jaxlint: ignore[R2] deliberate sync: single-device pivot-stream verdict; one compact int32 row per dispatch
-            lambda s=start_t: np.asarray(dispatch(
-                "lut5_pivot_stream",
-                dict(
-                    tl=tl, th=th,
-                    tile_batch=(
-                        1 if backend.startswith("pallas")
-                        else pivot_tile_batch()
-                    ),
-                    pipeline=pivot_pipeline(), backend=backend,
-                ),
-                (tables, lc1, lc0, hc, jlv, jhv, jdescs, s, t_real,
-                 jw, jm, seed),
-                g=g,
-            )),
-            "lut5.pivot",
-        )
-        status, next_t = int(v[0]), int(v[8])
-        ctx.stats.inc("lut5_candidates", int(
-            size_cum[min(next_t, t_real)] - size_cum[start_t]
-        ))
-        if status == 0:
-            return None
-        if status == 1:
-            return decode_hit(
-                int(v[1]), int(v[2]), int(v[3]), int(v[4]), int(v[5]),
-                int(v[6]), int(v[7]),
-            )
-        # status 2: more feasible tuples in tile next_t-1 than the in-kernel
-        # solver rows — fetch that tile's full constraints and solve them all.
-        res = redrive_tile(next_t - 1)
-        if res is not None:
-            return res
-        start_t = next_t
     return None
 
 
@@ -729,47 +755,73 @@ def _lut5_stream_loop(
     """Fully-fused single-device 5-LUT sweep from rank ``start``: filter +
     compaction + decomposition solve inside one while_loop dispatch; one
     int32[8] verdict per call.  Also the resume path after a fused-head
-    solver overflow (lut_search_from_head)."""
+    solver overflow (lut_search_from_head).
+
+    Under ``--candidate-order spectral`` a fresh sweep (``start == 0``)
+    first scores the rank chunks (:func:`_order_segments`) and walks the
+    score-tier segments best-first; each segment is just a (start, stop)
+    window for the unchanged fused kernel, so the per-chunk verdicts are
+    bit-identical to the lexicographic sweep's.  Overflow-resume
+    continuations (``start > 0``) stay lexicographic: their prefix was
+    already proven unsolvable, so there is no first hit left to move."""
     g = st.num_gates
     args, total, chunk = ctx.stream_args(st, target, mask, inbits, 5)
-    while start < total:
-        seed = ctx.next_seed()
-        v = ctx.guarded_dispatch(
-            # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
-            lambda s=start: np.asarray(ctx.stream_dispatch(
-                "lut5_stream", dict(chunk=chunk),
-                (*args, s, total, jw, jm, seed),
-                shared=_warmup.FLEET_SHARED["lut5_stream"], g=g,
-            )),
+    segments = None
+    if start == 0:
+        segments = _order_segments(
+            ctx, st, target, mask, inbits, 5, (args, total, chunk),
             "lut5.stream",
         )
-        status, cstart = int(v[0]), int(v[6])
-        ctx.stats.inc("lut5_candidates", int(v[7]))
-        if status == 0:
-            return None
-        if status == 1:
-            combo = comb.unrank_combination(int(v[1]), g, 5)
-            return _decode_lut5(
-                ctx,
-                combo,
-                int(v[2]),
-                int(v[3]),
-                _unpack32(int(v[4]) & 0xFFFFFFFF),
-                _unpack32(int(v[5]) & 0xFFFFFFFF),
-                splits,
-                w_tab,
-                m_tab,
+    ordered = segments is not None
+    if not ordered:
+        segments = [(start, total, 0)]
+    for seg_lo, seg_hi, tier in segments:
+        start = seg_lo
+        while start < seg_hi:
+            seed = ctx.next_seed()
+            v = ctx.guarded_dispatch(
+                # jaxlint: ignore[R2] deliberate sync: compact int32[8] verdict per while_loop dispatch, by design
+                lambda s=start, hi=seg_hi: np.asarray(ctx.stream_dispatch(
+                    "lut5_stream", dict(chunk=chunk),
+                    (*args, s, hi, jw, jm, seed),
+                    shared=_warmup.FLEET_SHARED["lut5_stream"], g=g,
+                )),
+                "lut5.stream",
             )
-        # status 2: the chunk at cstart had more feasible tuples than the
-        # in-kernel solver examined — re-drive just that chunk through the
-        # two-phase path, then resume the fused stream after it.
-        res = _lut5_chunk_two_phase(
-            ctx, st, target, mask, inbits, cstart, jw, jm,
-            splits, w_tab, m_tab, prebuilt=(args, total, chunk),
-        )
-        if res is not None:
-            return res
-        start = cstart + chunk
+            if ordered:
+                ctx.stats.inc("order_tier_dispatches")
+            status, cstart = int(v[0]), int(v[6])
+            ctx.stats.inc("lut5_candidates", int(v[7]))
+            if status == 0:
+                break  # segment exhausted; fall to the next tier segment
+            if status == 1:
+                if ordered:
+                    ctx.stats.inc("order_first_hit_tier", tier)
+                combo = comb.unrank_combination(int(v[1]), g, 5)
+                return _decode_lut5(
+                    ctx,
+                    combo,
+                    int(v[2]),
+                    int(v[3]),
+                    _unpack32(int(v[4]) & 0xFFFFFFFF),
+                    _unpack32(int(v[5]) & 0xFFFFFFFF),
+                    splits,
+                    w_tab,
+                    m_tab,
+                )
+            # status 2: the chunk at cstart had more feasible tuples than
+            # the in-kernel solver examined — re-drive just that chunk
+            # through the two-phase path, then resume the fused stream
+            # after it (within the same segment).
+            res = _lut5_chunk_two_phase(
+                ctx, st, target, mask, inbits, cstart, jw, jm,
+                splits, w_tab, m_tab, prebuilt=(args, total, chunk),
+            )
+            if res is not None:
+                if ordered:
+                    ctx.stats.inc("order_first_hit_tier", tier)
+                return res
+            start = cstart + chunk
     return None
 
 
@@ -900,6 +952,170 @@ def _filter_call(ctx: SearchContext, tables, chunk_placed, valid, jt, jm, g, k):
     return ctx.kernel_call(
         "lut_filter", {}, (tables, chunk_placed, valid, jt, jm), g=g
     )
+
+
+# -------------------------------------------------------------------------
+# Spectral best-first candidate ordering (--candidate-order spectral)
+#
+# ops/spectral.py computes Walsh-correlation scores of every gate table
+# against the masked target; the drivers below bucket rank chunks (or
+# pivot tiles) into score tiers and sweep the SAME kernels through the
+# tiers best-first via their ordinary (start, stop) operands.  Ordering
+# only: segments partition the space (ops.combinatorics.tier_segments
+# asserts it), so run-to-exhaustion visits exactly the lexicographic hit
+# set; and the scores are a pure integer function of (tables, target,
+# mask), so the order — hence the dispatch count, hence the seed draw
+# stream — is deterministic per config (R11 + resume bit-identity).
+# -------------------------------------------------------------------------
+
+#: Score tiers for the best-first rank remap; 4 keeps segments coarse
+#: enough that extra segment-boundary dispatches stay negligible while
+#: still front-loading the high-correlation chunks.
+ORDER_TIERS = 4
+
+
+def spectral_backend() -> str:
+    """Spectral gate-score backend (SBG_SPECTRAL_BACKEND, default xla):
+    ``pallas`` fuses unpack -> Walsh butterfly -> spectral dot in VMEM
+    (ops.spectral._gate_scores_pallas).  Bit-identical scores
+    (parity-tested); a failed Mosaic lowering latches back to xla with
+    the shared rate-limited fallback note, like the feasibility
+    filter's."""
+    import os
+
+    return os.environ.get("SBG_SPECTRAL_BACKEND", "xla")
+
+
+# Latch for a failed pallas spectral lowering (same probe-once shape as
+# the filter latch above; mutated only under the lock).
+_SPECTRAL_LOCK = threading.Lock()
+_SPECTRAL_PALLAS_BROKEN = False
+
+
+def _spectral_pallas_ok() -> bool:
+    return spectral_backend() == "pallas" and not _SPECTRAL_PALLAS_BROKEN
+
+
+def _latch_spectral_xla(ctx: SearchContext, exc: BaseException) -> None:
+    global _SPECTRAL_PALLAS_BROKEN
+    with _SPECTRAL_LOCK:
+        _SPECTRAL_PALLAS_BROKEN = True
+    from ..parallel.mesh import note_filter_pallas_fallback
+
+    note_filter_pallas_fallback("spectral-pallas", ctx.stats, exc)
+
+
+def _use_spectral(ctx: SearchContext, total: int, chunk: int) -> bool:
+    """Route guard for the best-first rank streams: opted in, a space
+    with an order to exploit (> 1 chunk) yet inside the scoring budget,
+    and off the sharded placements (the mesh streams own their chunk
+    striding and keep lexicographic order — README "Candidate
+    ordering")."""
+    return (
+        ctx.opt.candidate_order == "spectral"
+        and ctx.mesh_plan is None
+        and chunk < total <= spectral.SPECTRAL_SCORE_MAX
+    )
+
+
+def _order_segments(ctx, st, target, mask, inbits, k, prebuilt, phase):
+    """Best-first (score-tiered) rank segments for a chunked stream.
+
+    One ``spectral_score_stream`` dispatch scores every rank chunk
+    (packed WHT gate scores, summed per combination, maxed per chunk),
+    then :func:`sboxgates_tpu.ops.combinatorics.tier_segments` buckets
+    the chunks into ORDER_TIERS tiers and returns maximal same-tier runs
+    best-first.  Returns ``[(lo_rank, hi_rank, tier), ...]``
+    partitioning [0, total) in chunk-aligned ranges, or None when the
+    stream should keep lexicographic order.  A deadline breach raises
+    :class:`DispatchTimeout` — the caller's existing degrade path then
+    sweeps lexicographically on the host drivers."""
+    args, total, chunk = prebuilt
+    if not _use_spectral(ctx, total, chunk):
+        return None
+    g = st.num_gates
+    n_chunks = -(-total // chunk)
+    n_pad = 8
+    while n_pad < n_chunks:
+        n_pad *= 2
+    from ..resilience.faults import fault_point
+
+    t0 = time.perf_counter()
+    be = {"backend": "pallas" if _spectral_pallas_ok() else "xla"}
+
+    def issue():
+        # Fault site: one hit per scoring dispatch (raise = a scoring
+        # failure the driver's caller surfaces; the sweep itself never
+        # depends on scores for correctness).
+        fault_point("order.score")
+        return ctx.kernel_call(
+            "spectral_score_stream",
+            dict(k=k, chunk=chunk, n_chunks=n_pad, backend=be["backend"]),
+            (*args, total), g=g,
+        )
+
+    def attempt():
+        try:
+            return np.asarray(issue())
+        except Exception as e:
+            # A failed Mosaic lowering of the spectral head latches to
+            # the XLA path (bit-identical scores) and re-issues; the
+            # shared fallback signal logs it.
+            if be["backend"] != "pallas":
+                raise
+            _latch_spectral_xla(ctx, e)
+            be["backend"] = "xla"
+            return np.asarray(issue())
+
+    scores = ctx.guarded_dispatch(attempt, f"{phase}.order")
+    segs = [
+        (lo * chunk, min(hi * chunk, total), tier)
+        for lo, hi, tier in comb.tier_segments(scores, n_chunks, ORDER_TIERS)
+    ]
+    ctx.stats.observe("order_score_s", time.perf_counter() - t0)
+    return segs
+
+
+def _order_tile_segments(ctx, st, dev_tables, target, mask, descs, t_real, phase):
+    """Pivot-path best-first ordering: every tile keys on its pivot gate
+    m (``descs[:, 0]``), so per-gate Walsh scores tier the tiles with
+    ONE small gate-score dispatch and zero rank arithmetic — any
+    ``t_real``, no SPECTRAL_SCORE_MAX bound.  Returns
+    ``[(lo_tile, hi_tile, tier), ...]`` partitioning [0, t_real)."""
+    from ..resilience.faults import fault_point
+
+    t0 = time.perf_counter()
+    be = {"backend": "pallas" if _spectral_pallas_ok() else "xla"}
+
+    def issue():
+        fault_point("order.score")
+        return ctx.kernel_call(
+            "spectral_gate_scores", dict(backend=be["backend"]),
+            (
+                dev_tables,
+                ctx.place_replicated(np.asarray(target)),
+                ctx.place_replicated(np.asarray(mask)),
+            ),
+            g=st.num_gates,
+        )
+
+    def attempt():
+        try:
+            return np.asarray(issue())
+        except Exception as e:
+            # Same latch as _order_segments: failed Mosaic lowering
+            # falls back to XLA (bit-identical scores) and re-issues.
+            if be["backend"] != "pallas":
+                raise
+            _latch_spectral_xla(ctx, e)
+            be["backend"] = "xla"
+            return np.asarray(issue())
+
+    gscores = ctx.guarded_dispatch(attempt, f"{phase}.order")
+    tile_scores = gscores[descs[:t_real, 0]]
+    segs = comb.tier_segments(tile_scores, t_real, ORDER_TIERS)
+    ctx.stats.observe("order_score_s", time.perf_counter() - t0)
+    return segs
 
 
 def _device_enum_enabled() -> bool:
@@ -1270,7 +1486,15 @@ def _lut7_device_stage_a(
     phase: str,
 ):
     """Device-stream half of stage A (see :func:`_lut7_collect_hits`);
-    raises DispatchTimeout past the deadline budget."""
+    raises DispatchTimeout past the deadline budget.
+
+    Under ``--candidate-order spectral`` the windows walk score-tier
+    rank segments best-first (:func:`_order_segments`; each segment is a
+    (start, stop) window for the unchanged feasibility stream).  When
+    the sweep runs to exhaustion (cap not binding) the collected hit SET
+    equals the lexicographic sweep's; a binding LUT7_CAP keeps the
+    best-scored hits instead of the lexicographically-first ones, which
+    is exactly the ordering's point."""
     g = st.num_gates
     hit_combos: List[np.ndarray] = []
     hit_req1: List[np.ndarray] = []
@@ -1278,63 +1502,77 @@ def _lut7_device_stage_a(
     nhits = 0
     total = comb.n_choose_k(g, 7)
     prebuilt = ctx.stream_args(st, target, mask, inbits, 7)
+    segments = _order_segments(
+        ctx, st, target, mask, inbits, 7, prebuilt, phase
+    )
+    ordered = segments is not None
+    if not ordered:
+        segments = [(0, total, 0)]
 
-    def dispatch(start):
-        if start >= total:
+    def dispatch(start, stop):
+        if start >= stop:
             return None
         return ctx.feasible_stream_dispatch(
             st, target, mask, inbits, k=7, start=start,
-            prebuilt=prebuilt, phase=phase,
+            prebuilt=prebuilt, phase=phase, stop=stop,
         )
 
-    resolve = dispatch(0)
     # Worst per-window row count seen so far — the speculation gate's
     # headroom estimate (None until the first window resolves).
     max_rows = None
-    while resolve is not None and nhits < LUT7_CAP:
-        found, cstart, feas, r1, r0, examined, chunk = resolve()
-        ctx.stats.inc("lut7_candidates", examined)
-        if not found:
+    for seg_lo, seg_hi, tier in segments:
+        if nhits >= LUT7_CAP:
             break
-        # Keep the device busy during the host-side fetch + unrank of
-        # this window's hit rows: the resume stream's start depends
-        # only on the verdict, so it can launch right now.  When the
-        # rows below cross LUT7_CAP the in-flight dispatch is simply
-        # dropped (its candidates intentionally uncounted — the
-        # serial driver never swept them) — but the device still runs
-        # the abandoned stream, which in a hit-sparse tail can scan
-        # the whole remaining C(G,7) space before stage B and the
-        # next node's sweeps get the device (the same cost
-        # lut5_search's solve_failed gate guards against).  So
-        # speculate only with demonstrated cap headroom: this
-        # window's rows are unknown until the expensive feas fetch
-        # below, so assume it and the next window each bring the
-        # worst row count seen so far and require the cap to survive
-        # both.  The first window always resolves serially (no
-        # history), matching lut5's initially-unarmed speculation.
-        speculate = (
-            depth >= 2 and max_rows is not None
-            and nhits + 2 * max_rows < LUT7_CAP
-        )
-        resolve = dispatch(cstart + chunk) if speculate else None
-        # jaxlint: ignore[R2] deliberate sync: window resolve point of the double-buffered lut7 stream
-        feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
-        rows = np.nonzero(feas)[0]
-        hit_combos.append(
-            np.stack(
-                [comb.unrank_combination(cstart + int(r), g, 7) for r in rows]
+        resolve = dispatch(seg_lo, seg_hi)
+        while resolve is not None and nhits < LUT7_CAP:
+            found, cstart, feas, r1, r0, examined, chunk = resolve()
+            ctx.stats.inc("lut7_candidates", examined)
+            if ordered:
+                ctx.stats.inc("order_tier_dispatches")
+            if not found:
+                break  # segment exhausted; fall to the next tier segment
+            if ordered and nhits == 0:
+                ctx.stats.inc("order_first_hit_tier", tier)
+            # Keep the device busy during the host-side fetch + unrank of
+            # this window's hit rows: the resume stream's start depends
+            # only on the verdict, so it can launch right now.  When the
+            # rows below cross LUT7_CAP the in-flight dispatch is simply
+            # dropped (its candidates intentionally uncounted — the
+            # serial driver never swept them) — but the device still runs
+            # the abandoned stream, which in a hit-sparse tail can scan
+            # the whole remaining C(G,7) space before stage B and the
+            # next node's sweeps get the device (the same cost
+            # lut5_search's solve_failed gate guards against).  So
+            # speculate only with demonstrated cap headroom: this
+            # window's rows are unknown until the expensive feas fetch
+            # below, so assume it and the next window each bring the
+            # worst row count seen so far and require the cap to survive
+            # both.  The first window always resolves serially (no
+            # history), matching lut5's initially-unarmed speculation.
+            speculate = (
+                depth >= 2 and max_rows is not None
+                and nhits + 2 * max_rows < LUT7_CAP
             )
-        )
-        hit_req1.append(r1[rows])
-        hit_req0.append(r0[rows])
-        nhits += len(rows)
-        max_rows = max(max_rows or 0, len(rows))
-        if resolve is None and nhits < LUT7_CAP:
-            # No speculative dispatch was in flight (serial depth,
-            # first window, or insufficient headroom): resume only
-            # now that this window is fully consumed — and never
-            # past the cap.
-            resolve = dispatch(cstart + chunk)
+            resolve = dispatch(cstart + chunk, seg_hi) if speculate else None
+            # jaxlint: ignore[R2] deliberate sync: window resolve point of the double-buffered lut7 stream
+            feas, r1, r0 = np.asarray(feas), np.asarray(r1), np.asarray(r0)
+            rows = np.nonzero(feas)[0]
+            hit_combos.append(
+                np.stack(
+                    [comb.unrank_combination(cstart + int(r), g, 7)
+                     for r in rows]
+                )
+            )
+            hit_req1.append(r1[rows])
+            hit_req0.append(r0[rows])
+            nhits += len(rows)
+            max_rows = max(max_rows or 0, len(rows))
+            if resolve is None and nhits < LUT7_CAP:
+                # No speculative dispatch was in flight (serial depth,
+                # first window, or insufficient headroom): resume only
+                # now that this window is fully consumed — and never
+                # past the cap.
+                resolve = dispatch(cstart + chunk, seg_hi)
     return hit_combos, hit_req1, hit_req0, nhits
 
 

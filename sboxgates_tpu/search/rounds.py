@@ -290,8 +290,14 @@ def run_round_chain(
             # results are bit-identical to the direct window
             # (_merge_streams is off under a deadline budget, so the
             # guarded path below still owns that configuration).
+            # Fused windows keep lexicographic candidate order regardless
+            # of ctx.opt.candidate_order: the whole window is ONE dispatch
+            # (no host-visible segment boundaries to reorder), and its
+            # host-fallback rounds reach the spectrally-ordered lut
+            # drivers through kwan.create_circuit anyway.  The span tags
+            # the order so traces show which regime produced each window.
             with _ttrace.span("round_driver", "round", rounds=n, g=g,
-                              merged=True):
+                              merged=True, order=ctx.opt.candidate_order):
                 hits = np.asarray(ctx.stream_dispatch(
                     "round_driver", statics, window_args,
                     shared=_warmup.FLEET_SHARED["round_driver"], g=g,
@@ -303,7 +309,8 @@ def run_round_chain(
                 )
 
             try:
-                with _ttrace.span("round_driver", "round", rounds=n, g=g):
+                with _ttrace.span("round_driver", "round", rounds=n, g=g,
+                                  order=ctx.opt.candidate_order):
                     pending = {"out": issue()}
                     hits = ctx.guarded_dispatch(
                         # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused window — the sync this driver exists to amortize
@@ -517,7 +524,8 @@ def run_fleet_round_chains(
 
         try:
             with _ttrace.span("fleet_round_driver", "round",
-                              lanes=len(window), rounds=n, g=gmax):
+                              lanes=len(window), rounds=n, g=gmax,
+                              order=ctx.opt.candidate_order):
                 pending = {"out": issue()}
                 hits = ctx.guarded_dispatch(
                     # jaxlint: ignore[R2] deliberate sync: ONE compact hit-journal pull per fused WAVE window — lanes x rounds of search per sync

@@ -883,6 +883,10 @@ class ServeOrchestrator:
             "randomize": opt.randomize,
             "batch": opt.batch_restarts,
             "chain_rounds": opt.chain_rounds,
+            # Candidate ordering changes the dispatch count of every
+            # ordered sweep (and each dispatch draws a seed), so a
+            # frontier taken under one order only replays under it.
+            "candidate_order": opt.candidate_order,
         }
 
     def _consult_store(self, job: ServeJob, sbox, n_in: int):

@@ -205,6 +205,16 @@ KERNELS: Dict[str, KernelDef] = {
             warmable=False,
         ),
         KernelDef("lut5_filter", ("backend",), warmable=False),
+        # Spectral best-first prepass (ops/spectral.py + search/lut.py
+        # tier segments): one dispatch scoring every rank chunk before a
+        # sweep.  Not warmable: n_chunks keys on the live rank-space
+        # size bucket and the backend static rides the pallas latch.
+        KernelDef(
+            "spectral_score_stream",
+            ("k", "chunk", "n_chunks", "backend"),
+            warmable=False,
+        ),
+        KernelDef("spectral_gate_scores", ("backend",), warmable=False),
     )
 }
 

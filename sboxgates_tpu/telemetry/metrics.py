@@ -210,6 +210,17 @@ METRICS: Dict[str, MetricDef] = {
         "admission requests answered 5xx (injected faults included); "
         "each drops a flight-recorder dump",
     ),
+    "order_tier_dispatches": MetricDef(
+        COUNTER, "dispatches",
+        "sweep-stream dispatches issued under spectral best-first tier "
+        "order (lexicographic sweeps never touch this)",
+    ),
+    "order_first_hit_tier": MetricDef(
+        COUNTER, "tier index",
+        "accumulated tier index (0 = best) of the segment whose sweep "
+        "produced each spectrally-ordered first hit — staying near 0 "
+        "means the Walsh scores are pointing at the hits",
+    ),
     # histograms (bracketed members inherit the base declaration)
     "device_wait_s": MetricDef(
         HISTOGRAM, "s",
@@ -254,6 +265,13 @@ METRICS: Dict[str, MetricDef] = {
         "admission-endpoint service time per accepted/answered POST "
         "(auth + bounded read + canonical key + durable admit record + "
         "enqueue; the bench's admission-p99 source)",
+    ),
+    "order_score_s": MetricDef(
+        HISTOGRAM, "s",
+        "wall time of one spectral scoring prepass (the "
+        "spectral_score_stream / spectral_gate_scores dispatch plus tier "
+        "segmentation) — the ordering overhead a time-to-first-hit win "
+        "must beat",
     ),
 }
 

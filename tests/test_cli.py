@@ -44,6 +44,19 @@ def test_invalid_invocations_fail(argv):
     assert main(argv) != 0
 
 
+def test_bad_candidate_order_is_one_line_error(capsys):
+    """An unknown --candidate-order value is rejected before any engine
+    work with a one-line error naming the value — never a traceback,
+    never a silently-lexicographic run under a typo'd 'spectral'."""
+    capsys.readouterr()
+    rc = main(["--candidate-order", "spectrall", DES])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "spectrall" in err
+    assert err.strip().count("\n") == 0
+    assert "Traceback" not in err
+
+
 def test_truncated_graph_file_is_one_line_error(tmp_path, capsys):
     """-g on a truncated or corrupt XML state exits nonzero with a
     one-line error naming the file and the parse failure — never a
